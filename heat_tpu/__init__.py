@@ -1,0 +1,33 @@
+"""heat_tpu — a TPU-native distributed array and data-analytics framework.
+
+A from-scratch re-design of HeAT's capabilities (NumPy-style global arrays
+sharded along a ``split`` axis, MPI-style collectives, distributed linear
+algebra, sklearn-style estimators, data-parallel NN training) on
+JAX/XLA/shard_map/Pallas.  ``import heat_tpu as ht`` exposes the reference's
+flat namespace.
+"""
+
+from .core import *
+from . import core
+from .core import random
+from . import linalg
+from .linalg import matmul, dot, transpose, norm  # hoist reference's flat exports
+from .linalg.basics import outer, trace, tril, triu, vdot, cross, projection, vector_norm, matrix_norm
+from .linalg.qr import qr
+from .linalg.svdtools import svd
+from . import spatial
+from . import cluster
+from . import decomposition
+from . import regression
+from . import naive_bayes
+from . import classification
+from . import preprocessing
+from . import graph
+from . import nn
+from . import optim
+from . import utils
+from . import fft
+from . import sparse
+from . import parallel
+
+__version__ = core.version.__version__

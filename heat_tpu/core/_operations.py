@@ -1,0 +1,232 @@
+"""Generalized op dispatch (reference: ``heat/core/_operations.py``, SURVEY §2.1).
+
+The reference's four dispatch helpers do sanitize → local torch call →
+explicit collective → wrap.  Here the collective step vanishes: ops run on
+globally-shaped sharded ``jax.Array``s and XLA's SPMD partitioner emits any
+required communication.  What remains is *metadata propagation* — computing
+the result ``split`` under broadcasting and reductions, and reconciling
+mismatched splits (an explicit reshard, with the reference's perf warning).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import sanitation, types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+from .stride_tricks import broadcast_shape, sanitize_axis
+
+__all__ = ["_local_op", "_binary_op", "_reduce_op", "_cum_op"]
+
+
+def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwargs) -> DNDarray:
+    """Elementwise op with no communication; split is preserved."""
+    sanitation.sanitize_in(x)
+    result = op(x._jarray, **kwargs)
+    result = x.comm.shard(result, x.split if x.split is not None and x.split < result.ndim else None)
+    if out is not None:
+        sanitation.sanitize_out(out, result.shape, x.split, x.device)
+        out._jarray = result.astype(out.dtype.jax_dtype())
+        return out
+    return DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        x.split if x.split is not None and x.split < result.ndim else None,
+        x.device,
+        x.comm,
+        x.balanced,
+    )
+
+
+def _result_split(
+    shapes_splits: Tuple[Tuple[Tuple[int, ...], Optional[int]], ...], out_ndim: int
+) -> Optional[int]:
+    """Result split of a broadcasted op: operand splits aligned to output dims."""
+    aligned = []
+    for shape, split in shapes_splits:
+        if split is None:
+            continue
+        aligned.append(split + (out_ndim - len(shape)))
+    if not aligned:
+        return None
+    return aligned[0]
+
+
+def _binary_op(
+    op: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    where=None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Broadcasting binary op with split reconciliation (reference __binary_op)."""
+    from . import factories
+
+    fn_kwargs = fn_kwargs or {}
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        raise TypeError(f"At least one operand must be a DNDarray, got {type(t1)}, {type(t2)}")
+
+    proto = t1 if isinstance(t1, DNDarray) else t2
+    device, comm = proto.device, proto.comm
+
+    def as_operand(t):
+        if isinstance(t, DNDarray):
+            return t
+        if np.isscalar(t) or isinstance(t, (np.ndarray, jax.Array, list, tuple)):
+            return factories.array(t, device=device, comm=comm)
+        raise TypeError(f"Unsupported operand type {type(t)}")
+
+    # keep Python scalars as weak-typed scalars (jnp promotion handles them);
+    # everything else becomes a DNDarray
+    t1_scalar = np.isscalar(t1) and not isinstance(t1, (np.generic,))
+    t2_scalar = np.isscalar(t2) and not isinstance(t2, (np.generic,))
+    a1 = t1 if t1_scalar else as_operand(t1)
+    a2 = t2 if t2_scalar else as_operand(t2)
+
+    s1 = a1.split if isinstance(a1, DNDarray) else None
+    s2 = a2.split if isinstance(a2, DNDarray) else None
+    sh1 = a1.shape if isinstance(a1, DNDarray) else ()
+    sh2 = a2.shape if isinstance(a2, DNDarray) else ()
+    out_shape = broadcast_shape(sh1, sh2)
+    out_ndim = len(out_shape)
+
+    # split reconciliation: both distributed along different output axes →
+    # reshard the second operand (comm!), mirroring the reference's warning
+    if s1 is not None and s2 is not None:
+        al1 = s1 + (out_ndim - len(sh1))
+        al2 = s2 + (out_ndim - len(sh2))
+        if al1 != al2:
+            warnings.warn(
+                "Binary operation with mismatched splits triggers a redistribution "
+                f"(split {s2} -> {al1 - (out_ndim - len(sh2))}); this is a communication-heavy operation."
+            )
+            a2 = a2.resplit(al1 - (out_ndim - len(sh2)))
+            s2 = a2.split
+
+    res_split = _result_split(
+        ((sh1, s1), (sh2, s2)),
+        out_ndim,
+    )
+
+    j1 = a1._jarray if isinstance(a1, DNDarray) else a1
+    j2 = a2._jarray if isinstance(a2, DNDarray) else a2
+    result = op(j1, j2, **fn_kwargs)
+    if res_split is not None and res_split >= result.ndim:
+        res_split = None
+    result = comm.shard(result, res_split)
+
+    if out is not None:
+        if where is not None:
+            w = where._jarray if isinstance(where, DNDarray) else jnp.asarray(where)
+            result = jnp.where(w, result, out._jarray)
+            result = comm.shard(result, res_split)
+        sanitation.sanitize_out(out, result.shape, res_split, device)
+        out._jarray = result.astype(out.dtype.jax_dtype())
+        return out
+    if where is not None:
+        w = where._jarray if isinstance(where, DNDarray) else jnp.asarray(where)
+        result = comm.shard(jnp.where(w, result, jnp.zeros_like(result)), res_split)
+    return DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        res_split,
+        device,
+        comm,
+        True,
+    )
+
+
+def _reduce_op(
+    op: Callable,
+    x: DNDarray,
+    axis: Union[int, Tuple[int, ...], None] = None,
+    keepdims: bool = False,
+    out: Optional[DNDarray] = None,
+    dtype=None,
+    **kwargs,
+) -> DNDarray:
+    """Reduction with split bookkeeping (reference __reduce_op).
+
+    Reducing over the split axis (or all axes) yields a replicated result —
+    the implicit ``Allreduce``; other axes keep the (shifted) split.
+    """
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = op(x._jarray, axis=axis, keepdims=keepdims, **kwargs)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
+
+    split = x.split
+    if split is None:
+        new_split = None
+    elif axis is None:
+        new_split = None
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        if split in axes:
+            new_split = None
+        elif keepdims:
+            new_split = split
+        else:
+            new_split = split - sum(1 for a in axes if a < split)
+    if new_split is not None and new_split >= result.ndim:
+        new_split = None
+    result = x.comm.shard(result, new_split)
+    if out is not None:
+        sanitation.sanitize_out(out, result.shape, new_split, x.device)
+        out._jarray = result.astype(out.dtype.jax_dtype())
+        return out
+    return DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        new_split,
+        x.device,
+        x.comm,
+        True,
+    )
+
+
+def _cum_op(
+    op: Callable,
+    x: DNDarray,
+    axis: int,
+    dtype=None,
+    out: Optional[DNDarray] = None,
+) -> DNDarray:
+    """Cumulative op along ``axis`` (reference __cum_op via Exscan; here XLA scan)."""
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if axis is None:
+        # numpy semantics: flatten
+        flat = x._jarray.reshape(-1)
+        result = op(flat, axis=0)
+        split = None
+    else:
+        result = op(x._jarray, axis=axis)
+        split = x.split
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
+    result = x.comm.shard(result, split)
+    if out is not None:
+        sanitation.sanitize_out(out, result.shape, split, x.device)
+        out._jarray = result.astype(out.dtype.jax_dtype())
+        return out
+    return DNDarray(
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        split,
+        x.device,
+        x.comm,
+        True,
+    )

@@ -1,0 +1,297 @@
+"""Arithmetic operations (reference: ``heat/core/arithmetics.py``).
+
+All ops route through the dispatch core; XLA fuses elementwise chains and
+inserts collectives where splits demand (SURVEY §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import _binary_op, _cum_op, _local_op, _reduce_op
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "copysign",
+    "cumprod",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "divmod",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "gcd",
+    "hypot",
+    "invert",
+    "lcm",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "nanprod",
+    "nansum",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise addition ``t1 + t2``."""
+    return _binary_op(jnp.add, t1, t2, out=out, where=where)
+
+
+def sub(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise subtraction ``t1 - t2``."""
+    return _binary_op(jnp.subtract, t1, t2, out=out, where=where)
+
+
+subtract = sub
+
+
+def mul(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise multiplication ``t1 * t2``."""
+    return _binary_op(jnp.multiply, t1, t2, out=out, where=where)
+
+
+multiply = mul
+
+
+def div(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise true division ``t1 / t2``."""
+    return _binary_op(jnp.true_divide, t1, t2, out=out, where=where)
+
+
+divide = div
+
+
+def floordiv(t1, t2) -> DNDarray:
+    """Elementwise floor division ``t1 // t2``."""
+    return _binary_op(jnp.floor_divide, t1, t2)
+
+
+floor_divide = floordiv
+
+
+def mod(t1, t2) -> DNDarray:
+    """Elementwise modulo (sign follows divisor, Python semantics)."""
+    return _binary_op(jnp.mod, t1, t2)
+
+
+remainder = mod
+
+
+def fmod(t1, t2) -> DNDarray:
+    """Elementwise C-style fmod (sign follows dividend)."""
+    return _binary_op(jnp.fmod, t1, t2)
+
+
+def divmod(t1, t2):
+    return (floordiv(t1, t2), mod(t1, t2))
+
+
+def pow(t1, t2) -> DNDarray:
+    """Elementwise power ``t1 ** t2``."""
+    return _binary_op(jnp.power, t1, t2)
+
+
+power = pow
+
+
+def copysign(t1, t2) -> DNDarray:
+    return _binary_op(jnp.copysign, t1, t2)
+
+
+def hypot(t1, t2) -> DNDarray:
+    return _binary_op(jnp.hypot, t1, t2)
+
+
+def gcd(t1, t2) -> DNDarray:
+    return _binary_op(jnp.gcd, t1, t2)
+
+
+def lcm(t1, t2) -> DNDarray:
+    return _binary_op(jnp.lcm, t1, t2)
+
+
+def neg(x, out=None) -> DNDarray:
+    """Elementwise negation."""
+    return _local_op(jnp.negative, x, out=out)
+
+
+negative = neg
+
+
+def pos(x, out=None) -> DNDarray:
+    return _local_op(jnp.positive, x, out=out)
+
+
+positive = pos
+
+
+def bitwise_and(t1, t2) -> DNDarray:
+    return _binary_op(jnp.bitwise_and, t1, t2)
+
+
+def bitwise_or(t1, t2) -> DNDarray:
+    return _binary_op(jnp.bitwise_or, t1, t2)
+
+
+def bitwise_xor(t1, t2) -> DNDarray:
+    return _binary_op(jnp.bitwise_xor, t1, t2)
+
+
+def invert(x, out=None) -> DNDarray:
+    """Elementwise bitwise NOT."""
+    if x.dtype is types.bool:
+        return _local_op(jnp.logical_not, x, out=out)
+    return _local_op(jnp.invert, x, out=out)
+
+
+bitwise_not = invert
+
+
+def left_shift(t1, t2) -> DNDarray:
+    return _binary_op(jnp.left_shift, t1, t2)
+
+
+def right_shift(t1, t2) -> DNDarray:
+    return _binary_op(jnp.right_shift, t1, t2)
+
+
+def cumsum(x, axis, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along ``axis`` (reference: Exscan; here one XLA scan)."""
+    return _cum_op(jnp.cumsum, x, axis, dtype=dtype, out=out)
+
+
+def cumprod(x, axis, dtype=None, out=None) -> DNDarray:
+    return _cum_op(jnp.cumprod, x, axis, dtype=dtype, out=out)
+
+
+cumproduct = cumprod
+
+
+def sum(x, axis=None, out=None, keepdims=False, dtype=None) -> DNDarray:
+    """Sum over ``axis``; reducing the split axis is an implicit Allreduce."""
+    return _reduce_op(jnp.sum, x, axis=axis, keepdims=keepdims, out=out, dtype=dtype)
+
+
+def prod(x, axis=None, out=None, keepdims=False, dtype=None) -> DNDarray:
+    return _reduce_op(jnp.prod, x, axis=axis, keepdims=keepdims, out=out, dtype=dtype)
+
+
+def nansum(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.nansum, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def nanprod(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.nanprod, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def diff(x, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
+    """n-th discrete difference along ``axis``."""
+    axis = sanitize_axis(x.shape, axis)
+    kw = {}
+    if prepend is not None:
+        kw["prepend"] = prepend._jarray if isinstance(prepend, DNDarray) else prepend
+    if append is not None:
+        kw["append"] = append._jarray if isinstance(append, DNDarray) else append
+    result = jnp.diff(x._jarray, n=n, axis=axis, **kw)
+    split = x.split
+    result = x.comm.shard(result, split)
+    return DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, x.device, x.comm, True
+    )
+
+
+# ---------------------------------------------------------------------- #
+# DNDarray operator wiring (the reference does this inline in dndarray.py)
+# ---------------------------------------------------------------------- #
+def _rbin(fn):
+    return lambda self, other: fn(other, self)
+
+
+DNDarray.__add__ = lambda self, other: add(self, other)
+DNDarray.__radd__ = lambda self, other: add(self, other)
+DNDarray.__sub__ = lambda self, other: sub(self, other)
+DNDarray.__rsub__ = _rbin(sub)
+DNDarray.__mul__ = lambda self, other: mul(self, other)
+DNDarray.__rmul__ = lambda self, other: mul(self, other)
+DNDarray.__truediv__ = lambda self, other: div(self, other)
+DNDarray.__rtruediv__ = _rbin(div)
+DNDarray.__floordiv__ = lambda self, other: floordiv(self, other)
+DNDarray.__rfloordiv__ = _rbin(floordiv)
+DNDarray.__mod__ = lambda self, other: mod(self, other)
+DNDarray.__rmod__ = _rbin(mod)
+DNDarray.__pow__ = lambda self, other: pow(self, other)
+DNDarray.__rpow__ = _rbin(pow)
+DNDarray.__divmod__ = lambda self, other: divmod(self, other)
+DNDarray.__neg__ = lambda self: neg(self)
+DNDarray.__pos__ = lambda self: pos(self)
+DNDarray.__and__ = lambda self, other: bitwise_and(self, other)
+DNDarray.__rand__ = _rbin(bitwise_and)
+DNDarray.__or__ = lambda self, other: bitwise_or(self, other)
+DNDarray.__ror__ = _rbin(bitwise_or)
+DNDarray.__xor__ = lambda self, other: bitwise_xor(self, other)
+DNDarray.__rxor__ = _rbin(bitwise_xor)
+DNDarray.__invert__ = lambda self: invert(self)
+DNDarray.__lshift__ = lambda self, other: left_shift(self, other)
+DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
+
+
+def _iop(fn):
+    def inner(self, other):
+        res = fn(self, other)
+        if tuple(res.shape) != tuple(self.shape):
+            raise ValueError(
+                f"output shape {res.shape} of in-place operation does not match "
+                f"the array shape {self.shape} (in-place broadcasting growth is not allowed)"
+            )
+        self._jarray = res._jarray.astype(self.dtype.jax_dtype())
+        return self
+
+    return inner
+
+
+DNDarray.__iadd__ = _iop(add)
+DNDarray.__isub__ = _iop(sub)
+DNDarray.__imul__ = _iop(mul)
+DNDarray.__itruediv__ = _iop(div)
+DNDarray.__ifloordiv__ = _iop(floordiv)
+DNDarray.__imod__ = _iop(mod)
+DNDarray.__ipow__ = _iop(pow)
+
+# method forms
+DNDarray.add = add
+DNDarray.sub = sub
+DNDarray.mul = mul
+DNDarray.div = div
+DNDarray.pow = pow
+DNDarray.sum = sum
+DNDarray.prod = prod
+DNDarray.cumsum = cumsum
+DNDarray.cumprod = cumprod
+DNDarray.nansum = nansum
+DNDarray.fmod = fmod
+DNDarray.mod = mod

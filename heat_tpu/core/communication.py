@@ -1,0 +1,347 @@
+"""Communication backend: XLA collectives over an ICI/DCN device mesh.
+
+This is the TPU-native re-design of the reference's
+``heat/core/communication.py::MPICommunication`` (SURVEY §2.1, §5.8).  The
+reference wraps ``mpi4py``: every rank owns a local torch tensor and ships
+bytes explicitly (derived datatypes, CUDA-aware fast paths, request objects).
+Here the roles invert — arrays are globally-shaped ``jax.Array``s sharded over
+a :class:`jax.sharding.Mesh`, and *implicit* collectives are emitted by XLA's
+SPMD partitioner whenever a computation needs them.  What remains for an
+explicit ``Communication`` object:
+
+- **shard math** (``chunk``, ``counts_displs_shape``) for I/O boundaries and
+  test oracles, matching JAX's ceil-division placement convention;
+- **sharding constructors** (``sharding(ndim, split)``) translating the
+  reference's ``split`` axis to a ``NamedSharding``;
+- **redistribution** (``resplit`` → ``jax.device_put`` with a new sharding,
+  lowered by XLA to all-to-all, cf. arXiv 2112.01075);
+- **functional collectives** (``psum``/``all_gather``/``all_to_all``/
+  ``ppermute``/…) for use inside ``shard_map`` — the building blocks of the
+  manual-control paths (ring cdist, halo convolve, TSQR, DASO);
+- process-level helpers for the multi-host control plane.
+
+MPI-name parity table (reference → here):
+``Allreduce→psum``, ``Allgather(v)→all_gather``, ``Alltoall(v)→all_to_all``,
+``Bcast→select-from-source ppermute``, ``Isend/Irecv→ppermute`` (XLA
+collectives are asynchronously dispatched, so every op is effectively the
+nonblocking variant; ``jax.block_until_ready`` is ``Wait``), ``Exscan→
+associative_scan over shards``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import devices
+
+__all__ = [
+    "Communication",
+    "sanitize_comm",
+    "get_comm",
+    "use_comm",
+    "world",
+]
+
+
+class Communication:
+    """A communicator: a device mesh axis over which arrays are sharded.
+
+    The analogue of the reference's ``MPICommunication``.  ``size`` is the
+    number of shards along the communicator's mesh axis (the reference's
+    ``comm.size``); ``rank`` is the *process* index, which on a single
+    controller addressing all chips is 0 — per-shard identity only exists
+    inside ``shard_map`` (use :meth:`axis_index`).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = "x"):
+        if mesh is None:
+            mesh = devices.get_default_mesh()
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.__mesh = mesh
+        self.__axis = axis
+
+    # ------------------------------------------------------------------ #
+    # identity / topology
+    # ------------------------------------------------------------------ #
+    @property
+    def mesh(self) -> Mesh:
+        return self.__mesh
+
+    @property
+    def axis(self) -> str:
+        return self.__axis
+
+    @property
+    def size(self) -> int:
+        """Number of shards along this communicator's axis (= reference nprocs)."""
+        return self.__mesh.shape[self.__axis]
+
+    @property
+    def rank(self) -> int:
+        """Process index (single-controller: 0). Shard identity: :meth:`axis_index`."""
+        return jax.process_index()
+
+    @property
+    def n_processes(self) -> int:
+        return jax.process_count()
+
+    def is_distributed(self) -> bool:
+        return self.size > 1
+
+    def axis_index(self):
+        """Shard index along this communicator's axis — ONLY inside shard_map."""
+        return lax.axis_index(self.__axis)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Communication):
+            return NotImplemented
+        return self.__mesh == other.mesh and self.__axis == other.axis
+
+    def __hash__(self) -> int:
+        return hash((self.__mesh, self.__axis))
+
+    def __repr__(self) -> str:
+        return f"Communication(size={self.size}, axis={self.__axis!r}, mesh={tuple(self.__mesh.shape.items())})"
+
+    # ------------------------------------------------------------------ #
+    # shard math — matches JAX's ceil-division placement so that
+    # `chunk()` predictions agree with jax.Array.addressable_shards.
+    # (Deviation from the reference, which gives the first gshape%size
+    # ranks one extra row; documented in SURVEY §7 "Hard parts" #1.)
+    # ------------------------------------------------------------------ #
+    def chunk(
+        self, shape, split: Optional[int], rank: Optional[int] = None
+    ) -> Tuple[int, Tuple[int, ...], Tuple[slice, ...]]:
+        """Offset, local shape and slices of shard ``rank`` of a global ``shape``.
+
+        cf. reference ``MPICommunication.chunk`` — pure shard math, no comm.
+        """
+        shape = tuple(int(s) for s in shape)
+        if split is None:
+            return 0, shape, tuple(slice(0, s) for s in shape)
+        split = split % len(shape)
+        if rank is None:
+            rank = 0
+        n, p = shape[split], self.size
+        c = -(-n // p)  # ceil division, JAX/GSPMD convention
+        start = min(rank * c, n)
+        end = min(start + c, n)
+        lshape = shape[:split] + (end - start,) + shape[split + 1 :]
+        slices = tuple(
+            slice(start, end) if i == split else slice(0, s) for i, s in enumerate(shape)
+        )
+        return start, lshape, slices
+
+    def counts_displs_shape(self, shape, split: int):
+        """Per-shard counts and displacements along ``split`` (I/O hyperslabs)."""
+        counts, displs = [], []
+        for r in range(self.size):
+            off, lsh, _ = self.chunk(shape, split, r)
+            counts.append(lsh[split])
+            displs.append(off)
+        return tuple(counts), tuple(displs)
+
+    def lshape_map(self, shape, split: Optional[int]) -> np.ndarray:
+        """(size, ndim) array of every shard's local shape (reference: DNDarray.lshape_map)."""
+        out = np.empty((self.size, len(shape)), dtype=np.int64)
+        for r in range(self.size):
+            _, lsh, _ = self.chunk(shape, split, r)
+            out[r] = lsh
+        return out
+
+    # ------------------------------------------------------------------ #
+    # shardings
+    # ------------------------------------------------------------------ #
+    def spec(self, ndim: int, split: Optional[int]) -> PartitionSpec:
+        if split is None:
+            return PartitionSpec()
+        split = split % ndim if ndim else 0
+        return PartitionSpec(*(self.__axis if i == split else None for i in range(ndim)))
+
+    def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
+        """The ``NamedSharding`` realizing ``split`` over this communicator."""
+        return NamedSharding(self.__mesh, self.spec(ndim, split))
+
+    def shard(self, array: jax.Array, split: Optional[int]) -> jax.Array:
+        """Place/constrain ``array`` to the sharding of ``split``.
+
+        Eager: ``device_put`` (no-op if already so sharded).  Traced (inside
+        jit): ``with_sharding_constraint``.
+
+        JAX requires the sharded dimension to be divisible by the mesh axis
+        size; for ragged shapes the physical placement is left to XLA's
+        computation-follows-data propagation and ``split`` remains *logical*
+        metadata (SURVEY §7, hard part #1 — padding-free best-effort design).
+        """
+        if split is not None:
+            split = split % array.ndim if array.ndim else None
+        if split is not None and (
+            array.ndim == 0 or array.shape[split] % self.size != 0
+        ):
+            return array  # ragged: keep XLA's placement, split stays logical
+        sh = self.sharding(array.ndim, split)
+        if isinstance(array, jax.core.Tracer):
+            return lax.with_sharding_constraint(array, sh)
+        if getattr(array, "sharding", None) == sh:
+            return array
+        return jax.device_put(array, sh)
+
+    def split_of(self, array: jax.Array) -> Optional[int]:
+        """Infer the split axis from a concrete array's sharding (None if replicated)."""
+        sh = getattr(array, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            return None
+        for i, p in enumerate(sh.spec):
+            names = p if isinstance(p, tuple) else (p,)
+            if self.__axis in [n for n in names if n]:
+                return i
+        return None
+
+    # ------------------------------------------------------------------ #
+    # redistribution — the reference's Alltoallv-based resplit_
+    # ------------------------------------------------------------------ #
+    def resplit(self, array: jax.Array, split: Optional[int]) -> jax.Array:
+        """Redistribute a global array to a new split axis.
+
+        XLA lowers the sharding change to an all-to-all over ICI (the
+        memory-efficient reshard of arXiv 2112.01075); the reference does the
+        same thing by hand with derived datatypes + ``Alltoallv``
+        (``DNDarray.resplit_``, SURVEY §3.3).
+        """
+        return self.shard(array, split)
+
+    # ------------------------------------------------------------------ #
+    # functional collectives — valid ONLY inside shard_map over this mesh.
+    # These carry the MPI names for discoverability by reference users.
+    # ------------------------------------------------------------------ #
+    def Allreduce(self, x, op: str = "sum"):
+        ops = {
+            "sum": lax.psum,
+            "max": lax.pmax,
+            "min": lax.pmin,
+            "mean": lax.pmean,
+        }
+        if op in ("prod", "land", "lor"):
+            if op == "prod":
+                # sign-safe product: all_gather then reduce (log-sum only
+                # works for strictly positive inputs)
+                return jnp.prod(
+                    lax.all_gather(x, self.__axis, axis=0, tiled=False), axis=0
+                )
+            if op == "land":
+                return lax.pmin(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)
+            return lax.pmax(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)
+        return ops[op](x, self.__axis)
+
+    def Allgather(self, x, axis: int = 0, tiled: bool = True):
+        return lax.all_gather(x, self.__axis, axis=axis, tiled=tiled)
+
+    def Alltoall(self, x, split_axis: int, concat_axis: int):
+        return lax.all_to_all(
+            x, self.__axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+        )
+
+    def Bcast(self, x, root: int = 0):
+        """Every shard receives shard ``root``'s block."""
+        full = lax.all_gather(x, self.__axis, axis=0, tiled=False)
+        return full[root]
+
+    def Send(self, x, shift: int = 1):
+        """Ring shift by ``shift`` (reference Isend/Irecv neighbor exchange)."""
+        n = self.size
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, self.__axis, perm)
+
+    def ReduceScatter(self, x, axis: int = 0):
+        return lax.psum_scatter(x, self.__axis, scatter_dimension=axis, tiled=True)
+
+    def Exscan(self, x):
+        """Exclusive prefix sum across shards (reference ``comm.Exscan``)."""
+        idx = lax.axis_index(self.__axis)
+        gathered = lax.all_gather(x, self.__axis, axis=0, tiled=False)
+        n = self.size
+        mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * x.ndim)
+        return jnp.sum(gathered * mask.astype(gathered.dtype), axis=0)
+
+    def Scan(self, x):
+        return self.Exscan(x) + x
+
+    # convenience: run fn under shard_map over this communicator
+    def shard_map(self, fn, in_splits, out_splits, check_vma: bool = False):
+        """Wrap ``fn`` in a ``shard_map`` where each argument is split per ``in_splits``.
+
+        ``in_splits``/``out_splits`` are pytrees of ``split`` values (ints or
+        None) which are translated to PartitionSpecs over this communicator's
+        axis.  The per-shard function sees local blocks and may call the
+        collective methods above.
+        """
+        def is_leaf(s):
+            return (
+                isinstance(s, PartitionSpec)
+                or (isinstance(s, tuple) and len(s) == 2 and isinstance(s[0], int))
+            )
+
+        def to_spec(s):
+            if isinstance(s, PartitionSpec):
+                return s
+            return self.spec(s[0], s[1])
+
+        in_specs = jax.tree.map(to_spec, in_splits, is_leaf=is_leaf)
+        out_specs = jax.tree.map(to_spec, out_splits, is_leaf=is_leaf)
+        return jax.shard_map(
+            fn, mesh=self.__mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+
+
+# ---------------------------------------------------------------------- #
+# world communicator bootstrap
+# ---------------------------------------------------------------------- #
+_world_cache = {}
+
+
+def world() -> Communication:
+    """The default communicator over the default device's mesh (= ``MPI_WORLD``)."""
+    dev = devices.get_device()
+    comm = _world_cache.get(dev.device_type)
+    if comm is None or comm.mesh is not dev.mesh:
+        mesh = dev.mesh
+        axis = mesh.axis_names[-1] if "x" not in mesh.axis_names else "x"
+        comm = Communication(mesh, axis)
+        _world_cache[dev.device_type] = comm
+    return comm
+
+
+_default_comm: Optional[Communication] = None
+
+
+def _invalidate_default(device=None) -> None:
+    global _default_comm
+    _default_comm = None
+    _world_cache.clear()
+
+
+def get_comm() -> Communication:
+    return _default_comm if _default_comm is not None else world()
+
+
+def use_comm(comm: Optional[Communication] = None) -> None:
+    global _default_comm
+    if comm is not None and not isinstance(comm, Communication):
+        raise TypeError(f"Expected Communication, got {type(comm)}")
+    _default_comm = comm
+
+
+def sanitize_comm(comm: Optional[Communication]) -> Communication:
+    if comm is None:
+        return get_comm()
+    if isinstance(comm, Communication):
+        return comm
+    raise TypeError(f"Expected Communication or None, got {type(comm)}")

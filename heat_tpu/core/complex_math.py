@@ -1,0 +1,34 @@
+"""Complex number operations (reference: ``heat/core/complex_math.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import _local_op
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x, deg: bool = False, out=None) -> DNDarray:
+    """Phase angle of complex elements (radians, or degrees if ``deg``)."""
+    return _local_op(lambda a: jnp.angle(a, deg=deg), x, out=out)
+
+
+def conjugate(x, out=None) -> DNDarray:
+    """Elementwise complex conjugate."""
+    return _local_op(jnp.conjugate, x, out=out)
+
+
+conj = conjugate
+
+
+def imag(x, out=None) -> DNDarray:
+    return _local_op(jnp.imag, x, out=out)
+
+
+def real(x, out=None) -> DNDarray:
+    return _local_op(jnp.real, x, out=out)
+
+
+DNDarray.conj = conjugate

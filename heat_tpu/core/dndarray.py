@@ -1,0 +1,507 @@
+"""DNDarray — the distributed N-D array, TPU-native.
+
+Re-design of the reference's ``heat/core/dndarray.py`` (SURVEY §2.1).  The
+reference's DNDarray is *locally a torch.Tensor, globally a chunked array*;
+each MPI rank stores its chunk and all global bookkeeping (gshape, lshape_map,
+index translation) is hand-maintained Python.  Here a DNDarray wraps ONE
+globally-shaped :class:`jax.Array` whose ``NamedSharding`` over the
+communicator's mesh realizes the ``split`` axis:
+
+- ``split=None``  ⇔  fully replicated (``PartitionSpec()``)
+- ``split=k``     ⇔  axis ``k`` sharded over the mesh axis
+  (``PartitionSpec(..., 'x', ...)``)
+
+All inter-chip data movement is emitted by XLA when ops require it; the
+explicit ``resplit_`` maps to a resharding ``device_put`` (→ all-to-all).
+
+DNDarray is registered as a JAX pytree (the array is the leaf; split/device/
+comm are static aux data), so user functions over DNDarrays can be ``jax.jit``
+-ed, differentiated, and vmapped — something the reference fundamentally
+cannot offer.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .communication import Communication
+from .devices import Device
+from .stride_tricks import sanitize_axis
+
+__all__ = ["DNDarray"]
+
+Scalar = Union[int, float, bool, complex]
+
+
+class LocalIndex:
+    """Marker for local-index assignment, parity with reference ``x.lloc``."""
+
+    def __init__(self, arr: "DNDarray"):
+        self.arr = arr
+
+    def __getitem__(self, key):
+        return self.arr.larray[key]
+
+    def __setitem__(self, key, value):
+        # local == global view on a single controller; route through global set
+        self.arr[key] = value
+
+
+class DNDarray:
+    """A globally-shaped, mesh-sharded N-D array with a NumPy-style API."""
+
+    def __init__(
+        self,
+        array: jax.Array,
+        gshape: Tuple[int, ...],
+        dtype,
+        split: Optional[int],
+        device: Device,
+        comm: Communication,
+        balanced: Optional[bool] = True,
+    ):
+        self.__array = array
+        self.__gshape = tuple(int(s) for s in gshape)
+        self.__dtype = types.canonical_heat_type(dtype)
+        self.__split = split
+        self.__device = device
+        self.__comm = comm
+        self.__balanced = balanced
+
+    # ------------------------------------------------------------------ #
+    # internal access
+    # ------------------------------------------------------------------ #
+    @property
+    def _jarray(self) -> jax.Array:
+        """The underlying global jax.Array (framework-internal)."""
+        return self.__array
+
+    @_jarray.setter
+    def _jarray(self, arr) -> None:
+        self.__array = arr
+
+    # ------------------------------------------------------------------ #
+    # reference-parity attributes
+    # ------------------------------------------------------------------ #
+    @property
+    def larray(self) -> jax.Array:
+        """The process-local data.
+
+        Single-controller JAX addresses all chips, so the 'local' view is the
+        global array itself.  (Reference users index shards via
+        ``lshape_map``/``chunk``.)
+        """
+        return self.__array
+
+    @larray.setter
+    def larray(self, array: jax.Array) -> None:
+        self.__array = array
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.__gshape
+
+    @property
+    def gshape(self) -> Tuple[int, ...]:
+        return self.__gshape
+
+    @property
+    def lshape(self) -> Tuple[int, ...]:
+        """Shape of this process's first shard (reference: this rank's chunk)."""
+        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
+        return lshape
+
+    def lshape_map(self, force_check: bool = False) -> np.ndarray:
+        """(size, ndim) matrix of all shard shapes — pure math, no comm needed."""
+        return self.__comm.lshape_map(self.__gshape, self.__split)
+
+    @property
+    def dtype(self):
+        return self.__dtype
+
+    @property
+    def split(self) -> Optional[int]:
+        return self.__split
+
+    @property
+    def device(self) -> Device:
+        return self.__device
+
+    @property
+    def comm(self) -> Communication:
+        return self.__comm
+
+    @property
+    def balanced(self) -> bool:
+        return bool(self.__balanced)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.__gshape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.__gshape, dtype=np.int64)) if self.__gshape else 1
+
+    @property
+    def gnumel(self) -> int:
+        return self.size
+
+    @property
+    def lnumel(self) -> int:
+        return int(np.prod(self.lshape, dtype=np.int64)) if self.lshape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.__dtype.np_dtype().itemsize
+
+    @property
+    def gnbytes(self) -> int:
+        return self.nbytes
+
+    @property
+    def lnbytes(self) -> int:
+        return self.lnumel * self.__dtype.np_dtype().itemsize
+
+    @property
+    def imag(self) -> "DNDarray":
+        from . import complex_math
+
+        return complex_math.imag(self)
+
+    @property
+    def real(self) -> "DNDarray":
+        from . import complex_math
+
+        return complex_math.real(self)
+
+    @property
+    def T(self) -> "DNDarray":
+        from ..linalg import basics
+
+        return basics.transpose(self)
+
+    @property
+    def lloc(self) -> LocalIndex:
+        return LocalIndex(self)
+
+    @property
+    def stride(self) -> Tuple[int, ...]:
+        """Row-major strides in elements (XLA owns the physical layout)."""
+        strides = np.cumprod((1,) + self.__gshape[:0:-1])[::-1]
+        return tuple(int(s) for s in strides)
+
+    @property
+    def strides(self) -> Tuple[int, ...]:
+        return tuple(s * self.__dtype.np_dtype().itemsize for s in self.stride)
+
+    @property
+    def __partitioned__(self) -> dict:
+        """Cross-framework partitioned-array protocol (reference parity)."""
+        comm = self.__comm
+        parts = {}
+        for r in range(comm.size if self.__split is not None else 1):
+            off, lsh, _ = comm.chunk(self.__gshape, self.__split, r)
+            pos = (r,)
+            start = tuple(
+                off if i == self.__split else 0 for i in range(self.ndim)
+            ) if self.__split is not None else (0,) * self.ndim
+            parts[pos] = {
+                "start": start,
+                "shape": lsh,
+                "data": None,
+                "location": [r],
+                "dtype": self.__dtype.np_dtype(),
+            }
+        return {
+            "shape": self.__gshape,
+            "partition_tiling": (comm.size,) if self.__split is not None else (1,),
+            "partitions": parts,
+            "locals": [(comm.rank,)],
+            "get": lambda x: x,
+        }
+
+    # ------------------------------------------------------------------ #
+    # basic conversions
+    # ------------------------------------------------------------------ #
+    def astype(self, dtype, copy: bool = True) -> "DNDarray":
+        dtype = types.canonical_heat_type(dtype)
+        casted = self.__array.astype(dtype.jax_dtype())
+        # honor JAX canonicalization (64→32-bit when x64 is off) in metadata
+        dtype = types.canonical_heat_type(casted.dtype)
+        if copy:
+            return DNDarray(
+                casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, self.__balanced
+            )
+        self.__array = casted
+        self.__dtype = dtype
+        return self
+
+    def numpy(self) -> np.ndarray:
+        """Gather the global array to host memory as a numpy array."""
+        return np.asarray(jax.device_get(self.__array))
+
+    def __array__(self, dtype=None) -> np.ndarray:
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def tolist(self, keepsplit: bool = False) -> List:
+        return self.numpy().tolist()
+
+    def item(self):
+        if self.size != 1:
+            raise ValueError("only one-element DNDarrays can be converted to scalars")
+        return self.__array.reshape(()).item()
+
+    def __bool__(self) -> bool:
+        return bool(self.item())
+
+    def __int__(self) -> int:
+        return int(self.item())
+
+    def __float__(self) -> float:
+        return float(self.item())
+
+    def __complex__(self) -> complex:
+        return complex(self.item())
+
+    def __index__(self) -> int:
+        if not types.heat_type_is_exact(self.__dtype):
+            raise TypeError("only integer scalar arrays can be used as an index")
+        return int(self.item())
+
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.__gshape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------------ #
+    # device / distribution management
+    # ------------------------------------------------------------------ #
+    def is_distributed(self) -> bool:
+        return self.__split is not None and self.__comm.is_distributed()
+
+    def is_balanced(self, force_check: bool = False) -> bool:
+        return True  # ceil-div sharding is the only layout; always balanced
+
+    def balance_(self) -> None:
+        self.__balanced = True
+
+    def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
+        """In-place redistribution to a new split axis (reference SURVEY §3.3).
+
+        Lowered by XLA to an all-to-all (split↔split) or allgather (→None).
+        """
+        axis = sanitize_axis(self.__gshape, axis)
+        if axis == self.__split:
+            return self
+        self.__array = self.__comm.resplit(self.__array, axis)
+        self.__split = axis
+        self.__balanced = True
+        return self
+
+    def redistribute_(self, lshape_map=None, target_map=None) -> None:
+        """Reference parity: arbitrary re-chunking.
+
+        The ceil-div grid is the only physical layout under NamedSharding, so
+        redistribution to arbitrary chunk maps is a no-op on the contents; the
+        request is honored by rebalancing.
+        """
+        self.balance_()
+
+    def resplit(self, axis: Optional[int] = None) -> "DNDarray":
+        from . import manipulations
+
+        return manipulations.resplit(self, axis)
+
+    def cpu(self) -> "DNDarray":
+        from . import devices as _dev
+
+        return self.to_device(_dev.cpu)
+
+    def to_device(self, device) -> "DNDarray":
+        from . import devices as _dev
+        from .communication import Communication
+
+        device = _dev.sanitize_device(device)
+        if device == self.__device:
+            return self
+        comm = Communication(device.mesh)
+        arr = jax.device_put(self.numpy(), comm.sharding(self.ndim, self.__split))
+        return DNDarray(arr, self.__gshape, self.__dtype, self.__split, device, comm, True)
+
+    # ------------------------------------------------------------------ #
+    # halo support (reference: get_halo / array_with_halos, used by convolve)
+    # ------------------------------------------------------------------ #
+    def get_halo(self, halo_size: int, prev: bool = True, next: bool = True) -> None:
+        """Record the requested halo width; materialization happens inside the
+        shard_map of the consuming op (see ``parallel.halo.halo_exchange``)."""
+        if not isinstance(halo_size, int) or halo_size < 0:
+            raise (TypeError if not isinstance(halo_size, int) else ValueError)(
+                f"halo_size needs to be a non-negative int, got {halo_size}"
+            )
+        self.__halo_size = halo_size
+
+    @property
+    def array_with_halos(self) -> jax.Array:
+        from ..parallel.halo import with_halos
+
+        hs = getattr(self, "_DNDarray__halo_size", 0)
+        if self.__split is None or hs == 0:
+            return self.__array
+        return with_halos(self.__array, hs, self.__split, self.__comm)
+
+    # ------------------------------------------------------------------ #
+    # indexing
+    # ------------------------------------------------------------------ #
+    def _normalized_key(self, key):
+        def conv(k):
+            if isinstance(k, DNDarray):
+                return k._jarray
+            return k
+
+        if isinstance(key, tuple):
+            return tuple(conv(k) for k in key)
+        return conv(key)
+
+    def _result_split_of_key(self, key) -> Optional[int]:
+        """Compute the split axis of an indexing result (None ⇒ replicated)."""
+        if self.__split is None:
+            return None
+        key_t = key if isinstance(key, tuple) else (key,)
+        # expand Ellipsis
+        if any(k is Ellipsis for k in key_t):
+            n_specified = sum(1 for k in key_t if k is not None and k is not Ellipsis)
+            fill = self.ndim - n_specified
+            out = []
+            for k in key_t:
+                if k is Ellipsis:
+                    out.extend([slice(None)] * fill)
+                else:
+                    out.append(k)
+            key_t = tuple(out)
+        # walk input axes vs output axes
+        in_ax = 0
+        out_ax = 0
+        has_advanced = any(
+            isinstance(k, (list, np.ndarray, jax.Array)) and not isinstance(k, (bool, np.bool_))
+            for k in key_t
+        )
+        for k in key_t:
+            if k is None:
+                out_ax += 1
+                continue
+            if in_ax == self.__split:
+                if isinstance(k, slice):
+                    return out_ax
+                if isinstance(k, (int, np.integer)):
+                    return None
+                # advanced index on the split axis
+                if has_advanced and not isinstance(k, (bool, np.bool_)):
+                    # 1-D fancy index keeps a distributed result axis
+                    return 0 if not isinstance(k, slice) else out_ax
+                return None
+            if isinstance(k, (int, np.integer)):
+                in_ax += 1  # consumes an axis, produces none
+            elif isinstance(k, slice):
+                in_ax += 1
+                out_ax += 1
+            else:
+                # advanced index consumes (possibly several for bool) axes
+                if isinstance(k, (np.ndarray, jax.Array)) and k.dtype == np.bool_:
+                    in_ax += k.ndim
+                else:
+                    in_ax += 1
+                out_ax += 1
+        # remaining untouched axes
+        if in_ax <= self.__split:
+            return out_ax + (self.__split - in_ax)
+        return None
+
+    def __getitem__(self, key) -> "DNDarray":
+        nkey = self._normalized_key(key)
+        result = self.__array[nkey]
+        new_split = self._result_split_of_key(nkey)
+        if new_split is not None and new_split >= result.ndim:
+            new_split = None
+        result = self.__comm.shard(result, new_split)
+        return DNDarray(
+            result,
+            tuple(result.shape),
+            types.canonical_heat_type(result.dtype),
+            new_split,
+            self.__device,
+            self.__comm,
+            True,
+        )
+
+    def __setitem__(self, key, value) -> None:
+        nkey = self._normalized_key(key)
+        if isinstance(value, DNDarray):
+            value = value._jarray
+        updated = self.__array.at[nkey].set(value)
+        self.__array = self.__comm.shard(updated, self.__split)
+
+    def fill_diagonal(self, value) -> "DNDarray":
+        n = min(self.__gshape[-2], self.__gshape[-1]) if self.ndim >= 2 else 0
+        idx = jnp.arange(n)
+        updated = self.__array.at[..., idx, idx].set(value)
+        self.__array = self.__comm.shard(updated, self.__split)
+        return self
+
+    # ------------------------------------------------------------------ #
+    # printing
+    # ------------------------------------------------------------------ #
+    def __repr__(self) -> str:
+        from . import printing
+
+        return printing.__repr__(self)
+
+    def __str__(self) -> str:
+        from . import printing
+
+        return printing.__str__(self)
+
+    # ------------------------------------------------------------------ #
+    # interop stubs
+    # ------------------------------------------------------------------ #
+    def __torch_proxy__(self):
+        import torch
+
+        return torch.from_numpy(np.asarray(self.numpy()))
+
+    def counts_displs(self):
+        if self.__split is None:
+            raise ValueError("Non-distributed DNDarray has no counts and displacements")
+        return self.__comm.counts_displs_shape(self.__gshape, self.__split)
+
+
+# ---------------------------------------------------------------------- #
+# pytree registration: DNDarray-valued functions are jit/grad/vmap-able
+# ---------------------------------------------------------------------- #
+def _dnd_flatten(x: DNDarray):
+    return (x._jarray,), (x.split, x.device, x.comm)
+
+
+def _dnd_unflatten(aux, children):
+    (arr,) = children
+    split, device, comm = aux
+    shape = tuple(arr.shape) if hasattr(arr, "shape") else ()
+    try:
+        dtype = types.canonical_heat_type(arr.dtype)
+    except (TypeError, AttributeError):
+        dtype = types.float32
+    return DNDarray(arr, shape, dtype, split, device, comm, True)
+
+
+jax.tree_util.register_pytree_node(DNDarray, _dnd_flatten, _dnd_unflatten)

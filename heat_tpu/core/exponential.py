@@ -1,0 +1,76 @@
+"""Exponential/logarithmic operations (reference: ``heat/core/exponential.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import _binary_op, _local_op
+from .dndarray import DNDarray
+
+__all__ = ["exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "logaddexp", "logaddexp2", "sqrt", "square", "cbrt", "rsqrt"]
+
+
+def exp(x, out=None) -> DNDarray:
+    return _local_op(jnp.exp, x, out=out)
+
+
+def expm1(x, out=None) -> DNDarray:
+    return _local_op(jnp.expm1, x, out=out)
+
+
+def exp2(x, out=None) -> DNDarray:
+    return _local_op(jnp.exp2, x, out=out)
+
+
+def log(x, out=None) -> DNDarray:
+    return _local_op(jnp.log, x, out=out)
+
+
+def log2(x, out=None) -> DNDarray:
+    return _local_op(jnp.log2, x, out=out)
+
+
+def log10(x, out=None) -> DNDarray:
+    return _local_op(jnp.log10, x, out=out)
+
+
+def log1p(x, out=None) -> DNDarray:
+    return _local_op(jnp.log1p, x, out=out)
+
+
+def logaddexp(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logaddexp, t1, t2)
+
+
+def logaddexp2(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logaddexp2, t1, t2)
+
+
+def sqrt(x, out=None) -> DNDarray:
+    return _local_op(jnp.sqrt, x, out=out)
+
+
+def rsqrt(x, out=None) -> DNDarray:
+    """1/sqrt(x) — fused on TPU (lax.rsqrt)."""
+    import jax
+
+    return _local_op(jax.lax.rsqrt, x, out=out)
+
+
+def square(x, out=None) -> DNDarray:
+    return _local_op(jnp.square, x, out=out)
+
+
+def cbrt(x, out=None) -> DNDarray:
+    return _local_op(jnp.cbrt, x, out=out)
+
+
+DNDarray.exp = exp
+DNDarray.log = log
+DNDarray.sqrt = sqrt
+DNDarray.square = square
+DNDarray.exp2 = exp2
+DNDarray.log1p = log1p
+DNDarray.log2 = log2
+DNDarray.log10 = log10
+DNDarray.expm1 = expm1

@@ -1,0 +1,60 @@
+"""Indexing ops (reference: ``heat/core/indexing.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from .dndarray import DNDarray
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Global indices of non-zero elements, shape (nnz, ndim).
+
+    The reference Allgathers rank-local indices + offsets; here the global
+    array yields global indices directly.  Eager-only (data-dependent shape).
+    """
+    idx = jnp.nonzero(x._jarray)
+    stacked = jnp.stack(idx, axis=1) if x.ndim > 1 else idx[0]
+    out_split = 0 if x.split is not None else None
+    stacked = x.comm.shard(stacked, out_split)
+    return DNDarray(
+        stacked,
+        tuple(stacked.shape),
+        types.canonical_heat_type(stacked.dtype),
+        out_split,
+        x.device,
+        x.comm,
+        True,
+    )
+
+
+def where(cond, x=None, y=None) -> DNDarray:
+    """Ternary select; with one argument, alias of :func:`nonzero`."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    from ._operations import _binary_op
+
+    jx = x._jarray if isinstance(x, DNDarray) else x
+    jy = y._jarray if isinstance(y, DNDarray) else y
+    proto = cond if isinstance(cond, DNDarray) else (x if isinstance(x, DNDarray) else y)
+    jc = cond._jarray if isinstance(cond, DNDarray) else jnp.asarray(cond)
+    res = jnp.where(jc, jx, jy)
+    split = None
+    for a in (cond, x, y):
+        if isinstance(a, DNDarray) and a.split is not None:
+            split = a.split + (res.ndim - a.ndim)
+            break
+    if split is not None and split >= res.ndim:
+        split = None
+    res = proto.comm.shard(res, split)
+    return DNDarray(
+        res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, proto.device, proto.comm, True
+    )
+
+
+DNDarray.nonzero = nonzero

@@ -1,0 +1,97 @@
+"""Logical operations (reference: ``heat/core/logical.py``).
+
+``all``/``any`` over the split axis are implicit Allreduce(LAND/LOR).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import _binary_op, _local_op, _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """True where all elements along axis are truthy (Allreduce-LAND over split)."""
+    return _reduce_op(jnp.all, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def any(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.any, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Scalar closeness check (reference: local allclose + Allreduce)."""
+    res = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return bool(all(res).item())
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False) -> DNDarray:
+    return _binary_op(
+        jnp.isclose, x, y, fn_kwargs=dict(rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def isfinite(x) -> DNDarray:
+    return _local_op(jnp.isfinite, x)
+
+
+def isinf(x) -> DNDarray:
+    return _local_op(jnp.isinf, x)
+
+
+def isnan(x) -> DNDarray:
+    return _local_op(jnp.isnan, x)
+
+
+def isneginf(x, out=None) -> DNDarray:
+    return _local_op(jnp.isneginf, x, out=out)
+
+
+def isposinf(x, out=None) -> DNDarray:
+    return _local_op(jnp.isposinf, x, out=out)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_not(x, out=None) -> DNDarray:
+    return _local_op(jnp.logical_not, x, out=out)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x, out=None) -> DNDarray:
+    return _local_op(jnp.signbit, x, out=out)
+
+
+DNDarray.all = all
+DNDarray.any = any
+DNDarray.allclose = allclose
+DNDarray.isclose = isclose

@@ -1,0 +1,431 @@
+"""Shape/layout manipulations (reference: ``heat/core/manipulations.py``).
+
+The reference implements reshape/sort/unique with hand-built Alltoallv and
+sample-sort machinery; here they are global jnp ops whose communication XLA
+derives from the shardings (SURVEY §2.2 table).  Split bookkeeping follows
+the reference's conventions.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import factories, types
+from ._operations import _local_op
+from .dndarray import DNDarray
+from .sanitation import sanitize_in
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "balance",
+    "broadcast_arrays",
+    "broadcast_to",
+    "collect",
+    "column_stack",
+    "concatenate",
+    "diag",
+    "diagonal",
+    "dsplit",
+    "expand_dims",
+    "flatten",
+    "flip",
+    "fliplr",
+    "flipud",
+    "hsplit",
+    "hstack",
+    "moveaxis",
+    "pad",
+    "ravel",
+    "redistribute",
+    "repeat",
+    "reshape",
+    "resplit",
+    "roll",
+    "rot90",
+    "row_stack",
+    "shuffle",
+    "sort",
+    "split",
+    "squeeze",
+    "stack",
+    "swapaxes",
+    "tile",
+    "topk",
+    "unique",
+    "vsplit",
+    "vstack",
+]
+
+
+def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
+    if split is not None and (jarr.ndim == 0 or split >= jarr.ndim):
+        split = None
+    jarr = proto.comm.shard(jarr, split)
+    return DNDarray(
+        jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
+    )
+
+
+def balance(x: DNDarray, copy: bool = False) -> DNDarray:
+    """Already balanced under the ceil-div grid; returns (a copy of) x."""
+    from .memory import copy as _copy
+
+    return _copy(x) if copy else x
+
+
+def broadcast_arrays(*arrays) -> List[DNDarray]:
+    """Broadcast arrays against each other (replicating results' new dims)."""
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    outs = jnp.broadcast_arrays(*js)
+    res = []
+    for a, o in zip(arrays, outs):
+        if isinstance(a, DNDarray):
+            new_split = a.split + (o.ndim - a.ndim) if a.split is not None else None
+            res.append(_wrap(o, new_split, a))
+        else:
+            proto = next(x for x in arrays if isinstance(x, DNDarray))
+            res.append(_wrap(o, None, proto))
+    return res
+
+
+def broadcast_to(x: DNDarray, shape) -> DNDarray:
+    shape = sanitize_shape(shape)
+    res = jnp.broadcast_to(x._jarray, shape)
+    new_split = x.split + (len(shape) - x.ndim) if x.split is not None else None
+    return _wrap(res, new_split, x)
+
+
+def collect(x: DNDarray, target_rank: int = 0) -> DNDarray:
+    """Reference: gather whole array onto one rank ⇒ here: replicate (split=None)."""
+    return resplit(x, None)
+
+
+def concatenate(arrays, axis: int = 0) -> DNDarray:
+    """Join arrays along an existing axis; split of the first operand wins."""
+    arrays = list(arrays)
+    proto = next(a for a in arrays if isinstance(a, DNDarray))
+    axis = sanitize_axis(proto.shape, axis)
+    splits = [a.split for a in arrays if isinstance(a, DNDarray)]
+    out_split = next((s for s in splits if s is not None), None)
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    res = jnp.concatenate(js, axis=axis)
+    return _wrap(res, out_split, proto)
+
+
+def column_stack(arrays) -> DNDarray:
+    proto = next(a for a in arrays if isinstance(a, DNDarray))
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    res = jnp.column_stack(js)
+    splits = [a.split for a in arrays if isinstance(a, DNDarray)]
+    out_split = next((s for s in splits if s is not None), None)
+    return _wrap(res, out_split, proto)
+
+
+def row_stack(arrays) -> DNDarray:
+    return vstack(arrays)
+
+
+def hstack(arrays) -> DNDarray:
+    proto = next(a for a in arrays if isinstance(a, DNDarray))
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    res = jnp.hstack(js)
+    splits = [a.split for a in arrays if isinstance(a, DNDarray)]
+    out_split = next((s for s in splits if s is not None), None)
+    return _wrap(res, out_split, proto)
+
+
+def vstack(arrays) -> DNDarray:
+    proto = next(a for a in arrays if isinstance(a, DNDarray))
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    res = jnp.vstack(js)
+    splits = [a.split for a in arrays if isinstance(a, DNDarray)]
+    out_split = next((s for s in splits if s is not None), None)
+    return _wrap(res, out_split, proto)
+
+
+def stack(arrays, axis: int = 0, out: Optional[DNDarray] = None) -> DNDarray:
+    """Join arrays along a NEW axis."""
+    proto = next(a for a in arrays if isinstance(a, DNDarray))
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    res = jnp.stack(js, axis=axis)
+    axis_n = axis % res.ndim
+    split = proto.split
+    out_split = split + 1 if split is not None and axis_n <= split else split
+    r = _wrap(res, out_split, proto)
+    if out is not None:
+        out._jarray = r._jarray
+        return out
+    return r
+
+
+def diag(x: DNDarray, offset: int = 0) -> DNDarray:
+    """Extract the diagonal (2-D input) or build a diagonal matrix (1-D input)."""
+    res = jnp.diag(x._jarray, k=offset)
+    out_split = 0 if x.split is not None else None
+    return _wrap(res, out_split, x)
+
+
+def diagonal(x: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
+    res = jnp.diagonal(x._jarray, offset=offset, axis1=dim1, axis2=dim2)
+    out_split = None if x.split in (dim1, dim2) else (0 if x.split is not None else None)
+    return _wrap(res, out_split, x)
+
+
+def expand_dims(x: DNDarray, axis: int) -> DNDarray:
+    res = jnp.expand_dims(x._jarray, axis)
+    axis_n = axis % res.ndim
+    split = x.split
+    out_split = split + 1 if split is not None and axis_n <= split else split
+    return _wrap(res, out_split, x)
+
+
+def flatten(x: DNDarray) -> DNDarray:
+    """Flatten to 1-D; distributed input stays split along 0 (reference parity)."""
+    res = x._jarray.reshape(-1)
+    return _wrap(res, 0 if x.split is not None else None, x)
+
+
+def ravel(x: DNDarray) -> DNDarray:
+    return flatten(x)
+
+
+def flip(x: DNDarray, axis=None) -> DNDarray:
+    res = jnp.flip(x._jarray, axis=axis)
+    return _wrap(res, x.split, x)
+
+
+def fliplr(x: DNDarray) -> DNDarray:
+    return flip(x, 1)
+
+
+def flipud(x: DNDarray) -> DNDarray:
+    return flip(x, 0)
+
+
+def moveaxis(x: DNDarray, source, destination) -> DNDarray:
+    res = jnp.moveaxis(x._jarray, source, destination)
+    split = x.split
+    if split is not None:
+        perm = list(range(x.ndim))
+        src = np.atleast_1d(source) % x.ndim
+        dst = np.atleast_1d(destination) % x.ndim
+        for s in sorted(src, reverse=True):
+            perm.pop(s)
+        for d, s in sorted(zip(dst, src)):
+            perm.insert(d, s)
+        split = perm.index(split)
+    return _wrap(res, split, x)
+
+
+def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
+    a1, a2 = sanitize_axis(x.shape, axis1), sanitize_axis(x.shape, axis2)
+    res = jnp.swapaxes(x._jarray, a1, a2)
+    split = x.split
+    if split == a1:
+        split = a2
+    elif split == a2:
+        split = a1
+    return _wrap(res, split, x)
+
+
+def pad(x: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
+    """Pad the array (numpy semantics for pad_width)."""
+    kw = {"constant_values": constant_values} if mode == "constant" else {}
+    res = jnp.pad(x._jarray, pad_width, mode=mode, **kw)
+    return _wrap(res, x.split, x)
+
+
+def redistribute(x: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
+    out = x.resplit(x.split)
+    out.redistribute_(lshape_map, target_map)
+    return out
+
+
+def repeat(x: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
+    if isinstance(repeats, DNDarray):
+        repeats = repeats._jarray
+    res = jnp.repeat(x._jarray, repeats, axis=axis)
+    split = None if axis is None else x.split
+    if axis is None:
+        split = 0 if x.split is not None else None
+    return _wrap(res, split, x)
+
+
+def reshape(x: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> DNDarray:
+    """Reshape; the reference redistributes via Alltoallv on flattened index
+    math — XLA derives the equivalent collective from the sharding change."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(x.size // known if s == -1 else s for s in shape)
+    res = x._jarray.reshape(shape)
+    if new_split is None:
+        new_split = x.split if x.split is not None and x.split < len(shape) else (0 if x.split is not None and len(shape) else None)
+    return _wrap(res, new_split, x)
+
+
+def resplit(x: DNDarray, axis: Optional[int] = None) -> DNDarray:
+    """Out-of-place redistribution to a new split axis (→ XLA all-to-all)."""
+    axis = sanitize_axis(x.shape, axis)
+    arr = x.comm.resplit(x._jarray, axis)
+    return DNDarray(arr, x.gshape, x.dtype, axis, x.device, x.comm, True)
+
+
+def roll(x: DNDarray, shift, axis=None) -> DNDarray:
+    res = jnp.roll(x._jarray, shift, axis=axis)
+    return _wrap(res, x.split, x)
+
+
+def rot90(x: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
+    res = jnp.rot90(x._jarray, k=k, axes=axes)
+    split = x.split
+    if split is not None and k % 2 == 1:
+        a0, a1 = axes[0] % x.ndim, axes[1] % x.ndim
+        if split == a0:
+            split = a1
+        elif split == a1:
+            split = a0
+    return _wrap(res, split, x)
+
+
+def shuffle(x: DNDarray) -> DNDarray:
+    """Random permutation along axis 0 (reference: cross-rank Alltoall)."""
+    from . import random as ht_random
+
+    perm = ht_random.permutation(x.shape[0])
+    res = x._jarray[perm._jarray]
+    return _wrap(res, x.split, x)
+
+
+def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None):
+    """Sort along axis; the reference's distributed sample-sort becomes XLA's
+    sharded sort.  Returns (sorted, original_indices) like the reference."""
+    axis = sanitize_axis(x.shape, axis)
+    j = x._jarray
+    if descending:
+        idx = jnp.argsort(-j if jnp.issubdtype(j.dtype, jnp.number) else ~j, axis=axis, stable=True)
+    else:
+        idx = jnp.argsort(j, axis=axis, stable=True)
+    vals = jnp.take_along_axis(j, idx, axis=axis)
+    v = _wrap(vals, x.split, x)
+    i = _wrap(idx.astype(jnp.int32), x.split, x)
+    if out is not None:
+        out._jarray = v._jarray
+        return out, i
+    return v, i
+
+
+def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """Split into equal (or indexed) sections along axis (numpy semantics)."""
+    axis = sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, DNDarray):
+        indices_or_sections = indices_or_sections.numpy()
+    if isinstance(indices_or_sections, (list, tuple, np.ndarray)):
+        parts = jnp.split(x._jarray, np.asarray(indices_or_sections), axis=axis)
+    else:
+        parts = jnp.split(x._jarray, int(indices_or_sections), axis=axis)
+    out_split = None if axis == x.split else x.split
+    return [_wrap(p, out_split, x) for p in parts]
+
+
+def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    return split(x, indices_or_sections, axis=2)
+
+
+def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    if x.ndim < 2:
+        return split(x, indices_or_sections, axis=0)
+    return split(x, indices_or_sections, axis=1)
+
+
+def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    return split(x, indices_or_sections, axis=0)
+
+
+def squeeze(x: DNDarray, axis=None) -> DNDarray:
+    if axis is not None:
+        axis = sanitize_axis(x.shape, axis)
+    res = jnp.squeeze(x._jarray, axis=axis)
+    split = x.split
+    if split is not None:
+        removed = (
+            [a for a in range(x.ndim) if x.shape[a] == 1]
+            if axis is None
+            else list(np.atleast_1d(axis))
+        )
+        if split in removed:
+            split = None
+        else:
+            split = split - sum(1 for a in removed if a < split)
+    return _wrap(res, split, x)
+
+
+def tile(x: DNDarray, reps) -> DNDarray:
+    res = jnp.tile(x._jarray, reps)
+    new_split = x.split + (res.ndim - x.ndim) if x.split is not None else None
+    return _wrap(res, new_split, x)
+
+
+def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
+    """Top-k values and indices along dim (reference: torch.topk + merge)."""
+    dim = sanitize_axis(x.shape, dim)
+    j = x._jarray
+    if dim != x.ndim - 1:
+        jm = jnp.moveaxis(j, dim, -1)
+    else:
+        jm = j
+    if largest:
+        vals, idx = jax.lax.top_k(jm, k)
+    else:
+        vals, idx = jax.lax.top_k(-jm, k)
+        vals = -vals
+    if dim != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, dim)
+        idx = jnp.moveaxis(idx, -1, dim)
+    split = None if dim == x.split else x.split
+    v = _wrap(vals, split, x)
+    i = _wrap(idx.astype(jnp.int32), split, x)
+    if out is not None:
+        out[0]._jarray, out[1]._jarray = v._jarray, i._jarray
+        return out
+    return v, i
+
+
+def unique(x: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
+    """Unique elements (the reference's distributed unique ⇒ global XLA unique).
+
+    Eager-only (result shape is data-dependent), like the reference.
+    """
+    res = jnp.unique(x._jarray, return_inverse=return_inverse, axis=axis)
+    if return_inverse:
+        vals, inv = res
+        v = _wrap(vals, 0 if x.split is not None else None, x)
+        iv = _wrap(inv.reshape(x.shape if axis is None else inv.shape), x.split if axis is not None else None, x)
+        return v, iv
+    return _wrap(res, 0 if x.split is not None else None, x)
+
+
+DNDarray.expand_dims = expand_dims
+DNDarray.flatten = flatten
+DNDarray.ravel = ravel
+DNDarray.flip = flip
+DNDarray.reshape = reshape
+DNDarray.roll = roll
+DNDarray.squeeze = squeeze
+DNDarray.sort = sort
+DNDarray.topk = topk
+DNDarray.unique = unique
+DNDarray.repeat = repeat
+DNDarray.tile = tile
+DNDarray.swapaxes = swapaxes
+DNDarray.moveaxis = moveaxis
+DNDarray.broadcast_to = broadcast_to
+DNDarray.concatenate = lambda self, others, axis=0: concatenate([self] + ([others] if isinstance(others, DNDarray) else list(others)), axis=axis)
+DNDarray.diagonal = diagonal
+DNDarray.shuffle = shuffle

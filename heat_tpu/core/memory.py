@@ -1,0 +1,29 @@
+"""Memory operations (reference: ``heat/core/memory.py``).
+
+Memory layout is XLA's concern on TPU; ``sanitize_memory_layout`` is kept for
+API parity and validates the order argument only.
+"""
+
+from __future__ import annotations
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x):
+    """A (deep) copy of the array, cf. reference ``ht.copy``."""
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, got {type(x)}")
+    import jax.numpy as jnp
+
+    return DNDarray(
+        jnp.copy(x._jarray), x.gshape, x.dtype, x.split, x.device, x.comm, x.balanced
+    )
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Validate the memory order flag. XLA manages physical layout on TPU."""
+    if order not in ("C", "F"):
+        raise ValueError(f"Unsupported memory layout {order!r}, expected 'C' or 'F'")
+    return x

@@ -1,0 +1,101 @@
+"""Global-array printing (reference: ``heat/core/printing.py``).
+
+``print(x)`` must show the GLOBAL array.  The reference gathers boundary
+chunks to rank 0; here the array already has a global view, but for huge
+arrays we fetch only the edge tiles to the host (never the full buffer),
+mirroring SURVEY §5.5's guidance.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["get_printoptions", "set_printoptions", "local_printing", "global_printing", "print0"]
+
+# numpy-style print options (threshold/edgeitems/precision/sci_mode)
+__PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+_LOCAL_PRINTING = False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure printing (mirrors torch/numpy set_printoptions)."""
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=np.inf, edgeitems=3, linewidth=120)
+    for k, v in dict(
+        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
+    ).items():
+        if v is not None:
+            __PRINT_OPTIONS[k] = v
+
+
+def get_printoptions() -> dict:
+    return dict(__PRINT_OPTIONS)
+
+
+def local_printing() -> None:
+    global _LOCAL_PRINTING
+    _LOCAL_PRINTING = True
+
+
+def global_printing() -> None:
+    global _LOCAL_PRINTING
+    _LOCAL_PRINTING = False
+
+
+def print0(*args, **kwargs) -> None:
+    """Print only on process 0 (reference ``ht.print0``)."""
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+def _edge_fetch(x) -> np.ndarray:
+    """Host-fetch only the edge tiles of a large array for summarized printing."""
+    e = __PRINT_OPTIONS["edgeitems"]
+    jarr = x._jarray
+    # slice e+1 items from each end of every axis; numpy's own summarization
+    # then prints ellipses correctly for any axis longer than 2e
+    slices = []
+    for s in x.shape:
+        if s > 2 * e + 1:
+            slices.append(None)  # needs stitching
+        else:
+            slices.append(slice(None))
+    if all(sl == slice(None) for sl in slices):
+        return np.asarray(jax.device_get(jarr))
+    # fetch per-axis edges by advanced indexing with index vectors
+    idxs = []
+    for s in x.shape:
+        if s > 2 * e + 1:
+            idxs.append(np.r_[0 : e + 1, s - e : s])
+        else:
+            idxs.append(np.arange(s))
+    mesh_idx = np.ix_(*idxs)
+    return np.asarray(jax.device_get(jarr[mesh_idx]))
+
+
+def __str__(x) -> str:
+    opt = get_printoptions()
+    threshold = opt["threshold"]
+    with np.printoptions(
+        precision=opt["precision"],
+        threshold=int(threshold) if np.isfinite(threshold) else 10**18,
+        edgeitems=opt["edgeitems"],
+        linewidth=opt["linewidth"],
+    ):
+        if x.size <= threshold or not np.isfinite(threshold):
+            data = np.asarray(jax.device_get(x._jarray))
+            return np.array2string(data, separator=", ")
+        data = _edge_fetch(x)
+        # force summarization formatting of the stitched edges
+        with np.printoptions(threshold=0, edgeitems=opt["edgeitems"]):
+            return np.array2string(data, separator=", ")
+
+
+def __repr__(x) -> str:
+    body = __str__(x)
+    return f"DNDarray({body}, dtype=ht.{x.dtype.__name__}, device={x.device}, split={x.split})"

@@ -1,0 +1,98 @@
+"""Rounding operations (reference: ``heat/core/rounding.py``) — all local."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import _binary_op, _local_op
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "frexp", "modf", "round", "sgn", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Elementwise absolute value."""
+    res = _local_op(jnp.abs, x, out=out)
+    if dtype is not None:
+        res = res.astype(dtype, copy=False)
+    return res
+
+
+absolute = abs
+
+
+def fabs(x, out=None) -> DNDarray:
+    return _local_op(lambda a: jnp.abs(a).astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.integer) else jnp.fabs(a), x, out=out)
+
+
+def ceil(x, out=None) -> DNDarray:
+    return _local_op(jnp.ceil, x, out=out)
+
+
+def floor(x, out=None) -> DNDarray:
+    return _local_op(jnp.floor, x, out=out)
+
+
+def clip(x, min=None, max=None, out=None) -> DNDarray:
+    """Clamp values into [min, max]."""
+    if min is None and max is None:
+        raise ValueError("clip requires at least one of min/max")
+    a_min = min._jarray if isinstance(min, DNDarray) else min
+    a_max = max._jarray if isinstance(max, DNDarray) else max
+    return _local_op(lambda a: jnp.clip(a, a_min, a_max), x, out=out)
+
+
+def frexp(x, out=None):
+    """(mantissa, exponent) decomposition."""
+    m, e = jnp.frexp(x._jarray)
+    from ._operations import _local_op as lo
+
+    mm = _local_op(lambda a: jnp.frexp(a)[0], x)
+    ee = _local_op(lambda a: jnp.frexp(a)[1], x)
+    return (mm, ee)
+
+
+def modf(x, out=None):
+    """(fractional, integral) parts."""
+    f = _local_op(lambda a: jnp.modf(a)[0], x)
+    i = _local_op(lambda a: jnp.modf(a)[1], x)
+    if out is not None:
+        out[0]._jarray = f._jarray
+        out[1]._jarray = i._jarray
+        return out
+    return (f, i)
+
+
+def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    """Round half-to-even to the given number of decimals."""
+    res = _local_op(lambda a: jnp.round(a, decimals=decimals), x, out=out)
+    if dtype is not None:
+        res = res.astype(dtype, copy=False)
+    return res
+
+
+def sgn(x, out=None) -> DNDarray:
+    """Sign (complex: x/|x|)."""
+    return _local_op(jnp.sign, x, out=out)
+
+
+def sign(x, out=None) -> DNDarray:
+    """Sign; for complex inputs, the sign of the real part (reference/torch semantics)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        return _local_op(lambda a: jnp.sign(a.real).astype(a.dtype), x, out=out)
+    return _local_op(jnp.sign, x, out=out)
+
+
+def trunc(x, out=None) -> DNDarray:
+    return _local_op(jnp.trunc, x, out=out)
+
+
+DNDarray.abs = abs
+DNDarray.__abs__ = lambda self: abs(self)
+DNDarray.ceil = ceil
+DNDarray.clip = clip
+DNDarray.floor = floor
+DNDarray.round = round
+DNDarray.trunc = trunc
+DNDarray.sign = sign

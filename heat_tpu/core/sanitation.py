@@ -1,0 +1,112 @@
+"""Input/output sanitation (reference: ``heat/core/sanitation.py``)."""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+
+__all__ = [
+    "sanitize_in",
+    "sanitize_infinity",
+    "sanitize_in_tensor",
+    "sanitize_lshape",
+    "sanitize_out",
+    "sanitize_distribution",
+    "sanitize_sequence",
+    "scalar_to_1d",
+]
+
+
+def sanitize_in(x) -> None:
+    """Raise if ``x`` is not a DNDarray."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"Input must be a DNDarray, got {type(x)}")
+
+
+def sanitize_infinity(x) -> Union[int, float]:
+    """Largest representable value of ``x``'s dtype (for ±inf substitution)."""
+    dtype = x.dtype if isinstance(x, DNDarray) else types.canonical_heat_type(x.dtype)
+    if types.heat_type_is_exact(dtype):
+        return types.iinfo(dtype).max
+    return types.finfo(dtype).max
+
+
+def sanitize_in_tensor(x) -> jnp.ndarray:
+    """Coerce to a raw jax array."""
+    if isinstance(x, DNDarray):
+        return x._jarray
+    return jnp.asarray(x)
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Validate that a local tensor is a plausible shard of ``array``."""
+    tshape = tuple(tensor.shape)
+    if array.split is None:
+        if tshape != array.gshape:
+            raise ValueError(f"local tensor shape {tshape} inconsistent with {array.gshape}")
+        return
+    for i, (t, g) in enumerate(zip(tshape, array.gshape)):
+        if i != array.split and t != g:
+            raise ValueError(f"local tensor shape {tshape} inconsistent with {array.gshape}")
+
+
+def sanitize_out(
+    out: DNDarray,
+    output_shape: Sequence[int],
+    output_split: Optional[int],
+    output_device,
+    output_comm=None,
+) -> None:
+    """Validate an ``out=`` buffer against the expected result metadata."""
+    sanitize_in(out)
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {out.shape}")
+    if out.split != output_split:
+        # like the reference, repartition out to the required split (with warning)
+        warnings.warn(
+            f"Split axis of output buffer is inconsistent with split semantics (resplitting out from {out.split} to {output_split})."
+        )
+        out.resplit_(output_split)
+
+
+def sanitize_distribution(*args, target: DNDarray, diff_map=None):
+    """Force all DNDarray args onto the split/comm of ``target`` (reference parity).
+
+    Under XLA this is a resharding ``device_put`` per mismatched operand.
+    Returns single array or tuple.
+    """
+    out = []
+    for a in args:
+        sanitize_in(a)
+        if a.split != target.split:
+            a = a.resplit(target.split)
+        out.append(a)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def sanitize_sequence(seq) -> list:
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    if isinstance(seq, DNDarray):
+        if seq.split is None:
+            return [seq[i] for i in range(len(seq))]
+        raise TypeError("seq must not be distributed")
+    raise TypeError(f"seq must be a list, tuple or DNDarray, got {type(seq)}")
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Reshape a scalar DNDarray to shape (1,)."""
+    if x.ndim == 0:
+        return DNDarray(
+            x._jarray.reshape(1), (1,), x.dtype, None, x.device, x.comm, True
+        )
+    return x

@@ -1,0 +1,252 @@
+"""Statistical operations (reference: ``heat/core/statistics.py``).
+
+The reference merges distributed moments by hand (Chan et al. pairwise update
+of ``(n, μ, M2)`` via custom MPI ops).  Under XLA a global-mean/var over a
+sharded axis IS that merge — the partitioner emits the tree-reduction — so
+these collapse to jnp reductions plus split bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import _binary_op, _local_op, _reduce_op
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "average",
+    "bincount",
+    "bucketize",
+    "cov",
+    "digitize",
+    "histc",
+    "histogram",
+    "kurtosis",
+    "max",
+    "maximum",
+    "mean",
+    "median",
+    "min",
+    "minimum",
+    "percentile",
+    "skew",
+    "std",
+    "var",
+]
+
+
+def argmax(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Index of the maximum (global indices, reference MINLOC-style semantics)."""
+    return _reduce_op(jnp.argmax, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def argmin(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.argmin, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def max(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Maximum along axis (implicit Allreduce-MAX over the split axis)."""
+    return _reduce_op(jnp.max, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def min(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.min, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def maximum(x1, x2, out=None) -> DNDarray:
+    """Elementwise maximum of two arrays."""
+    return _binary_op(jnp.maximum, x1, x2, out=out)
+
+
+def minimum(x1, x2, out=None) -> DNDarray:
+    return _binary_op(jnp.minimum, x1, x2, out=out)
+
+
+def mean(x, axis=None) -> DNDarray:
+    """Arithmetic mean (distributed moment merge is XLA's tree-reduce)."""
+    return _reduce_op(jnp.mean, x, axis=axis)
+
+
+def var(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Variance with ``ddof`` correction (reference default ddof=0)."""
+    return _reduce_op(jnp.var, x, axis=axis, ddof=ddof)
+
+
+def std(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    return _reduce_op(jnp.std, x, axis=axis, ddof=ddof)
+
+
+def average(x, axis=None, weights=None, returned: bool = False):
+    """Weighted average along axis."""
+    if weights is None:
+        result = mean(x, axis=axis)
+        if returned:
+            from . import factories
+
+            n = x.size if axis is None else np.prod([x.shape[a] for a in np.atleast_1d(axis)])
+            return result, factories.full_like(result, float(n))
+        return result
+    w = weights._jarray if isinstance(weights, DNDarray) else jnp.asarray(weights)
+    ax = sanitize_axis(x.shape, axis) if axis is not None else None
+    res, wsum = jnp.average(x._jarray, axis=ax, weights=w, returned=True)
+    # split bookkeeping identical to _reduce_op (axis removed shifts the split)
+    if x.split is None or ax is None or ax == x.split:
+        split = None
+    else:
+        split = x.split - (1 if ax < x.split else 0)
+    if split is not None and split >= res.ndim:
+        split = None
+    res = x.comm.shard(res, split)
+    out = DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, x.device, x.comm, True)
+    if returned:
+        wb = x.comm.shard(jnp.broadcast_to(wsum, res.shape), split)
+        ws = DNDarray(wb, tuple(res.shape), types.canonical_heat_type(wsum.dtype), split, x.device, x.comm, True)
+        return out, ws
+    return out
+
+
+def bincount(x, weights=None, minlength: int = 0) -> DNDarray:
+    """Count occurrences of each value in a non-negative int array."""
+    if weights is not None:
+        w = weights._jarray if isinstance(weights, DNDarray) else jnp.asarray(weights)
+        w = w.reshape(-1)
+    else:
+        w = None
+    length = int(jnp.max(x._jarray).item()) + 1 if x.size else 0
+    length = length if length > minlength else minlength
+    res = jnp.bincount(x._jarray.reshape(-1), weights=w, length=length)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def bucketize(x, boundaries, right: bool = False, out=None) -> DNDarray:
+    """Index of the bucket each element falls into (torch semantics:
+    ``right=False`` ⇒ boundaries[i-1] < v <= boundaries[i] ⇒ searchsorted 'left')."""
+    b = boundaries._jarray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
+    side = "right" if right else "left"
+    return _local_op(lambda a: jnp.searchsorted(b, a, side=side).astype(jnp.int32), x, out=out)
+
+
+def digitize(x, bins, right: bool = False) -> DNDarray:
+    b = bins._jarray if isinstance(bins, DNDarray) else jnp.asarray(bins)
+    return _local_op(lambda a: jnp.digitize(a, b, right=right).astype(jnp.int32), x)
+
+
+def cov(m, y=None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] = None) -> DNDarray:
+    """Covariance matrix estimate (distributed via implicit matmul collectives)."""
+    x = m
+    if x.ndim > 2:
+        raise ValueError("m has more than 2 dimensions")
+    jm = x._jarray
+    if y is not None:
+        jy = y._jarray if isinstance(y, DNDarray) else jnp.asarray(y)
+    else:
+        jy = None
+    res = jnp.cov(jm, y=jy, rowvar=rowvar, bias=bias, ddof=ddof)
+    res = jnp.atleast_2d(res)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def histc(x, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo = float(jnp.min(x._jarray))
+        hi = float(jnp.max(x._jarray))
+    hist, _ = jnp.histogram(x._jarray.reshape(-1), bins=bins, range=(lo, hi))
+    hist = hist.astype(x.dtype.jax_dtype())
+    res = DNDarray(hist, tuple(hist.shape), x.dtype, None, x.device, x.comm, True)
+    if out is not None:
+        out._jarray = hist
+        return out
+    return res
+
+
+def histogram(x, bins=10, range=None, weights=None, density=None):
+    """(hist, bin_edges) over the global array."""
+    w = weights._jarray if isinstance(weights, DNDarray) else weights
+    hist, edges = jnp.histogram(x._jarray.reshape(-1), bins=bins, range=range, weights=w, density=density)
+    h = DNDarray(hist, tuple(hist.shape), types.canonical_heat_type(hist.dtype), None, x.device, x.comm, True)
+    e = DNDarray(edges, tuple(edges.shape), types.canonical_heat_type(edges.dtype), None, x.device, x.comm, True)
+    return h, e
+
+
+def _moment_stat(x, axis, fn_name, unbiased_correction=None, **kw):
+    pass
+
+
+def kurtosis(x, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:
+    """Kurtosis (Fisher: excess kurtosis). Distributed via global moments."""
+    ax = sanitize_axis(x.shape, axis)
+    j = x._jarray
+    mu = jnp.mean(j, axis=ax, keepdims=True)
+    d = j - mu
+    m2 = jnp.mean(d**2, axis=ax)
+    m4 = jnp.mean(d**4, axis=ax)
+    n = x.size if ax is None else x.shape[ax]
+    g2 = m4 / jnp.where(m2 == 0, 1.0, m2**2)
+    if unbiased and n > 3:
+        g2 = (n - 1) / ((n - 2) * (n - 3)) * ((n + 1) * g2 - 3 * (n - 1)) + 3
+    res = g2 - 3.0 if Fischer else g2
+    split = None if ax is None or ax == x.split else (x.split - (1 if ax < (x.split or 0) else 0) if x.split is not None else None)
+    if split is not None and split >= res.ndim:
+        split = None
+    res = x.comm.shard(res, split)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, x.device, x.comm, True)
+
+
+def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
+    """Skewness of the distribution along axis."""
+    ax = sanitize_axis(x.shape, axis)
+    j = x._jarray
+    mu = jnp.mean(j, axis=ax, keepdims=True)
+    d = j - mu
+    m2 = jnp.mean(d**2, axis=ax)
+    m3 = jnp.mean(d**3, axis=ax)
+    g1 = m3 / jnp.where(m2 == 0, 1.0, m2**1.5)
+    n = x.size if ax is None else x.shape[ax]
+    if unbiased and n > 2:
+        g1 = g1 * jnp.sqrt(n * (n - 1)) / (n - 2)
+    split = None if ax is None or ax == x.split else (x.split - (1 if ax < (x.split or 0) else 0) if x.split is not None else None)
+    if split is not None and split >= g1.ndim:
+        split = None
+    g1 = x.comm.shard(g1, split)
+    return DNDarray(g1, tuple(g1.shape), types.canonical_heat_type(g1.dtype), split, x.device, x.comm, True)
+
+
+def median(x, axis=None, keepdims: bool = False) -> DNDarray:
+    """Median — the reference does distributed selection; XLA sorts globally."""
+    return percentile(x, 50.0, axis=axis, keepdims=keepdims)
+
+
+def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+    """q-th percentile(s) along axis."""
+    ax = sanitize_axis(x.shape, axis)
+    qj = q._jarray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
+    res = jnp.percentile(x._jarray.astype(jnp.float32), qj, axis=ax, method=interpolation, keepdims=keepdims)
+    res = x.comm.shard(res, None)
+    r = DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+    if out is not None:
+        out._jarray = res.astype(out.dtype.jax_dtype())
+        return out
+    return r
+
+
+DNDarray.argmax = argmax
+DNDarray.argmin = argmin
+DNDarray.max = max
+DNDarray.min = min
+DNDarray.mean = mean
+DNDarray.var = var
+DNDarray.std = std
+DNDarray.average = average
+DNDarray.median = median
+DNDarray.percentile = percentile
+DNDarray.kurtosis = kurtosis
+DNDarray.skew = skew

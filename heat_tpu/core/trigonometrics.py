@@ -1,0 +1,131 @@
+"""Trigonometric/hyperbolic operations (reference: ``heat/core/trigonometrics.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import _binary_op, _local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "arccos",
+    "acos",
+    "arccosh",
+    "acosh",
+    "arcsin",
+    "asin",
+    "arcsinh",
+    "asinh",
+    "arctan",
+    "atan",
+    "arctan2",
+    "atan2",
+    "arctanh",
+    "atanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinc",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def arccos(x, out=None) -> DNDarray:
+    return _local_op(jnp.arccos, x, out=out)
+
+
+acos = arccos
+
+
+def arccosh(x, out=None) -> DNDarray:
+    return _local_op(jnp.arccosh, x, out=out)
+
+
+acosh = arccosh
+
+
+def arcsin(x, out=None) -> DNDarray:
+    return _local_op(jnp.arcsin, x, out=out)
+
+
+asin = arcsin
+
+
+def arcsinh(x, out=None) -> DNDarray:
+    return _local_op(jnp.arcsinh, x, out=out)
+
+
+asinh = arcsinh
+
+
+def arctan(x, out=None) -> DNDarray:
+    return _local_op(jnp.arctan, x, out=out)
+
+
+atan = arctan
+
+
+def arctan2(t1, t2) -> DNDarray:
+    return _binary_op(jnp.arctan2, t1, t2)
+
+
+atan2 = arctan2
+
+
+def arctanh(x, out=None) -> DNDarray:
+    return _local_op(jnp.arctanh, x, out=out)
+
+
+atanh = arctanh
+
+
+def cos(x, out=None) -> DNDarray:
+    return _local_op(jnp.cos, x, out=out)
+
+
+def cosh(x, out=None) -> DNDarray:
+    return _local_op(jnp.cosh, x, out=out)
+
+
+def deg2rad(x, out=None) -> DNDarray:
+    return _local_op(jnp.deg2rad, x, out=out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None) -> DNDarray:
+    return _local_op(jnp.rad2deg, x, out=out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None) -> DNDarray:
+    return _local_op(jnp.sin, x, out=out)
+
+
+def sinc(x, out=None) -> DNDarray:
+    return _local_op(jnp.sinc, x, out=out)
+
+
+def sinh(x, out=None) -> DNDarray:
+    return _local_op(jnp.sinh, x, out=out)
+
+
+def tan(x, out=None) -> DNDarray:
+    return _local_op(jnp.tan, x, out=out)
+
+
+def tanh(x, out=None) -> DNDarray:
+    return _local_op(jnp.tanh, x, out=out)
+
+
+for _n in ("sin", "cos", "tan", "sinh", "cosh", "tanh", "arcsin", "arccos", "arctan"):
+    setattr(DNDarray, _n, globals()[_n])

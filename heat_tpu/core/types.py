@@ -1,0 +1,389 @@
+"""The heat_tpu type system.
+
+Mirrors the reference's ``heat/core/types.py`` contract — a small class
+hierarchy of canonical types (``ht.bool`` … ``ht.complex128``) with
+NumPy-style promotion — but maps onto JAX/XLA dtypes instead of torch.
+
+TPU-first deviations (documented, deliberate):
+
+- ``bfloat16`` is a first-class type (the MXU's native matmul dtype); the
+  reference has none.
+- 64-bit types exist but are only materialized when ``jax_enable_x64`` is on;
+  otherwise JAX canonicalizes them to 32-bit (standard JAX behavior).  The
+  default float type is ``float32`` (matching both the reference's torch
+  default and the TPU sweet spot).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Type, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "datatype",
+    "generic",
+    "number",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "floating",
+    "flexible",
+    "complexfloating",
+    "bool",
+    "bool_",
+    "uint8",
+    "ubyte",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int_",
+    "int64",
+    "long",
+    "bfloat16",
+    "float16",
+    "half",
+    "float32",
+    "float",
+    "float_",
+    "float64",
+    "double",
+    "complex64",
+    "cfloat",
+    "complex128",
+    "cdouble",
+    "canonical_heat_type",
+    "heat_type_of",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "heat_type_is_complexfloating",
+    "issubdtype",
+    "promote_types",
+    "result_type",
+    "can_cast",
+    "iscomplex",
+    "isreal",
+    "finfo",
+    "iinfo",
+]
+
+
+class datatype:
+    """Base class of the heat_tpu scalar type hierarchy (``ht.generic``)."""
+
+    _np_char: str = None  # numpy typestring for the concrete leaf classes
+
+    def __new__(cls, *value, device=None, comm=None):
+        # instantiation casts: ht.float32(x) == ht.array(x, dtype=ht.float32)
+        from . import factories
+
+        if len(value) == 0:
+            value = (0,)
+        if len(value) == 1:
+            return factories.array(value[0], dtype=cls, device=device, comm=comm)
+        raise TypeError(f"takes at most 1 argument, got {len(value)}")
+
+    @classmethod
+    def np_dtype(cls) -> np.dtype:
+        return np.dtype(cls._np_char)
+
+    @classmethod
+    def jax_dtype(cls):
+        return jnp.dtype(cls._np_char) if cls._np_char != "bfloat16" else jnp.bfloat16
+
+    @classmethod
+    def char(cls) -> str:
+        return cls._np_char
+
+
+generic = datatype
+
+
+class bool(datatype):
+    _np_char = "bool"
+
+
+class number(datatype):
+    pass
+
+
+class integer(number):
+    pass
+
+
+class signedinteger(integer):
+    pass
+
+
+class unsignedinteger(integer):
+    pass
+
+
+class floating(number):
+    pass
+
+
+class flexible(datatype):
+    pass
+
+
+class complexfloating(number):
+    pass
+
+
+class uint8(unsignedinteger):
+    _np_char = "uint8"
+
+
+class uint16(unsignedinteger):
+    _np_char = "uint16"
+
+
+class uint32(unsignedinteger):
+    _np_char = "uint32"
+
+
+class uint64(unsignedinteger):
+    _np_char = "uint64"
+
+
+class int8(signedinteger):
+    _np_char = "int8"
+
+
+class int16(signedinteger):
+    _np_char = "int16"
+
+
+class int32(signedinteger):
+    _np_char = "int32"
+
+
+class int64(signedinteger):
+    _np_char = "int64"
+
+
+class bfloat16(floating):
+    _np_char = "bfloat16"
+
+
+class float16(floating):
+    _np_char = "float16"
+
+
+class float32(floating):
+    _np_char = "float32"
+
+
+class float64(floating):
+    _np_char = "float64"
+
+
+class complex64(complexfloating):
+    _np_char = "complex64"
+
+
+class complex128(complexfloating):
+    _np_char = "complex128"
+
+
+# aliases (reference-compatible)
+bool_ = bool
+ubyte = uint8
+byte = int8
+short = int16
+int = int32
+int_ = int32
+long = int64
+half = float16
+float = float32
+float_ = float32
+double = float64
+cfloat = complex64
+cdouble = complex128
+
+
+_HEAT_TYPES = [
+    bool,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    int8,
+    int16,
+    int32,
+    int64,
+    bfloat16,
+    float16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+]
+_BY_CHAR = {t._np_char: t for t in _HEAT_TYPES}
+
+# python-builtin / numpy / jax dtype → heat type
+_CANONICAL = {}
+for _t in _HEAT_TYPES:
+    _CANONICAL[_t] = _t
+    if _t._np_char != "bfloat16":
+        _CANONICAL[np.dtype(_t._np_char)] = _t
+        _CANONICAL[np.dtype(_t._np_char).type] = _t
+_CANONICAL[builtins.bool] = bool
+_CANONICAL[builtins.int] = int32
+_CANONICAL[builtins.float] = float32
+_CANONICAL[builtins.complex] = complex64
+_CANONICAL[jnp.bfloat16] = bfloat16
+_CANONICAL[jnp.dtype(jnp.bfloat16)] = bfloat16
+_CANONICAL["bool"] = bool
+for _c in ("uint8", "uint16", "uint32", "uint64", "int8", "int16", "int32", "int64",
+           "bfloat16", "float16", "float32", "float64", "complex64", "complex128"):
+    _CANONICAL[_c] = _BY_CHAR[_c]
+
+
+def canonical_heat_type(a_type) -> Type[datatype]:
+    """Resolve any dtype-like object to the canonical heat_tpu type class."""
+    try:
+        return _CANONICAL[a_type]
+    except (KeyError, TypeError):
+        pass
+    try:
+        return _CANONICAL[np.dtype(a_type)]
+    except (KeyError, TypeError):
+        raise TypeError(f"Data type {a_type!r} is not understood") from None
+
+
+def heat_type_of(obj) -> Type[datatype]:
+    """The heat type of ``obj``'s elements (DNDarray / jax / numpy / scalars / sequences)."""
+    dt = getattr(obj, "dtype", None)
+    if dt is not None:
+        return canonical_heat_type(dt)
+    if isinstance(obj, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
+        return canonical_heat_type(type(obj))
+    if isinstance(obj, (list, tuple)):
+        return canonical_heat_type(np.asarray(obj).dtype)
+    raise TypeError(f"Cannot determine heat type of {type(obj)}")
+
+
+def issubdtype(arg1, arg2) -> builtins.bool:
+    """NumPy-semantics ``issubdtype`` over the heat class hierarchy."""
+    if not isinstance(arg1, type) or not issubclass(arg1, datatype):
+        arg1 = canonical_heat_type(arg1)
+    if isinstance(arg2, type) and issubclass(arg2, datatype):
+        return issubclass(arg1, arg2)
+    return issubclass(arg1, canonical_heat_type(arg2))
+
+
+def heat_type_is_exact(ht_dtype) -> builtins.bool:
+    """True for integer/bool types."""
+    t = canonical_heat_type(ht_dtype)
+    return issubclass(t, integer) or t is bool
+
+
+def heat_type_is_inexact(ht_dtype) -> builtins.bool:
+    return issubclass(canonical_heat_type(ht_dtype), (floating, complexfloating))
+
+
+def heat_type_is_complexfloating(ht_dtype) -> builtins.bool:
+    return issubclass(canonical_heat_type(ht_dtype), complexfloating)
+
+
+def promote_types(type1, type2) -> Type[datatype]:
+    """NumPy-style type promotion over heat types (bfloat16-aware via jnp)."""
+    t1, t2 = canonical_heat_type(type1), canonical_heat_type(type2)
+    res = jnp.promote_types(t1.jax_dtype(), t2.jax_dtype())
+    return canonical_heat_type(res)
+
+
+def result_type(*operands) -> Type[datatype]:
+    """The heat type resulting from combining the given operands (arrays or scalars)."""
+
+    def as_np(o):
+        if isinstance(o, type) and issubclass(o, datatype):
+            return o.jax_dtype()
+        dt = getattr(o, "dtype", None)
+        if dt is not None:
+            d = canonical_heat_type(dt)
+            return d.jax_dtype()
+        return o
+
+    return canonical_heat_type(jnp.result_type(*[as_np(o) for o in operands]))
+
+
+def can_cast(from_, to, casting: str = "safe") -> builtins.bool:
+    """NumPy-semantics ``can_cast`` over heat types (intuitive | safe | same_kind | unsafe)."""
+    if casting == "unsafe":
+        return True
+    try:
+        f = canonical_heat_type(from_) if not isinstance(from_, (builtins.int, builtins.float, builtins.complex, builtins.bool)) else heat_type_of(from_)
+    except TypeError:
+        f = heat_type_of(from_)
+    t = canonical_heat_type(to)
+    fd, td = np.dtype(f._np_char if f._np_char != "bfloat16" else "float32"), np.dtype(
+        t._np_char if t._np_char != "bfloat16" else "float32"
+    )
+    if casting == "same_kind":
+        return np.can_cast(fd, td, casting="same_kind")
+    if casting in ("safe", "intuitive"):
+        return np.can_cast(fd, td, casting="safe")
+    raise ValueError(f"Unknown casting mode {casting}")
+
+
+def iscomplex(x):
+    """Elementwise: does the element have a non-zero imaginary part."""
+    from . import _operations
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        from . import factories
+
+        x = factories.array(x)
+    if heat_type_is_complexfloating(x.dtype):
+        return _operations.__dict__["_local_op"](jnp.imag, x) != 0
+    from . import factories
+
+    return factories.zeros(x.shape, dtype=bool, split=x.split, device=x.device, comm=x.comm)
+
+
+def isreal(x):
+    """Elementwise: is the element real-valued (imag == 0)."""
+    from .logical import logical_not
+
+    return logical_not(iscomplex(x))
+
+
+class finfo:
+    """Machine limits for floating point heat types (mirrors ``np.finfo``)."""
+
+    def __new__(cls, dtype):
+        t = canonical_heat_type(dtype)
+        if not issubclass(t, floating) and not issubclass(t, complexfloating):
+            raise TypeError(f"Data type {dtype} not inexact")
+        info = jnp.finfo(t.jax_dtype())
+        self = object.__new__(cls)
+        self.bits = info.bits
+        self.eps = builtins.float(info.eps)
+        self.max = builtins.float(info.max)
+        self.min = builtins.float(info.min)
+        self.tiny = builtins.float(info.tiny)
+        return self
+
+
+class iinfo:
+    """Machine limits for integer heat types (mirrors ``np.iinfo``)."""
+
+    def __new__(cls, dtype):
+        t = canonical_heat_type(dtype)
+        if t is bool or not issubclass(t, integer):
+            raise TypeError(f"Data type {dtype} not an integer type")
+        info = jnp.iinfo(t.jax_dtype())
+        self = object.__new__(cls)
+        self.bits = info.bits
+        self.max = builtins.int(info.max)
+        self.min = builtins.int(info.min)
+        return self

@@ -1,0 +1,268 @@
+"""Distributed linear algebra basics (reference: ``heat/core/linalg/basics.py``).
+
+The reference's ``matmul`` is a hand-rolled SUMMA: case analysis on
+``(a.split, b.split)``, K-blocks circulated with Bcast/ring, local GEMMs
+accumulated (SURVEY §3.2).  On TPU that entire machinery collapses: one
+``jnp.matmul`` on sharded operands lets GSPMD emit the identical blocked
+algorithm (collective-matmul fusion over ICI keeps the MXU busy during
+transfers).  What remains here is the *result-split bookkeeping* — the same
+case table as the reference — plus an explicit ``shard_map`` SUMMA path for
+when manual control wins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+from ..core.stride_tricks import sanitize_axis
+
+__all__ = [
+    "cross",
+    "dot",
+    "matmul",
+    "matrix_norm",
+    "norm",
+    "outer",
+    "projection",
+    "trace",
+    "transpose",
+    "tril",
+    "triu",
+    "vdot",
+    "vecdot",
+    "vector_norm",
+]
+
+
+def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
+    if split is not None and (jarr.ndim == 0 or split >= jarr.ndim):
+        split = None
+    jarr = proto.comm.shard(jarr, split)
+    return DNDarray(
+        jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
+    )
+
+
+def _matmul_result_split(sa: Optional[int], sb: Optional[int], nd_out: int) -> Optional[int]:
+    """The reference's result-split case table for 2-D matmul.
+
+    (None,None)→None; a row-split → out row-split; b col-split → out
+    col-split; both-split contraction cases reduce over K → prefer row-split
+    output (the reference picks split=0 for the 0/0 and 0/1 cases).
+    """
+    row, col = nd_out - 2, nd_out - 1
+    if sa is None and sb is None:
+        return None
+    if sa == 0 and sb is None:
+        return row
+    if sa == 1 and sb is None:
+        return row  # contraction over a's split: result gathered then re-split 0? ref: split=None→we keep row for locality
+    if sa is None and sb == 0:
+        return col if nd_out >= 2 else None
+    if sa is None and sb == 1:
+        return col
+    if sa == 0:
+        return row
+    return col
+
+
+def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+    """Matrix product with distributed-split bookkeeping.
+
+    All eight split cases of the reference map onto ONE sharded
+    ``jnp.matmul``; XLA's SPMD partitioner performs the K-block circulation
+    (SUMMA) that ``heat/core/linalg/basics.py::matmul`` hand-implements.
+    """
+    sanitize_in(a)
+    sanitize_in(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return dot(a, b)
+    res = jnp.matmul(a._jarray, b._jarray)
+    nd = res.ndim
+    if nd == 0:
+        return _wrap(res, None, a)
+    # vector cases
+    if a.ndim == 1:
+        split = None if b.split is None else (nd - 1 if b.split == b.ndim - 1 else None)
+    elif b.ndim == 1:
+        split = None if a.split is None else (nd - 1 if a.split == a.ndim - 2 else None)
+    else:
+        sa = None if a.split is None else (0 if a.split == a.ndim - 2 else (1 if a.split == a.ndim - 1 else None))
+        sb = None if b.split is None else (0 if b.split == b.ndim - 2 else (1 if b.split == b.ndim - 1 else None))
+        split = _matmul_result_split(sa, sb, nd)
+    return _wrap(res, split, a)
+
+
+def matmul_summa(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Explicit shard_map SUMMA (manual-control path, both operands split=0).
+
+    Stationary A row-block; B row-blocks rotate around the ring while each
+    shard accumulates its partial GEMM — the reference's K-block circulation
+    made explicit.  Useful when GSPMD's choice is suboptimal.
+    """
+    sanitize_in(a)
+    sanitize_in(b)
+    if a.ndim != 2 or b.ndim != 2:
+        raise ValueError("matmul_summa requires 2-D operands")
+    comm = a.comm
+    axis, size = comm.axis, comm.size
+    M, K = a.shape
+    K2, N = b.shape
+    if K != K2:
+        raise ValueError(f"shapes {a.shape} and {b.shape} not aligned")
+    a0 = a.resplit(0) if a.split != 0 else a
+    b0 = b.resplit(0) if b.split != 0 else b
+
+    def shard_fn(a_blk, b_blk):
+        my = lax.axis_index(axis)
+        kblk = b_blk.shape[0]
+
+        def step(carry, i):
+            acc, rot = carry
+            src = (my + i) % size  # which K-rows this rotating block holds
+            a_cols = lax.dynamic_slice_in_dim(a_blk, src * kblk, kblk, axis=1)
+            acc = acc + a_cols @ rot
+            rot = lax.ppermute(rot, axis, [((j + 1) % size, j) for j in range(size)])
+            return (acc, rot), None
+
+        acc0 = jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=jnp.promote_types(a_blk.dtype, b_blk.dtype))
+        (acc, _), _ = lax.scan(step, (acc0, b_blk), jnp.arange(size))
+        return acc
+
+    if K % size != 0 or M % size != 0:
+        # fall back to the GSPMD path for ragged shards
+        return matmul(a0, b0)
+    mapped = comm.shard_map(shard_fn, in_splits=((2, 0), (2, 0)), out_splits=(2, 0))
+    res = mapped(a0._jarray, b0._jarray)
+    return _wrap(res, 0, a)
+
+
+def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+    """Dot product: 1-D·1-D → scalar (implicit Allreduce); else matmul."""
+    if a.ndim == 1 and b.ndim == 1:
+        res = jnp.dot(a._jarray, b._jarray)
+        r = _wrap(res, None, a)
+        if out is not None:
+            out._jarray = r._jarray
+            return out
+        return r
+    r = matmul(a, b)
+    if out is not None:
+        out._jarray = r._jarray
+        return out
+    return r
+
+
+def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
+    res = jnp.vdot(x1._jarray, x2._jarray)
+    return _wrap(res, None, x1)
+
+
+def vecdot(x1: DNDarray, x2: DNDarray, axis: int = -1, keepdims: bool = False) -> DNDarray:
+    res = jnp.sum(jnp.conj(x1._jarray) * x2._jarray, axis=axis, keepdims=keepdims)
+    split = None
+    return _wrap(res, split, x1)
+
+
+def outer(a: DNDarray, b: DNDarray, out=None, split=None) -> DNDarray:
+    """Outer product (reference: ring algorithm; here sharded broadcast-mul)."""
+    res = jnp.outer(a._jarray, b._jarray)
+    if split is None:
+        split = 0 if (a.split is not None or b.split is not None) else None
+    r = _wrap(res, split, a)
+    if out is not None:
+        out._jarray = r._jarray
+        return out
+    return r
+
+
+def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1) -> DNDarray:
+    res = jnp.cross(a._jarray, b._jarray, axisa=axisa, axisb=axisb, axisc=axisc, axis=axis)
+    return _wrap(res, a.split, a)
+
+
+def projection(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Projection of vector a onto vector b."""
+    scale = dot(a, b) / dot(b, b)
+    from ..core import arithmetics
+
+    return arithmetics.mul(b, scale)
+
+
+def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out=None) -> DNDarray:
+    res = jnp.trace(a._jarray, offset=offset, axis1=axis1, axis2=axis2)
+    if dtype is not None:
+        res = res.astype(types.canonical_heat_type(dtype).jax_dtype())
+    r = _wrap(res, None, a)
+    if out is not None:
+        out._jarray = r._jarray
+        return out
+    return r
+
+
+def transpose(a: DNDarray, axes=None) -> DNDarray:
+    """Permute axes; the split axis moves with its dimension (no data motion
+    beyond XLA's layout change + reshard)."""
+    sanitize_in(a)
+    if axes is None:
+        axes = tuple(reversed(range(a.ndim)))
+    axes = tuple(int(ax) % a.ndim for ax in axes)
+    res = jnp.transpose(a._jarray, axes)
+    split = axes.index(a.split) if a.split is not None else None
+    return _wrap(res, split, a)
+
+
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
+    return _wrap(jnp.tril(m._jarray, k=k), m.split, m)
+
+
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
+    return _wrap(jnp.triu(m._jarray, k=k), m.split, m)
+
+
+def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=2) -> DNDarray:
+    res = jnp.linalg.vector_norm(x._jarray, axis=axis, keepdims=keepdims, ord=ord)
+    split = None
+    if axis is not None and x.split is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(ax % x.ndim for ax in axes)
+        if x.split not in axes:
+            split = x.split - sum(1 for ax in axes if ax < x.split) if not keepdims else x.split
+    return _wrap(res, split, x)
+
+
+def matrix_norm(x: DNDarray, axis=None, keepdims: bool = False, ord="fro") -> DNDarray:
+    if axis is None:
+        if x.ndim < 2:
+            raise ValueError("matrix_norm requires at least 2 dimensions")
+        axis = (x.ndim - 2, x.ndim - 1)
+    res = jnp.linalg.norm(x._jarray, ord=ord, axis=tuple(axis), keepdims=keepdims)
+    return _wrap(res, None, x)
+
+
+def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+    """Vector/matrix norm dispatch (numpy semantics)."""
+    res = jnp.linalg.norm(x._jarray, ord=ord, axis=axis if axis is None or isinstance(axis, int) else tuple(axis), keepdims=keepdims)
+    split = None
+    if axis is not None and x.split is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(ax % x.ndim for ax in axes)
+        if x.split not in axes and not keepdims:
+            split = x.split - sum(1 for ax in axes if ax < x.split)
+        elif x.split not in axes:
+            split = x.split
+    return _wrap(res, split, x)
+
+
+DNDarray.__matmul__ = lambda self, other: matmul(self, other)
+DNDarray.transpose = transpose
+DNDarray.tril = lambda self, k=0: tril(self, k)
+DNDarray.triu = lambda self, k=0: triu(self, k)

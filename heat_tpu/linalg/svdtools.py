@@ -1,0 +1,211 @@
+"""Distributed SVD (reference: ``heat/core/linalg/svdtools.py``).
+
+- ``svd``: exact SVD; tall-skinny row-split inputs go through TSQR (QR then
+  SVD of the small R — the communication-avoiding TS-SVD of the reference).
+- ``hsvd_rank`` / ``hsvd_rtol``: **hierarchical approximate SVD** — local
+  truncated SVDs of column blocks merged pairwise up a binary tree, exactly
+  the reference's algorithm; each merge is a small on-device QR/SVD, the
+  block extraction is sharded slicing (implicit collectives).
+- ``rsvd``: randomized SVD (Halko-Martinsson-Tropp sketch).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+from .qr import tsqr
+
+__all__ = ["hsvd", "hsvd_rank", "hsvd_rtol", "rsvd", "svd"]
+
+SVDTuple = collections.namedtuple("SVD", "U, S, V")
+
+
+def _wrap(jarr, split, proto):
+    if split is not None and split >= jarr.ndim:
+        split = None
+    jarr = proto.comm.shard(jarr, split)
+    return DNDarray(
+        jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
+    )
+
+
+def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True, qr_procs_to_merge: int = 2):
+    """Exact SVD. Row-split tall matrices: TSQR → SVD(R) (TS-SVD)."""
+    sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError("svd requires a 2-D array")
+    if full_matrices:
+        raise NotImplementedError("full_matrices=True is not supported (reference parity)")
+    m, n = a.shape
+    if a.split == 0 and m >= n:
+        q, r = tsqr(a)
+        ur, s, vt = jnp.linalg.svd(r._jarray, full_matrices=False)
+        if not compute_uv:
+            return _wrap(s, None, a)
+        u = q._jarray @ ur  # (m,n) split-0 GEMM against replicated (n,n)
+        return SVDTuple(_wrap(u, 0, a), _wrap(s, None, a), _wrap(vt.T, None, a))
+    if a.split == 1 and n > m:
+        # wide: transpose reduces to the tall case
+        ut, s, vt = svd(a.T.resplit(0), compute_uv=True)
+        if not compute_uv:
+            return _wrap(s._jarray, None, a)
+        return SVDTuple(vt, s, ut)
+    u, s, vt = jnp.linalg.svd(a._jarray, full_matrices=False)
+    if not compute_uv:
+        return _wrap(s, None, a)
+    return SVDTuple(_wrap(u, a.split, a), _wrap(s, None, a), _wrap(vt.T, None, a))
+
+
+def _truncate(u, s, rank: Optional[int] = None, rtol: Optional[float] = None, safetyshift: int = 0):
+    if rank is not None:
+        k = min(rank + safetyshift, s.shape[0])
+        return u[:, :k], s[:k]
+    # rtol truncation: discard tail energy below rtol * ||s||
+    err2 = jnp.cumsum((s**2)[::-1])[::-1]
+    thresh = (rtol**2) * jnp.sum(s**2)
+    keep = int(jnp.sum(err2 > thresh).item())
+    keep = max(keep, 1)
+    keep = min(keep + safetyshift, s.shape[0])
+    return u[:, :keep], s[:keep]
+
+
+def hsvd(
+    a: DNDarray,
+    maxrank: Optional[int] = None,
+    maxmergedim: Optional[int] = None,
+    rtol: Optional[float] = None,
+    safetyshift: int = 0,
+    no_of_merges: Optional[int] = None,
+    compute_sv: bool = False,
+    silent: bool = True,
+):
+    """Hierarchical SVD core: local SVDs of column blocks, pairwise tree merge.
+
+    Mirrors the reference's binary process tree; each level halves the number
+    of factors.  Runs on the sharded global array — block slicing and the
+    final small GEMMs produce the collectives.
+    """
+    sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError("hsvd requires a 2-D array")
+    m, n = a.shape
+    comm = a.comm
+    nblocks = min(comm.size, n) if comm.size > 1 else min(4, n)
+    ja = a._jarray
+
+    # leaf factors: truncated local SVD of each column block
+    factors = []
+    bounds = np.linspace(0, n, nblocks + 1, dtype=np.int64)
+    for i in range(nblocks):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        if hi <= lo:
+            continue
+        blk = ja[:, lo:hi]
+        u, s, _ = jnp.linalg.svd(blk, full_matrices=False)
+        u, s = _truncate(u, s, rank=maxrank, rtol=rtol, safetyshift=safetyshift)
+        factors.append(u * s)
+
+    # binary tree merge
+    while len(factors) > 1:
+        merged = []
+        for i in range(0, len(factors) - 1, 2):
+            cat = jnp.concatenate([factors[i], factors[i + 1]], axis=1)
+            u, s, _ = jnp.linalg.svd(cat, full_matrices=False)
+            u, s = _truncate(u, s, rank=maxrank, rtol=rtol, safetyshift=safetyshift)
+            merged.append(u * s)
+        if len(factors) % 2 == 1:
+            merged.append(factors[-1])
+        factors = merged
+
+    us = factors[0]
+    u, s, _ = jnp.linalg.svd(us, full_matrices=False)
+    u, s = _truncate(u, s, rank=maxrank, rtol=rtol, safetyshift=0)
+    U = _wrap(u, 0 if a.split == 0 else None, a)
+    if not compute_sv:
+        return U, _wrap(s, None, a)
+    # V = A^T U diag(1/s)
+    vt = (u.T @ ja) / s[:, None]
+    V = _wrap(vt.T, 0 if a.split == 1 else None, a)
+    # relative error estimate
+    err = jnp.linalg.norm(ja - (u * s) @ vt) / jnp.maximum(jnp.linalg.norm(ja), 1e-30)
+    return U, _wrap(s, None, a), V, float(err)
+
+
+def hsvd_rank(
+    a: DNDarray,
+    maxrank: int,
+    compute_sv: bool = False,
+    maxmergedim: Optional[int] = None,
+    safetyshift: int = 5,
+    silent: bool = True,
+):
+    """Hierarchical SVD truncated to ``maxrank`` (reference API)."""
+    res = hsvd(
+        a, maxrank=maxrank, maxmergedim=maxmergedim, safetyshift=safetyshift,
+        compute_sv=compute_sv, silent=silent,
+    )
+    if compute_sv:
+        U, s, V, err = res
+        k = min(maxrank, s.shape[0])
+        return U[:, :k], s[:k], V[:, :k], err
+    U, s = res
+    k = min(maxrank, s.shape[0])
+    return U[:, :k]
+
+
+def hsvd_rtol(
+    a: DNDarray,
+    rtol: float,
+    compute_sv: bool = False,
+    maxrank: Optional[int] = None,
+    maxmergedim: Optional[int] = None,
+    safetyshift: int = 5,
+    no_of_merges: Optional[int] = None,
+    silent: bool = True,
+):
+    """Hierarchical SVD truncated to relative tolerance ``rtol`` (reference API)."""
+    res = hsvd(
+        a, maxrank=maxrank, rtol=rtol, maxmergedim=maxmergedim, safetyshift=safetyshift,
+        compute_sv=compute_sv, silent=silent,
+    )
+    if compute_sv:
+        return res
+    U, s = res
+    return U
+
+
+def rsvd(
+    a: DNDarray,
+    rank: int,
+    n_oversamples: int = 10,
+    power_iter: int = 0,
+    qr_procs_to_merge: int = 2,
+):
+    """Randomized SVD (sketch + TSQR + small SVD) — reference ``rsvd``."""
+    sanitize_in(a)
+    from ..core import random as ht_random
+
+    m, n = a.shape
+    k = min(rank + n_oversamples, min(m, n))
+    omega = ht_random.randn(n, k, dtype=a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32)
+    y = a._jarray @ omega._jarray
+    for _ in range(power_iter):
+        y = a._jarray @ (a._jarray.T @ y)
+    q, _ = jnp.linalg.qr(y, mode="reduced")
+    b = q.T @ a._jarray  # (k, n)
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    r = min(rank, s.shape[0])
+    return (
+        _wrap(u[:, :r], 0 if a.split == 0 else None, a),
+        _wrap(s[:r], None, a),
+        _wrap(vt[:r].T, None, a),
+    )

@@ -1,0 +1,15 @@
+"""First-class distributed communication skeletons (SURVEY §5.7).
+
+The reference contains three reusable comm patterns buried inside ops:
+the **ring pipeline** (``spatial.cdist``), the **halo exchange**
+(``signal.convolve``) and the **all-to-all axis swap** (``resplit_``).
+Here they are public, named utilities built on ``shard_map`` +
+``lax.ppermute``/``lax.all_to_all`` — and they double as the building
+blocks of sequence/context parallelism (ring attention's KV rotation is
+exactly ``ring_map``) if transformer workloads are layered on top.
+"""
+
+from .ring import ring_map
+from .halo import halo_exchange, with_halos
+
+__all__ = ["ring_map", "halo_exchange", "with_halos"]

@@ -1,0 +1,56 @@
+"""Halo exchange primitive (reference skeleton: ``DNDarray.get_halo`` +
+``heat/core/signal.py::convolve``).
+
+Each shard receives ``halo_size`` boundary elements from both neighbors along
+the split axis (``lax.ppermute`` neighbor shifts over the ICI ring) and the
+caller computes on interior+halo — the stencil/context-parallel skeleton.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["halo_exchange", "with_halos"]
+
+
+def _take(arr, axis, start, stop):
+    idx = [slice(None)] * arr.ndim
+    idx[axis] = slice(start, stop)
+    return arr[tuple(idx)]
+
+
+def halo_exchange(block: jax.Array, halo_size: int, axis_name: str, size: int, split_axis: int = 0):
+    """Inside shard_map: return (halo_prev, halo_next) for this shard.
+
+    ``halo_prev`` is the last ``halo_size`` slice of the left neighbor (zeros
+    on shard 0), ``halo_next`` the first slice of the right neighbor (zeros on
+    the last shard) — matching the reference's boundary semantics.
+    """
+    tail = _take(block, split_axis, block.shape[split_axis] - halo_size, block.shape[split_axis])
+    head = _take(block, split_axis, 0, halo_size)
+    # send tail to right neighbor: j -> j+1 (shard 0 receives zeros)
+    halo_prev = lax.ppermute(tail, axis_name, [(j, j + 1) for j in range(size - 1)])
+    # send head to left neighbor: j -> j-1 (last shard receives zeros)
+    halo_next = lax.ppermute(head, axis_name, [(j, j - 1) for j in range(1, size)])
+    return halo_prev, halo_next
+
+
+def with_halos(array: jax.Array, halo_size: int, split_axis: int, comm) -> jax.Array:
+    """Global array → per-shard blocks extended with neighbor halos, returned
+    as a global array of shape ``gshape + 2*halo*size`` along ``split_axis``
+    (each shard's slab is ``[halo_prev | local | halo_next]``)."""
+    axis = comm.axis
+    size = comm.size
+
+    def shard_fn(blk):
+        prev, nxt = halo_exchange(blk, halo_size, axis, size, split_axis)
+        return jnp.concatenate([prev, blk, nxt], axis=split_axis)
+
+    mapped = comm.shard_map(
+        shard_fn, in_splits=((array.ndim, split_axis),), out_splits=(array.ndim, split_axis)
+    )
+    return mapped(array)

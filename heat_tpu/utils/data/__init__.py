@@ -1,0 +1,1 @@
+"""Data tools — populated in this round."""

@@ -1,0 +1,30 @@
+"""Test bootstrap: run everything on a virtual 8-device CPU mesh.
+
+The reference runs its suite under ``mpirun -n N`` for several N; the
+TPU-native analogue (SURVEY §4) is a multi-device CPU mesh in ONE process via
+``--xla_force_host_platform_device_count`` — same code paths as a real pod,
+only the transport differs.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def ht():
+    import heat_tpu
+
+    return heat_tpu
+
+
+# split sweep used across op tests (the reference's distributed-coverage trick)
+SPLITS_1D = [None, 0]
+SPLITS_2D = [None, 0, 1]

@@ -1,0 +1,136 @@
+"""DNDarray behavior tests (reference: heat/core/tests/test_dndarray.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestDNDarray(TestCase):
+    def test_attributes(self):
+        a = ht.zeros((16, 4), split=0)
+        assert a.shape == (16, 4)
+        assert a.gshape == (16, 4)
+        assert a.ndim == 2
+        assert a.size == 64
+        assert a.split == 0
+        assert a.dtype == ht.float32
+        assert a.nbytes == 64 * 4
+        lm = a.lshape_map()
+        assert lm.shape == (a.comm.size, 2)
+        assert lm[:, 0].sum() == 16
+
+    def test_astype(self):
+        a = ht.arange(8, split=0)
+        b = a.astype(ht.float32)
+        assert b.dtype == ht.float32
+        assert a.dtype == ht.int32  # copy semantics
+        a.astype(ht.float32, copy=False)
+        assert a.dtype == ht.float32
+
+    def test_item_scalar_conversions(self):
+        a = ht.array([5])
+        assert a.item() == 5
+        assert int(a) == 5
+        assert float(a) == 5.0
+        assert bool(ht.array([1]))
+        with pytest.raises(ValueError):
+            ht.arange(5).item()
+
+    def test_resplit_cycle(self):
+        data = np.arange(48.0, dtype=np.float32).reshape(8, 6)
+        a = ht.array(data, split=0)
+        a.resplit_(1)
+        assert a.split == 1
+        self.assert_array_equal(a, data)
+        a.resplit_(None)
+        assert a.split is None
+        self.assert_array_equal(a, data)
+        a.resplit_(0)
+        assert a.split == 0
+        self.assert_array_equal(a, data)
+
+    def test_getitem_basic(self):
+        data = np.arange(40.0, dtype=np.float32).reshape(8, 5)
+        for split in [None, 0, 1]:
+            a = ht.array(data, split=split)
+            self.assert_array_equal(a[2], data[2])
+            self.assert_array_equal(a[1:5], data[1:5])
+            self.assert_array_equal(a[:, 2], data[:, 2])
+            self.assert_array_equal(a[1:5, 2:4], data[1:5, 2:4])
+            self.assert_array_equal(a[-1], data[-1])
+            assert a[3, 4].item() == data[3, 4]
+
+    def test_getitem_split_semantics(self):
+        a = ht.array(np.arange(48).reshape(8, 6), split=0)
+        assert a[2].split is None  # split axis consumed
+        assert a[:, 2].split == 0  # split axis survives as axis 0
+        b = ht.array(np.arange(48).reshape(8, 6), split=1)
+        assert b[2].split == 0  # col split shifts into axis 0
+        assert b[:, :3].split == 1
+
+    def test_getitem_advanced(self):
+        data = np.arange(24).reshape(6, 4)
+        a = ht.array(data, split=0)
+        idx = ht.array([0, 2, 4])
+        self.assert_array_equal(a[idx], data[[0, 2, 4]])
+        mask = data[:, 0] > 8
+        self.assert_array_equal(a[ht.array(mask)], data[mask])
+
+    def test_setitem(self):
+        data = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+        for split in [None, 0, 1]:
+            a = ht.array(data, split=split)
+            a[0] = 99.0
+            expected = data.copy()
+            expected[0] = 99.0
+            self.assert_array_equal(a, expected)
+            assert a.split == split
+            a[2:4, 1] = -1.0
+            expected[2:4, 1] = -1.0
+            self.assert_array_equal(a, expected)
+
+    def test_iter_len(self):
+        a = ht.arange(6, split=0)
+        assert len(a) == 6
+        vals = [int(x.item()) for x in a]
+        assert vals == [0, 1, 2, 3, 4, 5]
+
+    def test_numpy_roundtrip(self):
+        data = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        a = ht.array(data, split=0)
+        np.testing.assert_array_equal(a.numpy(), data)
+        np.testing.assert_array_equal(np.asarray(a), data)
+
+    def test_T(self):
+        data = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+        a = ht.array(data, split=0)
+        t = a.T
+        assert t.split == 1
+        self.assert_array_equal(t, data.T)
+
+    def test_partitioned_protocol(self):
+        a = ht.zeros((16, 4), split=0)
+        p = a.__partitioned__
+        assert p["shape"] == (16, 4)
+        assert len(p["partitions"]) == a.comm.size
+        b = ht.core.factories.from_partitioned  # symbol exists
+
+    def test_jit_through_pytree(self):
+        import jax
+
+        a = ht.arange(16, dtype=ht.float32, split=0)
+
+        @jax.jit
+        def f(x):
+            return (x * 2 + 1).sum()
+
+        res = f(a)
+        assert float(res.item()) == float((np.arange(16) * 2 + 1).sum())
+
+    def test_fill_diagonal(self):
+        a = ht.zeros((5, 5), split=0)
+        a.fill_diagonal(3.0)
+        self.assert_array_equal(a, np.eye(5) * 3.0)

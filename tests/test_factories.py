@@ -1,0 +1,93 @@
+"""Factory tests (reference: heat/core/tests/test_factories.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+SPLITS_2D = [None, 0, 1]
+
+
+class TestFactories(TestCase):
+    def test_array_from_list(self):
+        for split in [None, 0]:
+            a = ht.array([1, 2, 3, 4], split=split)
+            assert a.shape == (4,)
+            assert a.split == split
+            self.assert_array_equal(a, np.array([1, 2, 3, 4]))
+
+    def test_array_from_numpy_2d(self):
+        data = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+        for split in SPLITS_2D:
+            a = ht.array(data, split=split)
+            self.assert_array_equal(a, data)
+            assert a.split == split
+
+    def test_array_dtype_resolution(self):
+        assert ht.array([1, 2]).dtype == ht.int32
+        assert ht.array([1.0, 2.0]).dtype == ht.float32
+        assert ht.array([True, False]).dtype == ht.bool
+        assert ht.array([1, 2], dtype=ht.float64).dtype in (ht.float64, ht.float32)
+
+    def test_array_is_split(self):
+        a = ht.array(np.ones((8, 3)), is_split=0)
+        assert a.split == 0
+
+    def test_zeros_ones_full(self):
+        for split in SPLITS_2D:
+            z = ht.zeros((8, 4), split=split)
+            self.assert_array_equal(z, np.zeros((8, 4)))
+            o = ht.ones((8, 4), split=split)
+            self.assert_array_equal(o, np.ones((8, 4)))
+            f = ht.full((8, 4), 3.5, split=split)
+            self.assert_array_equal(f, np.full((8, 4), 3.5))
+
+    def test_like_factories(self):
+        a = ht.ones((6, 4), split=0)
+        z = ht.zeros_like(a)
+        assert z.split == 0 and z.shape == (6, 4)
+        self.assert_array_equal(z, np.zeros((6, 4)))
+        o = ht.ones_like(z)
+        self.assert_array_equal(o, np.ones((6, 4)))
+        f = ht.full_like(a, 9.0)
+        self.assert_array_equal(f, np.full((6, 4), 9.0))
+
+    def test_arange(self):
+        self.assert_array_equal(ht.arange(10), np.arange(10))
+        self.assert_array_equal(ht.arange(2, 10), np.arange(2, 10))
+        self.assert_array_equal(ht.arange(2, 10, 3), np.arange(2, 10, 3))
+        a = ht.arange(16, split=0)
+        assert a.split == 0
+        self.assert_array_equal(ht.arange(0.0, 1.0, 0.25), np.arange(0.0, 1.0, 0.25))
+
+    def test_linspace_logspace(self):
+        self.assert_array_equal(ht.linspace(0, 1, 9), np.linspace(0, 1, 9, dtype=np.float32))
+        res, step = ht.linspace(0, 10, 11, retstep=True)
+        assert step == pytest.approx(1.0)
+        self.assert_array_equal(
+            ht.logspace(0, 3, 4), np.logspace(0, 3, 4, dtype=np.float32), rtol=1e-4
+        )
+
+    def test_eye(self):
+        self.assert_array_equal(ht.eye(4), np.eye(4))
+        self.assert_array_equal(ht.eye((4, 6), split=0), np.eye(4, 6))
+
+    def test_meshgrid(self):
+        x = ht.arange(4)
+        y = ht.arange(3)
+        mx, my = ht.meshgrid(x, y)
+        ex, ey = np.meshgrid(np.arange(4), np.arange(3))
+        self.assert_array_equal(mx, ex)
+        self.assert_array_equal(my, ey)
+
+    def test_empty(self):
+        e = ht.empty((4, 5), split=1)
+        assert e.shape == (4, 5)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            ht.array([1, 2], split=0, is_split=0)
+        with pytest.raises(ValueError):
+            ht.zeros((-1, 3))

@@ -1,0 +1,181 @@
+"""Ops surface tests: arithmetics/relational/logical/rounding/exp/trig
+(reference: per-module tests in heat/core/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestArithmetics(TestCase):
+    def test_binary_split_sweep(self):
+        data_a = np.arange(24.0, dtype=np.float32).reshape(6, 4) + 1
+        data_b = np.arange(24.0, dtype=np.float32)[::-1].reshape(6, 4) + 1
+        for split in [None, 0, 1]:
+            a = ht.array(data_a, split=split)
+            b = ht.array(data_b, split=split)
+            self.assert_array_equal(ht.add(a, b), data_a + data_b)
+            self.assert_array_equal(a - b, data_a - data_b)
+            self.assert_array_equal(a * b, data_a * data_b)
+            self.assert_array_equal(a / b, data_a / data_b, rtol=1e-5)
+            self.assert_array_equal(a // b, data_a // data_b)
+            self.assert_array_equal(a % b, data_a % data_b, rtol=1e-5)
+            self.assert_array_equal(a**2, data_a**2, rtol=1e-4)
+            assert (a + b).split == split
+
+    def test_scalar_operands(self):
+        data = np.arange(12.0, dtype=np.float32).reshape(3, 4)
+        a = ht.array(data, split=0)
+        self.assert_array_equal(a + 2, data + 2)
+        self.assert_array_equal(2 + a, 2 + data)
+        self.assert_array_equal(2 - a, 2 - data)
+        self.assert_array_equal(a * 0.5, data * 0.5)
+        self.assert_array_equal(1.0 / (a + 1), 1.0 / (data + 1), rtol=1e-5)
+
+    def test_mismatched_split_reconciliation(self):
+        data = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+        a = ht.array(data, split=0)
+        b = ht.array(data, split=1)
+        with pytest.warns(UserWarning):
+            c = a + b
+        self.assert_array_equal(c, data + data)
+        assert c.split == 0
+
+    def test_broadcasting(self):
+        a = ht.array(np.ones((4, 1), dtype=np.float32), split=0)
+        b = ht.array(np.arange(5.0, dtype=np.float32))
+        c = a + b
+        assert c.shape == (4, 5)
+        assert c.split == 0
+        self.assert_array_equal(c, np.ones((4, 1)) + np.arange(5.0))
+
+    def test_inplace(self):
+        data = np.arange(8.0, dtype=np.float32)
+        a = ht.array(data, split=0)
+        a += 1
+        self.assert_array_equal(a, data + 1)
+        a *= 2
+        self.assert_array_equal(a, (data + 1) * 2)
+
+    def test_reductions(self):
+        data = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+        for split in [None, 0, 1]:
+            a = ht.array(data, split=split)
+            assert a.sum().item() == pytest.approx(data.sum())
+            self.assert_array_equal(a.sum(axis=0), data.sum(axis=0))
+            self.assert_array_equal(a.sum(axis=1), data.sum(axis=1))
+            self.assert_array_equal(
+                a.sum(axis=0, keepdims=True), data.sum(axis=0, keepdims=True)
+            )
+            self.assert_array_equal(a.prod(axis=1), data.prod(axis=1), rtol=1e-3)
+        # split bookkeeping
+        a = ht.array(data, split=0)
+        assert a.sum(axis=0).split is None
+        assert a.sum(axis=1).split == 0
+        a = ht.array(data, split=1)
+        assert a.sum(axis=0).split == 0
+        assert a.sum(axis=1).split is None
+
+    def test_cumops(self):
+        data = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+        for split in [None, 0, 1]:
+            a = ht.array(data, split=split)
+            self.assert_array_equal(ht.cumsum(a, 0), data.cumsum(0))
+            self.assert_array_equal(ht.cumsum(a, 1), data.cumsum(1))
+            self.assert_array_equal(ht.cumprod(a + 1, 1), (data + 1).cumprod(1), rtol=1e-2)
+
+    def test_diff(self):
+        data = np.array([[1.0, 3, 6], [0, 5, 10]], dtype=np.float32)
+        a = ht.array(data, split=0)
+        self.assert_array_equal(ht.diff(a, axis=1), np.diff(data, axis=1))
+
+    def test_bitwise(self):
+        x = np.array([0b1100, 0b1010], dtype=np.int32)
+        y = np.array([0b1010, 0b0110], dtype=np.int32)
+        a, b = ht.array(x), ht.array(y)
+        self.assert_array_equal(a & b, x & y)
+        self.assert_array_equal(a | b, x | y)
+        self.assert_array_equal(a ^ b, x ^ y)
+        self.assert_array_equal(~a, ~x)
+        self.assert_array_equal(a << 1, x << 1)
+        self.assert_array_equal(a >> 1, x >> 1)
+
+    def test_nan_ops(self):
+        data = np.array([1.0, np.nan, 3.0], dtype=np.float32)
+        a = ht.array(data)
+        assert ht.nansum(a).item() == pytest.approx(4.0)
+
+
+class TestRelationalLogical(TestCase):
+    def test_comparisons(self):
+        x = np.array([1.0, 2, 3], dtype=np.float32)
+        y = np.array([3.0, 2, 1], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        self.assert_array_equal(a == b, x == y)
+        self.assert_array_equal(a != b, x != y)
+        self.assert_array_equal(a < b, x < y)
+        self.assert_array_equal(a <= b, x <= y)
+        self.assert_array_equal(a > b, x > y)
+        self.assert_array_equal(a >= b, x >= y)
+
+    def test_equal_allclose(self):
+        a = ht.arange(10, split=0)
+        assert ht.equal(a, a)
+        assert not ht.equal(a, a + 1)
+        assert ht.allclose(a.astype(ht.float32), a.astype(ht.float32) + 1e-8)
+
+    def test_all_any(self):
+        data = np.array([[True, True], [True, False]])
+        for split in [None, 0, 1]:
+            a = ht.array(data, split=split)
+            assert not a.all().item()
+            assert a.any().item()
+            self.assert_array_equal(ht.all(a, axis=0), data.all(axis=0))
+            self.assert_array_equal(ht.any(a, axis=1), data.any(axis=1))
+
+    def test_isnan_isinf(self):
+        data = np.array([1.0, np.nan, np.inf, -np.inf], dtype=np.float32)
+        a = ht.array(data)
+        self.assert_array_equal(ht.isnan(a), np.isnan(data))
+        self.assert_array_equal(ht.isinf(a), np.isinf(data))
+        self.assert_array_equal(ht.isfinite(a), np.isfinite(data))
+
+
+class TestUnaryOps(TestCase):
+    def test_rounding(self):
+        data = np.array([-1.7, -0.2, 0.2, 1.7], dtype=np.float32)
+        a = ht.array(data, split=0)
+        self.assert_array_equal(ht.abs(a), np.abs(data))
+        self.assert_array_equal(ht.ceil(a), np.ceil(data))
+        self.assert_array_equal(ht.floor(a), np.floor(data))
+        self.assert_array_equal(ht.trunc(a), np.trunc(data))
+        self.assert_array_equal(ht.round(a), np.round(data))
+        self.assert_array_equal(ht.sign(a), np.sign(data))
+        self.assert_array_equal(ht.clip(a, -1, 1), np.clip(data, -1, 1))
+
+    def test_exponential(self):
+        data = np.array([0.5, 1.0, 2.0], dtype=np.float32)
+        a = ht.array(data, split=0)
+        self.assert_array_equal(ht.exp(a), np.exp(data), rtol=1e-5)
+        self.assert_array_equal(ht.log(a), np.log(data), rtol=1e-5)
+        self.assert_array_equal(ht.sqrt(a), np.sqrt(data), rtol=1e-5)
+        self.assert_array_equal(ht.square(a), np.square(data), rtol=1e-5)
+        self.assert_array_equal(ht.log1p(a), np.log1p(data), rtol=1e-5)
+
+    def test_trig(self):
+        data = np.linspace(-1.0, 1.0, 7).astype(np.float32)
+        a = ht.array(data, split=0)
+        self.assert_array_equal(ht.sin(a), np.sin(data), rtol=1e-5)
+        self.assert_array_equal(ht.cos(a), np.cos(data), rtol=1e-5)
+        self.assert_array_equal(ht.tanh(a), np.tanh(data), rtol=1e-5)
+        self.assert_array_equal(ht.arcsin(a), np.arcsin(data), rtol=1e-4)
+
+    def test_complex(self):
+        data = np.array([1 + 2j, 3 - 4j], dtype=np.complex64)
+        a = ht.array(data)
+        self.assert_array_equal(a.real, data.real)
+        self.assert_array_equal(a.imag, data.imag)
+        self.assert_array_equal(ht.conj(a), np.conj(data))
+        self.assert_array_equal(ht.angle(a), np.angle(data), rtol=1e-5)
