@@ -1,1 +1,3 @@
-"""Placeholder — populated in this round."""
+"""Classification estimators (reference: ``heat/classification/``)."""
+
+from .kneighborsclassifier import KNeighborsClassifier

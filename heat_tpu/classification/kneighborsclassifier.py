@@ -1,0 +1,49 @@
+"""k-nearest-neighbors classifier (reference:
+``heat/classification/kneighborsclassifier.py``): brute-force cdist + top-k.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["KNeighborsClassifier"]
+
+
+class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
+    """Brute-force kNN over the distributed distance matrix."""
+
+    def __init__(self, n_neighbors: int = 5):
+        self.n_neighbors = n_neighbors
+        self.x_train = None
+        self.y_train = None
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        self.x_train = x
+        self.y_train = y
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        if self.x_train is None:
+            raise RuntimeError("fit must be called before predict")
+        jx, jt = x._jarray, self.x_train._jarray
+        jy = self.y_train._jarray.reshape(-1)
+        # squared distances (MXU form) + negative top-k = k nearest
+        d2 = (
+            jnp.sum(jx * jx, axis=1, keepdims=True)
+            + jnp.sum(jt * jt, axis=1)[None, :]
+            - 2.0 * jx @ jt.T
+        )
+        _, idx = jax.lax.top_k(-d2, self.n_neighbors)  # (n, k)
+        votes = jy[idx]  # (n, k)
+        classes = jnp.unique(jy)
+        counts = jnp.sum(votes[:, :, None] == classes[None, None, :], axis=1)  # (n, c)
+        pred = classes[jnp.argmax(counts, axis=1)]
+        lab = x.comm.shard(pred, x.split)
+        return DNDarray(
+            lab, tuple(lab.shape), types.canonical_heat_type(lab.dtype), x.split, x.device, x.comm, True
+        )

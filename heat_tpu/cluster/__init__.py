@@ -1,1 +1,7 @@
-"""Placeholder — populated in this round."""
+"""Clustering estimators (reference: ``heat/cluster/``)."""
+
+from .kmeans import KMeans
+from .kmedians import KMedians
+from .kmedoids import KMedoids
+from .batchparallelclustering import BatchParallelKMeans, BatchParallelKMedians
+from .spectral import Spectral

@@ -1,0 +1,169 @@
+"""Shared k-clustering skeleton (reference: ``heat/cluster/_kcluster.py``).
+
+Init strategies and the E/M fit loop shell.  The per-iteration compute
+(distances → assignment → masked aggregation) is one jitted XLA program; the
+reference's two Allreduces per iteration (SURVEY §3.4) are implicit in the
+sharded segment-sum.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import factories, types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+
+
+class _KCluster(ClusteringMixin, BaseEstimator):
+    """Base class for KMeans/KMedians/KMedoids."""
+
+    def __init__(self, metric: Callable, n_clusters: int, init, max_iter: int, tol: float, random_state: Optional[int]):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+        self._metric = metric
+
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    @property
+    def functional_value_(self) -> float:
+        return self._inertia
+
+    # ------------------------------------------------------------------ #
+    def _initialize_cluster_centers(self, x: DNDarray, oversampling: float = 1.0, iter_multiplier: float = 1.0):
+        """Center init: 'random', 'kmeans++' (distributed D² sampling), or
+        a user-provided (k, d) DNDarray/array."""
+        k = self.n_clusters
+        jx = x._jarray
+        n, d = x.shape
+        key = jax.random.key(self.random_state if self.random_state is not None else 0)
+
+        if isinstance(self.init, DNDarray) or isinstance(self.init, (np.ndarray, jnp.ndarray)):
+            centers = self.init._jarray if isinstance(self.init, DNDarray) else jnp.asarray(self.init)
+            if centers.shape != (k, d):
+                raise ValueError(f"initial centers must have shape {(k, d)}, got {centers.shape}")
+            self._cluster_centers = factories.array(centers, device=x.device, comm=x.comm)
+            return
+
+        if self.init == "random":
+            idx = jax.random.choice(key, n, (k,), replace=False)
+            centers = jx[idx]
+        elif self.init in ("kmeans++", "probability_based"):
+            # greedy D² sampling: draw several candidates ∝ D², keep the one
+            # minimizing the resulting potential (the reference's Allreduce of
+            # the D² mass is XLA's implicit psum over the sharded sample axis)
+            n_trials = 2 + int(np.ceil(np.log2(max(k, 2))))
+
+            def body(i, state):
+                centers, d2, key = state
+                key, sub = jax.random.split(key)
+                probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
+                cand_idx = jax.random.choice(sub, n, (n_trials,), p=probs)
+                cand = jx[cand_idx]  # (t, d)
+                cd2 = jnp.sum((jx[:, None, :] - cand[None, :, :]) ** 2, axis=-1)  # (n, t)
+                pots = jnp.sum(jnp.minimum(d2[:, None], cd2), axis=0)  # (t,)
+                best = jnp.argmin(pots)
+                nxt = cand[best]
+                d2 = jnp.minimum(d2, cd2[:, best])
+                return centers.at[i].set(nxt), d2, key
+
+            key, sub = jax.random.split(key)
+            first = jx[jax.random.randint(sub, (), 0, n)]
+            centers0 = jnp.zeros((k, d), jx.dtype).at[0].set(first)
+            d2_0 = jnp.sum((jx - first[None, :]) ** 2, axis=-1)
+            centers, _, _ = jax.lax.fori_loop(1, k, body, (centers0, d2_0, key))
+        elif self.init == "batchparallel":
+            centers = jx[jax.random.choice(key, n, (k,), replace=False)]
+        else:
+            raise ValueError(f"Unknown init strategy {self.init!r}")
+        centers = x.comm.shard(centers, None)
+        self._cluster_centers = DNDarray(
+            centers, (k, d), x.dtype, None, x.device, x.comm, True
+        )
+
+    def _assign(self, jx, centers):
+        """E-step: squared distances + argmin, fused on the MXU."""
+        xx = jnp.sum(jx * jx, axis=1, keepdims=True)
+        cc = jnp.sum(centers * centers, axis=1)[None, :]
+        d2 = xx + cc - 2.0 * (jx @ centers.T)
+        return jnp.argmin(d2, axis=1), jnp.min(jnp.maximum(d2, 0.0), axis=1)
+
+    def _update(self, jx, labels, centers):
+        raise NotImplementedError()
+
+    def fit(self, x: DNDarray):
+        """Lloyd-style iteration; each step is one compiled sharded program."""
+        from ..core.sanitation import sanitize_in
+
+        sanitize_in(x)
+        self._initialize_cluster_centers(x)
+        jx = x._jarray
+        centers = self._cluster_centers._jarray
+
+        @jax.jit
+        def step(centers):
+            labels, d2 = self._assign(jx, centers)
+            new_centers = self._update(jx, labels, centers)
+            return new_centers, labels, jnp.sum(d2)
+
+        n_iter = 0
+        for it in range(self.max_iter):
+            new_centers, _, _ = step(centers)
+            shift = float(jnp.max(jnp.abs(new_centers - centers)))
+            centers = new_centers
+            n_iter = it + 1
+            if shift <= self.tol:
+                break
+        # final assignment against the centers actually stored, so that
+        # labels_/inertia_ are consistent with cluster_centers_ (and defined
+        # even for max_iter=0)
+        labels, d2 = self._assign(jx, centers)
+        inertia = jnp.sum(d2)
+
+        self._cluster_centers = DNDarray(
+            x.comm.shard(centers, None), tuple(centers.shape), x.dtype, None, x.device, x.comm, True
+        )
+        lab = x.comm.shard(labels, x.split)
+        self._labels = DNDarray(
+            lab, tuple(lab.shape), types.canonical_heat_type(lab.dtype), x.split, x.device, x.comm, True
+        )
+        self._inertia = float(inertia)
+        self._n_iter = n_iter
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Nearest-center assignment for new data."""
+        from ..core.sanitation import sanitize_in
+
+        sanitize_in(x)
+        labels, _ = self._assign(x._jarray, self._cluster_centers._jarray)
+        lab = x.comm.shard(labels, x.split)
+        return DNDarray(
+            lab, tuple(lab.shape), types.canonical_heat_type(lab.dtype), x.split, x.device, x.comm, True
+        )

@@ -1,0 +1,46 @@
+"""KMeans (reference: ``heat/cluster/kmeans.py``; BASELINE workload, SURVEY §3.4).
+
+M-step = segment-sum over the sharded sample axis; XLA emits the two small
+Allreduces (sums, counts) the reference issues by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ._kcluster import _KCluster
+
+__all__ = ["KMeans"]
+
+
+class KMeans(_KCluster):
+    """K-Means clustering with the reference's API.
+
+    Parameters mirror ``heat.cluster.KMeans``: n_clusters, init
+    ('kmeans++' | 'random' | array), max_iter, tol, random_state.
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, object] = "kmeans++",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__(
+            metric=lambda x, y: None, n_clusters=n_clusters, init=init,
+            max_iter=max_iter, tol=tol, random_state=random_state,
+        )
+
+    def _update(self, jx, labels, centers):
+        k = self.n_clusters
+        onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(jx.dtype)
+        sums = onehot.T @ jx          # (k, d) — MXU GEMM + implicit Allreduce
+        counts = jnp.sum(onehot, axis=0)  # (k,)  — implicit Allreduce
+        safe = jnp.maximum(counts, 1.0)
+        new = sums / safe[:, None]
+        # empty clusters keep their previous center (reference behavior)
+        return jnp.where(counts[:, None] > 0, new, centers)

@@ -1,0 +1,50 @@
+"""KMedians (reference: ``heat/cluster/kmedians.py``).
+
+M-step: per-cluster coordinate-wise median.  The reference runs a
+distributed sort per cluster; here a masked median over the global array
+(vmapped over clusters) — the sort is XLA's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ._kcluster import _KCluster
+
+__all__ = ["KMedians"]
+
+
+def _masked_median(jx, mask):
+    """Median over rows where mask, per column (NaN-masked global median)."""
+    filled = jnp.where(mask[:, None], jx, jnp.nan)
+    return jnp.nanmedian(filled, axis=0)
+
+
+class KMedians(_KCluster):
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, object] = "kmedians++",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        if init == "kmedians++":
+            init = "kmeans++"
+        super().__init__(
+            metric=lambda x, y: None, n_clusters=n_clusters, init=init,
+            max_iter=max_iter, tol=tol, random_state=random_state,
+        )
+
+    def _update(self, jx, labels, centers):
+        k = self.n_clusters
+
+        def one(c):
+            m = labels == c
+            med = _masked_median(jx, m)
+            return jnp.where(jnp.any(m), med, centers[c])
+
+        return jax.vmap(one)(jnp.arange(k))

@@ -1,0 +1,53 @@
+"""KMedoids (reference: ``heat/cluster/kmedoids.py``).
+
+The reference's variant: compute the coordinate-wise median of each cluster,
+then snap to the nearest actual data point (keeps medoids ∈ X without the
+O(n²) pairwise search).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ._kcluster import _KCluster
+from .kmedians import _masked_median
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, object] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__(
+            metric=lambda x, y: None, n_clusters=n_clusters, init=init,
+            max_iter=max_iter, tol=0.0, random_state=random_state,
+        )
+
+    def _update(self, jx, labels, centers):
+        k = self.n_clusters
+
+        def one(c):
+            m = labels == c
+            med = _masked_median(jx, m)
+            med = jnp.where(jnp.any(m), med, centers[c])
+            # snap to nearest member of the cluster (inf distance outside it)
+            d2 = jnp.sum((jx - med[None, :]) ** 2, axis=1)
+            d2 = jnp.where(m, d2, jnp.inf)
+            idx = jnp.argmin(d2)
+            return jnp.where(jnp.any(m), jx[idx], centers[c])
+
+        return jax.vmap(one)(jnp.arange(k))
+
+    def fit(self, x):
+        # medoids move discretely; tol-based stop would trigger immediately on
+        # a repeated medoid, which is exactly the convergence criterion
+        self.tol = 1e-12
+        return super().fit(x)

@@ -1,0 +1,100 @@
+"""Spectral clustering (reference: ``heat/cluster/spectral.py``).
+
+RBF affinity → normalized Laplacian → Lanczos eigenvectors → KMeans in the
+embedding space, all through the public array API (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import spatial
+from ..core import types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+from ..graph.laplacian import Laplacian
+from ..linalg.solver import lanczos
+from .kmeans import KMeans
+
+__all__ = ["Spectral"]
+
+
+class Spectral(ClusteringMixin, BaseEstimator):
+    """Spectral clustering on the normalized graph Laplacian."""
+
+    def __init__(
+        self,
+        n_clusters: Optional[int] = None,
+        gamma: float = 1.0,
+        metric: str = "rbf",
+        laplacian: str = "fully_connected",
+        threshold: float = 1.0,
+        boundary: str = "upper",
+        n_lanczos: int = 300,
+        assign_labels: str = "kmeans",
+        **params,
+    ):
+        self.n_clusters = n_clusters
+        self.gamma = gamma
+        self.metric = metric
+        self.n_lanczos = n_lanczos
+        self.assign_labels = assign_labels
+
+        sigma = jnp.sqrt(1.0 / (2.0 * gamma)) if gamma > 0 else 1.0
+        if metric == "rbf":
+            sim = lambda x: spatial.rbf(x, sigma=float(sigma), quadratic_expansion=True)
+        elif metric == "euclidean":
+            sim = lambda x: spatial.cdist(x, quadratic_expansion=True)
+        else:
+            raise NotImplementedError(f"metric {metric!r} not supported")
+        self._laplacian = Laplacian(sim, definition="norm_sym", mode=laplacian,
+                                    threshold_key=boundary, threshold_value=threshold)
+        self._cluster = KMeans(n_clusters=n_clusters or 8, init="kmeans++", random_state=0)
+        self._labels = None
+
+    @property
+    def labels_(self):
+        return self._labels
+
+    def _spectral_embedding(self, x: DNDarray):
+        L = self._laplacian.construct(x)
+        m = min(self.n_lanczos, L.shape[0])
+        V, T = lanczos(L, m)
+        evals, evecs = jnp.linalg.eigh(T._jarray)
+        # eigenvectors of L ≈ V @ evecs; take the k smallest eigenvalues
+        components = V._jarray @ evecs
+        return evals, components
+
+    def fit(self, x: DNDarray):
+        evals, components = self._spectral_embedding(x)
+        k = self.n_clusters
+        if k is None:
+            # largest eigen-gap heuristic (reference behavior)
+            diffs = jnp.diff(evals)
+            k = int(jnp.argmax(diffs).item()) + 1
+            k = max(k, 2)
+            self._cluster.n_clusters = k
+        emb = components[:, :k]
+        embedding = DNDarray(
+            x.comm.shard(emb, x.split), tuple(emb.shape),
+            types.canonical_heat_type(emb.dtype), x.split, x.device, x.comm, True,
+        )
+        self._cluster.fit(embedding)
+        self._labels = self._cluster.labels_
+        self._embedding = embedding
+        self._fit_shape = tuple(x.shape)
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Labels of the FITTED data (spectral embeddings do not extend to
+        out-of-sample points; the reference has the same restriction)."""
+        if self._labels is None:
+            raise RuntimeError("fit must be called before predict")
+        if tuple(x.shape) != self._fit_shape:
+            raise NotImplementedError(
+                "Spectral clustering cannot label out-of-sample points; "
+                "re-fit on the combined data instead"
+            )
+        return self._labels

@@ -1,1 +1,4 @@
-"""Placeholder — populated in this round."""
+"""Matrix decompositions (reference: ``heat/decomposition/``)."""
+
+from .pca import PCA, IncrementalPCA
+from .dmd import DMD

@@ -1,1 +1,3 @@
-"""Placeholder — populated in this round."""
+"""Graph analytics (reference: ``heat/graph/``)."""
+
+from .laplacian import Laplacian

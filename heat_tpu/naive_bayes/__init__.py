@@ -1,1 +1,3 @@
-"""Placeholder — populated in this round."""
+"""Naive Bayes estimators (reference: ``heat/naive_bayes/``)."""
+
+from .gaussianNB import GaussianNB

@@ -1,1 +1,3 @@
-"""Placeholder — populated in this round."""
+"""Preprocessing scalers (reference: ``heat/preprocessing/``)."""
+
+from .preprocessing import StandardScaler, MinMaxScaler, MaxAbsScaler, RobustScaler, Normalizer

@@ -1,0 +1,195 @@
+"""Data scalers (reference: ``heat/preprocessing/preprocessing.py``).
+
+All statistics are distributed global reductions (implicit Allreduce over
+the split axis); the transforms are elementwise and fuse into one kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, TransformMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["StandardScaler", "MinMaxScaler", "MaxAbsScaler", "RobustScaler", "Normalizer"]
+
+
+def _wrap_like(jarr, split, proto: DNDarray) -> DNDarray:
+    if split is not None and split >= jarr.ndim:
+        split = None
+    jarr = proto.comm.shard(jarr, split)
+    return DNDarray(
+        jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
+    )
+
+
+class StandardScaler(TransformMixin, BaseEstimator):
+    """Zero-mean unit-variance scaling (per feature)."""
+
+    def __init__(self, copy: bool = True, with_mean: bool = True, with_std: bool = True):
+        self.copy = copy
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.mean_ = None
+        self.var_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray, sample_weight=None) -> "StandardScaler":
+        j = x._jarray
+        mean = jnp.mean(j, axis=0)
+        var = jnp.var(j, axis=0)
+        scale = jnp.where(var > 1e-30, jnp.sqrt(var), 1.0)
+        self.mean_ = _wrap_like(mean, None, x)
+        self.var_ = _wrap_like(var, None, x)
+        self.scale_ = _wrap_like(scale, None, x)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        j = x._jarray
+        if self.with_mean:
+            j = j - self.mean_._jarray[None, :]
+        if self.with_std:
+            j = j / self.scale_._jarray[None, :]
+        return _wrap_like(j, x.split, x)
+
+    def inverse_transform(self, x: DNDarray) -> DNDarray:
+        j = x._jarray
+        if self.with_std:
+            j = j * self.scale_._jarray[None, :]
+        if self.with_mean:
+            j = j + self.mean_._jarray[None, :]
+        return _wrap_like(j, x.split, x)
+
+
+class MinMaxScaler(TransformMixin, BaseEstimator):
+    """Scale features to a given range (default [0, 1])."""
+
+    def __init__(self, feature_range: Tuple[float, float] = (0.0, 1.0), copy: bool = True, clip: bool = False):
+        if feature_range[0] >= feature_range[1]:
+            raise ValueError("Minimum of feature_range must be smaller than maximum")
+        self.feature_range = feature_range
+        self.copy = copy
+        self.clip = clip
+        self.data_min_ = None
+        self.data_max_ = None
+        self.scale_ = None
+        self.min_ = None
+
+    def fit(self, x: DNDarray) -> "MinMaxScaler":
+        j = x._jarray
+        dmin = jnp.min(j, axis=0)
+        dmax = jnp.max(j, axis=0)
+        rng = jnp.where(dmax > dmin, dmax - dmin, 1.0)
+        lo, hi = self.feature_range
+        scale = (hi - lo) / rng
+        self.data_min_ = _wrap_like(dmin, None, x)
+        self.data_max_ = _wrap_like(dmax, None, x)
+        self.data_range_ = _wrap_like(rng, None, x)
+        self.scale_ = _wrap_like(scale, None, x)
+        self.min_ = _wrap_like(lo - dmin * scale, None, x)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        j = x._jarray * self.scale_._jarray[None, :] + self.min_._jarray[None, :]
+        if self.clip:
+            j = jnp.clip(j, self.feature_range[0], self.feature_range[1])
+        return _wrap_like(j, x.split, x)
+
+    def inverse_transform(self, x: DNDarray) -> DNDarray:
+        j = (x._jarray - self.min_._jarray[None, :]) / self.scale_._jarray[None, :]
+        return _wrap_like(j, x.split, x)
+
+
+class MaxAbsScaler(TransformMixin, BaseEstimator):
+    """Scale each feature by its maximum absolute value (sparse-safe)."""
+
+    def __init__(self, copy: bool = True):
+        self.copy = copy
+        self.max_abs_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray) -> "MaxAbsScaler":
+        j = x._jarray
+        ma = jnp.max(jnp.abs(j), axis=0)
+        self.max_abs_ = _wrap_like(ma, None, x)
+        self.scale_ = _wrap_like(jnp.where(ma > 0, ma, 1.0), None, x)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        return _wrap_like(x._jarray / self.scale_._jarray[None, :], x.split, x)
+
+    def inverse_transform(self, x: DNDarray) -> DNDarray:
+        return _wrap_like(x._jarray * self.scale_._jarray[None, :], x.split, x)
+
+
+class RobustScaler(TransformMixin, BaseEstimator):
+    """Median/IQR scaling (distributed percentiles, SURVEY §2.4)."""
+
+    def __init__(self, with_centering: bool = True, with_scaling: bool = True,
+                 quantile_range: Tuple[float, float] = (25.0, 75.0), copy: bool = True,
+                 unit_variance: bool = False):
+        lo, hi = quantile_range
+        if not 0 <= lo <= hi <= 100:
+            raise ValueError(f"Invalid quantile range {quantile_range}")
+        if unit_variance:
+            raise NotImplementedError("unit_variance=True not supported (reference parity)")
+        self.with_centering = with_centering
+        self.with_scaling = with_scaling
+        self.quantile_range = quantile_range
+        self.copy = copy
+        self.unit_variance = unit_variance
+        self.center_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray) -> "RobustScaler":
+        j = x._jarray.astype(jnp.float32)
+        lo, hi = self.quantile_range
+        if self.with_centering:
+            self.center_ = _wrap_like(jnp.median(j, axis=0), None, x)
+        if self.with_scaling:
+            q = jnp.percentile(j, jnp.asarray([lo, hi]), axis=0)
+            iqr = q[1] - q[0]
+            self.scale_ = _wrap_like(jnp.where(iqr > 0, iqr, 1.0), None, x)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        j = x._jarray
+        if self.with_centering:
+            j = j - self.center_._jarray[None, :]
+        if self.with_scaling:
+            j = j / self.scale_._jarray[None, :]
+        return _wrap_like(j, x.split, x)
+
+    def inverse_transform(self, x: DNDarray) -> DNDarray:
+        j = x._jarray
+        if self.with_scaling:
+            j = j * self.scale_._jarray[None, :]
+        if self.with_centering:
+            j = j + self.center_._jarray[None, :]
+        return _wrap_like(j, x.split, x)
+
+
+class Normalizer(TransformMixin, BaseEstimator):
+    """Row-wise normalization to unit norm ('l1' | 'l2' | 'max') — stateless."""
+
+    def __init__(self, norm: str = "l2", copy: bool = True):
+        if norm not in ("l1", "l2", "max"):
+            raise NotImplementedError(f"Unsupported norm {norm!r}")
+        self.norm = norm
+        self.copy = copy
+
+    def fit(self, x: DNDarray) -> "Normalizer":
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        j = x._jarray
+        if self.norm == "l1":
+            n = jnp.sum(jnp.abs(j), axis=1, keepdims=True)
+        elif self.norm == "l2":
+            n = jnp.sqrt(jnp.sum(j * j, axis=1, keepdims=True))
+        else:
+            n = jnp.max(jnp.abs(j), axis=1, keepdims=True)
+        return _wrap_like(j / jnp.where(n > 0, n, 1.0), x.split, x)

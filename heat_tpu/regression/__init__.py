@@ -1,1 +1,3 @@
-"""Placeholder — populated in this round."""
+"""Regression estimators (reference: ``heat/regression/``)."""
+
+from .lasso import Lasso

@@ -1,1 +1,4 @@
-"""Placeholder — populated in this round."""
+"""Distance computations (reference: ``heat/spatial/``)."""
+
+from .distance import *
+from . import distance

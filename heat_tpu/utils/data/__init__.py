@@ -1,1 +1,5 @@
-"""Data tools — populated in this round."""
+"""Data utilities (reference: ``heat/utils/data/``)."""
+
+from . import matrixgallery
+from . import spherical
+from .spherical import create_spherical_dataset, create_clusters

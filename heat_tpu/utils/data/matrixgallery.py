@@ -1,0 +1,62 @@
+"""Test-matrix gallery (reference: ``heat/utils/data/matrixgallery.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core import factories, types
+from ...core.dndarray import DNDarray
+
+__all__ = ["hermitian", "parter", "random_known_rank", "random_known_singularvalues"]
+
+
+def parter(n: int, split=None, device=None, comm=None, dtype=types.float32) -> DNDarray:
+    """The Parter matrix: A[i,j] = 1/(i−j+0.5) — a Cauchy matrix with
+    singular values clustered at π (reference parity)."""
+    i = jnp.arange(n, dtype=jnp.float32)
+    a = 1.0 / (i[:, None] - i[None, :] + 0.5)
+    return factories.array(a, split=split, device=device, comm=comm, dtype=dtype)
+
+
+def hermitian(n: int, split=None, device=None, comm=None, dtype=types.complex64,
+              positive_definite: bool = False, random_state: int = 0) -> DNDarray:
+    """Random (complex) Hermitian n×n matrix; optionally positive definite."""
+    key = jax.random.key(random_state)
+    k1, k2 = jax.random.split(key)
+    dt = types.canonical_heat_type(dtype)
+    if types.heat_type_is_complexfloating(dt):
+        a = jax.random.normal(k1, (n, n)) + 1j * jax.random.normal(k2, (n, n))
+    else:
+        a = jax.random.normal(k1, (n, n))
+    if positive_definite:
+        h = a @ jnp.conj(a.T) + n * jnp.eye(n, dtype=a.dtype)
+    else:
+        h = 0.5 * (a + jnp.conj(a.T))
+    return factories.array(h.astype(dt.jax_dtype()), split=split, device=device, comm=comm)
+
+
+def random_known_singularvalues(
+    m: int, n: int, singular_values, split=None, device=None, comm=None,
+    dtype=types.float32, random_state: int = 1
+) -> Tuple[DNDarray, Tuple]:
+    """Random matrix with prescribed singular values (returns (A, (U, s, V)))."""
+    sv = jnp.asarray(singular_values, dtype=jnp.float32)
+    k = sv.shape[0]
+    key = jax.random.key(random_state)
+    k1, k2 = jax.random.split(key)
+    u, _ = jnp.linalg.qr(jax.random.normal(k1, (m, k)))
+    v, _ = jnp.linalg.qr(jax.random.normal(k2, (n, k)))
+    a = (u * sv[None, :]) @ v.T
+    A = factories.array(a, split=split, device=device, comm=comm, dtype=dtype)
+    return A, (factories.array(u), factories.array(sv), factories.array(v))
+
+
+def random_known_rank(
+    m: int, n: int, r: int, split=None, device=None, comm=None, dtype=types.float32
+) -> Tuple[DNDarray, Tuple]:
+    """Random matrix of known rank r (uniform-decaying singular values)."""
+    sv = jnp.linspace(1.0, 0.1, r)
+    return random_known_singularvalues(m, n, sv, split=split, device=device, comm=comm, dtype=dtype)

@@ -1,0 +1,86 @@
+"""Clustering estimator tests (reference: heat/cluster/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    return ht.utils.data.create_spherical_dataset(128)
+
+
+class TestKMeans(TestCase):
+    def test_fit_quality(self, blobs):
+        km = ht.cluster.KMeans(n_clusters=4, random_state=0).fit(blobs)
+        centers = np.sort(km.cluster_centers_.numpy().mean(axis=1))
+        np.testing.assert_allclose(centers, [-6, -2, 2, 6], atol=0.5)
+        assert km.labels_.shape == (blobs.shape[0],)
+        assert km.labels_.split == 0
+        assert km.inertia_ > 0
+        assert km.n_iter_ >= 1
+
+    def test_predict(self, blobs):
+        km = ht.cluster.KMeans(n_clusters=4, random_state=0).fit(blobs)
+        pred = km.predict(blobs)
+        np.testing.assert_array_equal(pred.numpy(), km.labels_.numpy())
+
+    def test_init_variants(self, blobs):
+        for init in ["random", "kmeans++"]:
+            km = ht.cluster.KMeans(n_clusters=4, init=init, random_state=1).fit(blobs)
+            assert km.cluster_centers_.shape == (4, 3)
+        arr_init = blobs.numpy()[:4]
+        km = ht.cluster.KMeans(n_clusters=4, init=ht.array(arr_init)).fit(blobs)
+        assert km.cluster_centers_.shape == (4, 3)
+        with pytest.raises(ValueError):
+            ht.cluster.KMeans(n_clusters=4, init="bogus").fit(blobs)
+
+    def test_get_set_params(self):
+        km = ht.cluster.KMeans(n_clusters=4)
+        p = km.get_params()
+        assert p["n_clusters"] == 4
+        km.set_params(n_clusters=8)
+        assert km.n_clusters == 8
+
+
+class TestKMediansMedoids(TestCase):
+    def test_kmedians(self, blobs):
+        km = ht.cluster.KMedians(n_clusters=4, random_state=1).fit(blobs)
+        centers = np.sort(km.cluster_centers_.numpy().mean(axis=1))
+        np.testing.assert_allclose(centers, [-6, -2, 2, 6], atol=0.5)
+
+    def test_kmedoids(self, blobs):
+        km = ht.cluster.KMedoids(n_clusters=4, random_state=1).fit(blobs)
+        centers = km.cluster_centers_.numpy()
+        # medoids must be actual data points
+        data = blobs.numpy()
+        for c in centers:
+            assert np.min(np.sum((data - c) ** 2, axis=1)) < 1e-10
+
+
+class TestBatchParallel(TestCase):
+    def test_bp_kmeans(self, blobs):
+        bp = ht.cluster.BatchParallelKMeans(n_clusters=4, random_state=1).fit(blobs)
+        centers = np.sort(bp.cluster_centers_.numpy().mean(axis=1))
+        np.testing.assert_allclose(centers, [-6, -2, 2, 6], atol=0.8)
+        assert bp.labels_.shape == (blobs.shape[0],)
+
+    def test_bp_kmedians(self, blobs):
+        bp = ht.cluster.BatchParallelKMedians(n_clusters=4, random_state=1).fit(blobs)
+        assert bp.cluster_centers_.shape == (4, 3)
+
+
+class TestSpectral(TestCase):
+    def test_spectral(self):
+        data = ht.utils.data.create_spherical_dataset(24)
+        sp = ht.cluster.Spectral(n_clusters=4, gamma=0.1, n_lanczos=48).fit(data)
+        labels = sp.labels_.numpy()
+        # clusters of 24 points each must be internally consistent
+        n = 24
+        for b in range(4):
+            blk = labels[b * n : (b + 1) * n]
+            vals, counts = np.unique(blk, return_counts=True)
+            assert counts.max() >= n * 0.75

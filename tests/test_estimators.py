@@ -1,0 +1,165 @@
+"""Estimator tests: PCA/DMD/Lasso/GaussianNB/KNN/scalers/Laplacian
+(reference: per-package tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+@pytest.fixture(scope="module")
+def regression_data():
+    rng = np.random.default_rng(0)
+    X = (rng.normal(size=(256, 8)) @ np.diag([5, 4, 3, 2, 1, 0.5, 0.2, 0.1])).astype(np.float32)
+    w = np.array([0.0, 2.0, 0.0, -3.0, 0.0, 1.5, 0.0, 0.0], dtype=np.float32)
+    y = X @ w + 0.01 * rng.normal(size=256).astype(np.float32)
+    return X, w, y
+
+
+class TestPCA(TestCase):
+    def test_solvers_match_sklearn(self, regression_data):
+        X, _, _ = regression_data
+        from sklearn.decomposition import PCA as SKPCA
+
+        sk = SKPCA(n_components=3).fit(X)
+        for solver in ["full", "hierarchical", "randomized"]:
+            p = ht.decomposition.PCA(n_components=3, svd_solver=solver).fit(ht.array(X, split=0))
+            np.testing.assert_allclose(
+                p.singular_values_.numpy(), sk.singular_values_, rtol=2e-2
+            )
+            assert p.n_components_ == 3
+        p = ht.decomposition.PCA(n_components=3, svd_solver="full").fit(ht.array(X, split=0))
+        np.testing.assert_allclose(
+            p.explained_variance_.numpy(), sk.explained_variance_, rtol=2e-2
+        )
+
+    def test_transform_inverse(self, regression_data):
+        X, _, _ = regression_data
+        p = ht.decomposition.PCA(n_components=8, svd_solver="full").fit(ht.array(X, split=0))
+        t = p.transform(ht.array(X, split=0))
+        assert t.split == 0
+        back = p.inverse_transform(t)
+        np.testing.assert_allclose(back.numpy(), X, atol=1e-3)
+
+    def test_variance_fraction(self, regression_data):
+        X, _, _ = regression_data
+        p = ht.decomposition.PCA(n_components=0.95, svd_solver="full").fit(ht.array(X, split=0))
+        assert 1 <= p.n_components_ <= 8
+        assert p.total_explained_variance_ratio_ >= 0.95
+
+    def test_incremental(self, regression_data):
+        X, _, _ = regression_data
+        p = ht.decomposition.IncrementalPCA(n_components=3, batch_size=64).fit(ht.array(X, split=0))
+        from sklearn.decomposition import PCA as SKPCA
+
+        sk = SKPCA(n_components=3).fit(X)
+        np.testing.assert_allclose(p.singular_values_.numpy(), sk.singular_values_, rtol=0.1)
+
+
+class TestDMD(TestCase):
+    def test_linear_system_eigs(self):
+        rng = np.random.default_rng(1)
+        A = np.array([[0.9, 0.2], [0.0, 0.8]], dtype=np.float32)
+        states = [rng.normal(size=2).astype(np.float32)]
+        for _ in range(20):
+            states.append(A @ states[-1])
+        X = ht.array(np.stack(states, axis=1))
+        dmd = ht.decomposition.DMD(svd_rank=2).fit(X)
+        np.testing.assert_allclose(
+            np.sort(np.abs(dmd.rom_eigenvalues_.numpy())), [0.8, 0.9], atol=1e-3
+        )
+        nxt = dmd.predict_next(ht.array(states[-1].reshape(-1, 1)))
+        np.testing.assert_allclose(nxt.numpy().ravel(), A @ states[-1], atol=1e-3)
+
+
+class TestLasso(TestCase):
+    def test_sparse_recovery(self, regression_data):
+        X, w, y = regression_data
+        ls = ht.regression.Lasso(lam=0.01, max_iter=200).fit(
+            ht.array(X, split=0), ht.array(y.reshape(-1, 1), split=0)
+        )
+        coef = ls.coef_.numpy().ravel()
+        np.testing.assert_allclose(coef[[1, 3]], w[[1, 3]], atol=0.1)
+        assert np.all(np.abs(coef[[0, 2, 6, 7]]) < 0.05)
+        pred = ls.predict(ht.array(X, split=0))
+        assert pred.shape == (256, 1)
+        np.testing.assert_allclose(pred.numpy().ravel(), y, atol=1.0)
+
+
+class TestGaussianNB(TestCase):
+    def test_vs_sklearn(self, regression_data):
+        X, _, _ = regression_data
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.int32)
+        nb = ht.naive_bayes.GaussianNB().fit(ht.array(X, split=0), ht.array(y, split=0))
+        from sklearn.naive_bayes import GaussianNB as SKNB
+
+        sk = SKNB().fit(X, y)
+        np.testing.assert_allclose(nb.theta_.numpy(), sk.theta_, rtol=1e-3, atol=1e-4)
+        pred = nb.predict(ht.array(X, split=0))
+        agreement = (pred.numpy() == sk.predict(X)).mean()
+        assert agreement > 0.98
+        proba = nb.predict_proba(ht.array(X, split=0))
+        np.testing.assert_allclose(proba.numpy().sum(axis=1), 1.0, atol=1e-4)
+
+    def test_priors_validation(self, regression_data):
+        X, _, _ = regression_data
+        y = (X[:, 0] > 0).astype(np.int32)
+        with pytest.raises(ValueError):
+            ht.naive_bayes.GaussianNB(priors=[0.9, 0.9]).fit(ht.array(X), ht.array(y))
+
+
+class TestKNN(TestCase):
+    def test_vs_sklearn(self, regression_data):
+        X, _, _ = regression_data
+        y = (X[:, 0] > 0).astype(np.int32)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5).fit(
+            ht.array(X, split=0), ht.array(y, split=0)
+        )
+        from sklearn.neighbors import KNeighborsClassifier as SKKNN
+
+        sk = SKKNN(n_neighbors=5).fit(X, y)
+        agreement = (knn.predict(ht.array(X, split=0)).numpy() == sk.predict(X)).mean()
+        assert agreement > 0.97
+
+
+class TestScalers(TestCase):
+    def test_standard(self, regression_data):
+        X, _, _ = regression_data
+        s = ht.preprocessing.StandardScaler().fit(ht.array(X, split=0))
+        Z = s.transform(ht.array(X, split=0))
+        np.testing.assert_allclose(Z.numpy().mean(axis=0), 0, atol=1e-4)
+        np.testing.assert_allclose(Z.numpy().std(axis=0), 1, atol=1e-3)
+        np.testing.assert_allclose(s.inverse_transform(Z).numpy(), X, atol=1e-4)
+
+    def test_minmax(self, regression_data):
+        X, _, _ = regression_data
+        s = ht.preprocessing.MinMaxScaler(feature_range=(-1, 1)).fit(ht.array(X, split=0))
+        Z = s.transform(ht.array(X, split=0))
+        np.testing.assert_allclose(Z.numpy().min(axis=0), -1, atol=1e-5)
+        np.testing.assert_allclose(Z.numpy().max(axis=0), 1, atol=1e-5)
+        with pytest.raises(ValueError):
+            ht.preprocessing.MinMaxScaler(feature_range=(1, 0))
+
+    def test_maxabs_robust_normalizer(self, regression_data):
+        X, _, _ = regression_data
+        hx = ht.array(X, split=0)
+        Z = ht.preprocessing.MaxAbsScaler().fit(hx).transform(hx)
+        assert np.abs(Z.numpy()).max() <= 1 + 1e-5
+        Z = ht.preprocessing.RobustScaler().fit(hx).transform(hx)
+        np.testing.assert_allclose(np.median(Z.numpy(), axis=0), 0, atol=1e-4)
+        Z = ht.preprocessing.Normalizer().transform(hx)
+        np.testing.assert_allclose(np.linalg.norm(Z.numpy(), axis=1), 1, atol=1e-5)
+
+
+class TestLaplacian(TestCase):
+    def test_norm_sym(self):
+        data = ht.utils.data.create_spherical_dataset(16)
+        lap = ht.graph.Laplacian(lambda x: ht.spatial.rbf(x, sigma=2.0))
+        L = lap.construct(data)
+        Ln = L.numpy()
+        assert Ln.shape == (64, 64)
+        np.testing.assert_allclose(Ln, Ln.T, atol=1e-5)
+        evals = np.linalg.eigvalsh(Ln)
+        assert evals.min() > -1e-4  # PSD

@@ -1,0 +1,161 @@
+"""Linalg tests (reference: heat/core/linalg/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+SPLITS_2D = [None, 0, 1]
+
+
+class TestMatmul(TestCase):
+    def test_matmul_split_cases(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(16, 8)).astype(np.float32)
+        b = rng.normal(size=(8, 24)).astype(np.float32)
+        expected = a @ b
+        for sa in SPLITS_2D:
+            for sb in SPLITS_2D:
+                ha = ht.array(a, split=sa)
+                hb = ht.array(b, split=sb)
+                hc = ha @ hb
+                self.assert_array_equal(hc, expected, rtol=1e-4, atol=1e-4)
+
+    def test_matmul_result_split(self):
+        a = ht.ones((16, 8), split=0)
+        b = ht.ones((8, 24))
+        assert (a @ b).split == 0
+        c = ht.ones((16, 8))
+        d = ht.ones((8, 24), split=1)
+        assert (c @ d).split == 1
+
+    def test_matmul_vector(self):
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(8, 6)).astype(np.float32)
+        v = rng.normal(size=6).astype(np.float32)
+        self.assert_array_equal(ht.matmul(ht.array(a, split=0), ht.array(v)), a @ v, rtol=1e-4)
+
+    def test_summa(self):
+        from heat_tpu.linalg.basics import matmul_summa
+
+        rng = np.random.default_rng(3)
+        a = rng.normal(size=(32, 32)).astype(np.float32)
+        b = rng.normal(size=(32, 32)).astype(np.float32)
+        res = matmul_summa(ht.array(a, split=0), ht.array(b, split=0))
+        self.assert_array_equal(res, a @ b, rtol=1e-3, atol=1e-3)
+        assert res.split == 0
+
+    def test_dot_outer_trace(self):
+        x = np.arange(5.0, dtype=np.float32)
+        y = np.arange(5.0, dtype=np.float32) + 1
+        assert ht.dot(ht.array(x, split=0), ht.array(y, split=0)).item() == pytest.approx(x @ y)
+        self.assert_array_equal(ht.linalg.outer(ht.array(x), ht.array(y)), np.outer(x, y))
+        m = np.arange(9.0, dtype=np.float32).reshape(3, 3)
+        assert ht.linalg.trace(ht.array(m, split=0)).item() == pytest.approx(np.trace(m))
+
+    def test_transpose_norm(self):
+        m = np.arange(24.0, dtype=np.float32).reshape(4, 6)
+        for split in SPLITS_2D:
+            a = ht.array(m, split=split)
+            self.assert_array_equal(ht.transpose(a), m.T)
+            assert ht.norm(a).item() == pytest.approx(np.linalg.norm(m), rel=1e-4)
+        a = ht.array(m, split=0)
+        assert a.T.split == 1
+        self.assert_array_equal(ht.linalg.vector_norm(a, axis=1), np.linalg.norm(m, axis=1), rtol=1e-4)
+
+    def test_tril_triu(self):
+        m = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+        for split in SPLITS_2D:
+            a = ht.array(m, split=split)
+            self.assert_array_equal(ht.linalg.tril(a), np.tril(m))
+            self.assert_array_equal(ht.linalg.triu(a, 1), np.triu(m, 1))
+
+
+class TestQR(TestCase):
+    def test_tsqr_tall_skinny(self):
+        rng = np.random.default_rng(4)
+        a = rng.normal(size=(64, 8)).astype(np.float32)
+        for split in [None, 0, 1]:
+            ha = ht.array(a, split=split)
+            q, r = ht.linalg.qr(ha)
+            np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+            np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(8), atol=1e-4)
+            # R upper triangular
+            np.testing.assert_allclose(np.tril(r.numpy(), -1), 0, atol=1e-4)
+        q, r = ht.linalg.qr(ht.array(a, split=0))
+        assert q.split == 0
+
+    def test_qr_mode_r(self):
+        a = np.random.default_rng(5).normal(size=(32, 4)).astype(np.float32)
+        res = ht.linalg.qr(ht.array(a, split=0), mode="r")
+        assert res.Q is None
+        assert res.R.shape == (4, 4)
+
+    def test_qr_ragged(self):
+        # 30 rows on 8 devices: ragged fallback path
+        a = np.random.default_rng(6).normal(size=(30, 4)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(a, split=0))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+
+
+class TestSVD(TestCase):
+    def test_tssvd(self):
+        rng = np.random.default_rng(7)
+        a = rng.normal(size=(64, 8)).astype(np.float32)
+        u, s, v = ht.linalg.svd(ht.array(a, split=0))
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-3
+        )
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-3)
+
+    def test_hsvd_rank(self):
+        from heat_tpu.utils.data.matrixgallery import random_known_rank
+
+        A, (u, sv, v) = random_known_rank(64, 32, 5, split=0)
+        U, s, V, err = ht.linalg.svdtools.hsvd_rank(A, 5, compute_sv=True)
+        assert U.shape == (64, 5)
+        assert err < 1e-3
+        np.testing.assert_allclose(np.sort(s.numpy())[::-1][:5], np.sort(sv.numpy())[::-1], rtol=1e-2)
+
+    def test_hsvd_rtol(self):
+        from heat_tpu.utils.data.matrixgallery import random_known_rank
+
+        A, _ = random_known_rank(64, 32, 5, split=0)
+        U, s, V, err = ht.linalg.svdtools.hsvd_rtol(A, 1e-4, compute_sv=True)
+        assert err < 1e-3
+
+    def test_rsvd(self):
+        from heat_tpu.utils.data.matrixgallery import random_known_rank
+
+        A, (u, sv, v) = random_known_rank(64, 32, 5, split=0)
+        U, s, V = ht.linalg.svdtools.rsvd(A, 5)
+        np.testing.assert_allclose(np.sort(s.numpy())[::-1], np.sort(sv.numpy())[::-1], rtol=1e-2)
+
+
+class TestSolvers(TestCase):
+    def test_cg(self):
+        rng = np.random.default_rng(8)
+        a = rng.normal(size=(16, 16)).astype(np.float32)
+        spd = a @ a.T + 16 * np.eye(16, dtype=np.float32)
+        b = rng.normal(size=16).astype(np.float32)
+        x = ht.linalg.solver.cg(ht.array(spd, split=0), ht.array(b))
+        np.testing.assert_allclose(spd @ x.numpy(), b, atol=1e-3)
+
+    def test_lanczos(self):
+        rng = np.random.default_rng(9)
+        a = rng.normal(size=(16, 16)).astype(np.float32)
+        spd = a @ a.T + 16 * np.eye(16, dtype=np.float32)
+        V, T = ht.linalg.solver.lanczos(ht.array(spd, split=0), 16)
+        # Lanczos with full reorthogonalization reproduces the spectrum
+        evals = np.sort(np.linalg.eigvalsh(T.numpy()))
+        expected = np.sort(np.linalg.eigvalsh(spd))
+        np.testing.assert_allclose(evals[-4:], expected[-4:], rtol=1e-2)
+
+    def test_solve_triangular(self):
+        rng = np.random.default_rng(10)
+        L = np.tril(rng.normal(size=(8, 8)).astype(np.float32)) + 8 * np.eye(8, dtype=np.float32)
+        b = rng.normal(size=(8, 2)).astype(np.float32)
+        x = ht.linalg.solver.solve_triangular(ht.array(L, split=0), ht.array(b, split=0), lower=True)
+        np.testing.assert_allclose(L @ x.numpy(), b, atol=1e-4)

@@ -1,0 +1,143 @@
+"""RNG + communication layer tests (reference: test_random.py,
+test_communication.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestRandom:
+    def test_reproducibility(self):
+        ht.random.seed(42)
+        a = ht.random.rand(16, 4)
+        ht.random.seed(42)
+        b = ht.random.rand(16, 4)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_split_invariance(self):
+        # the reference's Threefry guarantee: same stream regardless of split
+        ht.random.seed(7)
+        a = ht.random.randn(16, 4, split=0)
+        ht.random.seed(7)
+        b = ht.random.randn(16, 4, split=1)
+        ht.random.seed(7)
+        c = ht.random.randn(16, 4)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        np.testing.assert_array_equal(a.numpy(), c.numpy())
+
+    def test_state(self):
+        ht.random.seed(5)
+        st = ht.random.get_state()
+        assert st[0] == "Threefry"
+        assert st[1] == 5
+        a = ht.random.rand(4)
+        ht.random.set_state(("Threefry", 5, 0))
+        b = ht.random.rand(4)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        with pytest.raises(ValueError):
+            ht.random.set_state(("Mersenne", 0, 0))
+
+    def test_distributions(self):
+        u = ht.random.uniform(low=2.0, high=3.0, size=(1000,))
+        assert 2.0 <= float(u.min().item()) and float(u.max().item()) < 3.0
+        n = ht.random.normal(mean=5.0, std=0.1, shape=(1000,))
+        assert abs(float(n.mean().item()) - 5.0) < 0.05
+        r = ht.random.randint(0, 10, size=(1000,))
+        assert 0 <= int(r.min().item()) and int(r.max().item()) < 10
+        with pytest.raises(ValueError):
+            ht.random.randint(5, 5)
+
+    def test_permutation_randperm(self):
+        p = ht.random.randperm(16)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(16))
+        x = ht.arange(10, split=0)
+        s = ht.random.permutation(x)
+        np.testing.assert_array_equal(np.sort(s.numpy()), np.arange(10))
+
+
+class TestCommunication:
+    def test_chunk_math(self):
+        comm = ht.communication.get_comm()
+        # ceil-div convention, matches jax shard placement
+        offset, lshape, slices = comm.chunk((16, 4), 0, rank=0)
+        assert offset == 0 and lshape == (2, 4)
+        offset, lshape, _ = comm.chunk((16, 4), 0, rank=7)
+        assert offset == 14 and lshape == (2, 4)
+        # ragged
+        offset, lshape, _ = comm.chunk((13,), 0, rank=7)
+        assert offset == 13 and lshape == (0,)
+        counts, displs = comm.counts_displs_shape((16, 4), 0)
+        assert sum(counts) == 16
+        assert displs[0] == 0
+
+    def test_sharding_spec(self):
+        comm = ht.communication.get_comm()
+        from jax.sharding import PartitionSpec
+
+        assert comm.spec(2, 0) == PartitionSpec(comm.axis, None)
+        assert comm.spec(2, 1) == PartitionSpec(None, comm.axis)
+        assert comm.spec(3, None) == PartitionSpec()
+
+    def test_world(self):
+        comm = ht.communication.get_comm()
+        assert comm.size == 8
+        assert comm.rank == 0
+        assert comm.is_distributed()
+
+    def test_functional_collectives(self):
+        import jax
+        import jax.numpy as jnp
+
+        comm = ht.communication.get_comm()
+
+        def fn(x):
+            s = comm.Allreduce(x, "sum")
+            mx = comm.Allreduce(x, "max")
+            ag = comm.Allgather(x)
+            ex = comm.Exscan(x)
+            return s, mx, ag, ex
+
+        mapped = comm.shard_map(fn, in_splits=((1, 0),), out_splits=((1, 0), (1, 0), (1, None), (1, 0)))
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        s, mx, ag, ex = mapped(x._jarray)
+        np.testing.assert_allclose(np.asarray(s), np.full(8, 28.0))
+        np.testing.assert_allclose(np.asarray(mx), np.full(8, 7.0))
+        np.testing.assert_allclose(np.asarray(ag), np.arange(8.0))
+        np.testing.assert_allclose(np.asarray(ex), np.concatenate([[0], np.cumsum(np.arange(7.0))]))
+
+    def test_prod_allreduce_signs(self):
+        comm = ht.communication.get_comm()
+        mapped = comm.shard_map(
+            lambda x: comm.Allreduce(x, "prod"), in_splits=((1, 0),), out_splits=(1, 0)
+        )
+        x = ht.array(np.array([-2.0, 1, 1, 1, 3, 1, 1, 1], dtype=np.float32), split=0)
+        res = np.asarray(mapped(x._jarray))
+        np.testing.assert_allclose(res, np.full(8, -6.0))
+
+
+class TestParallelPrimitives:
+    def test_ring_map_cdist(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 4)).astype(np.float32)
+        from heat_tpu.spatial.distance import cdist_ring
+
+        a = ht.array(X, split=0)
+        d = cdist_ring(a)
+        from scipy.spatial.distance import cdist as scdist
+
+        np.testing.assert_allclose(d.numpy(), scdist(X, X), atol=1e-4)
+        assert d.split == 0
+
+    def test_halo(self):
+        from heat_tpu.parallel.halo import with_halos
+
+        a = ht.arange(16, dtype=ht.float32, split=0)
+        h = with_halos(a._jarray, 1, 0, a.comm)
+        # each 2-element shard becomes 4 (halo_prev + block + halo_next)
+        assert h.shape == (32,)
+        hn = np.asarray(h)
+        # shard 1 slab: [prev=1, 2, 3, next=4]
+        np.testing.assert_allclose(hn[4:8], [1, 2, 3, 4])
+        # shard 0 slab gets zero halo_prev
+        np.testing.assert_allclose(hn[0:4], [0, 0, 1, 2])

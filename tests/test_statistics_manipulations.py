@@ -1,0 +1,202 @@
+"""Statistics + manipulations tests (reference: test_statistics.py,
+test_manipulations.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+SPLITS_2D = [None, 0, 1]
+
+
+class TestStatistics(TestCase):
+    def setup_method(self, method):
+        self.data = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+
+    def test_mean_var_std(self):
+        for split in SPLITS_2D:
+            a = ht.array(self.data, split=split)
+            assert a.mean().item() == pytest.approx(self.data.mean(), abs=1e-5)
+            assert a.var().item() == pytest.approx(self.data.var(), rel=1e-4)
+            assert a.std().item() == pytest.approx(self.data.std(), rel=1e-4)
+            self.assert_array_equal(a.mean(axis=0), self.data.mean(axis=0), rtol=1e-4)
+            self.assert_array_equal(a.var(axis=1), self.data.var(axis=1), rtol=1e-3)
+
+    def test_minmax_argminmax(self):
+        for split in SPLITS_2D:
+            a = ht.array(self.data, split=split)
+            assert a.max().item() == pytest.approx(self.data.max())
+            assert a.min().item() == pytest.approx(self.data.min())
+            assert a.argmax().item() == self.data.argmax()
+            assert a.argmin().item() == self.data.argmin()
+            self.assert_array_equal(a.max(axis=0), self.data.max(axis=0))
+            self.assert_array_equal(ht.argmax(a, axis=1), self.data.argmax(axis=1))
+
+    def test_minimum_maximum(self):
+        b = -self.data
+        self.assert_array_equal(
+            ht.minimum(ht.array(self.data, split=0), ht.array(b, split=0)),
+            np.minimum(self.data, b),
+        )
+        self.assert_array_equal(
+            ht.maximum(ht.array(self.data, split=0), ht.array(b, split=0)),
+            np.maximum(self.data, b),
+        )
+
+    def test_average_median_percentile(self):
+        a = ht.array(self.data, split=0)
+        assert ht.average(a).item() == pytest.approx(self.data.mean(), abs=1e-5)
+        w = np.arange(1.0, 17.0, dtype=np.float32)
+        self.assert_array_equal(
+            ht.average(a, axis=0, weights=ht.array(w)),
+            np.average(self.data, axis=0, weights=w),
+            rtol=1e-4,
+        )
+        assert ht.median(a).item() == pytest.approx(np.median(self.data), abs=1e-5)
+        self.assert_array_equal(
+            ht.percentile(a, 30.0), np.percentile(self.data, 30.0).astype(np.float32), rtol=1e-4
+        )
+
+    def test_cov(self):
+        a = ht.array(self.data, split=0)
+        self.assert_array_equal(ht.statistics.cov(a), np.cov(self.data), rtol=1e-3)
+
+    def test_histogram_bincount(self):
+        a = ht.array(self.data, split=0)
+        h, e = ht.statistics.histogram(a, bins=10)
+        he, ee = np.histogram(self.data, bins=10)
+        np.testing.assert_array_equal(h.numpy(), he)
+        ints = ht.array(np.array([0, 1, 1, 2, 2, 2]), split=0)
+        self.assert_array_equal(ht.statistics.bincount(ints), np.bincount([0, 1, 1, 2, 2, 2]))
+
+    def test_skew_kurtosis(self):
+        from scipy import stats
+
+        flat = self.data.ravel()
+        a = ht.array(flat, split=0)
+        assert ht.statistics.skew(a, unbiased=False).item() == pytest.approx(
+            stats.skew(flat, bias=True), abs=1e-3
+        )
+        assert ht.statistics.kurtosis(a, unbiased=False).item() == pytest.approx(
+            stats.kurtosis(flat, bias=True), abs=1e-3
+        )
+
+    def test_digitize_bucketize(self):
+        x = ht.array(np.array([0.5, 1.0, 2.5, 3.0], dtype=np.float32))
+        bins = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        self.assert_array_equal(ht.statistics.digitize(x, bins), np.digitize(x.numpy(), bins))
+        assert ht.statistics.bucketize(ht.array([3.0]), [1.0, 3.0, 5.0]).item() == 1
+
+
+class TestManipulations(TestCase):
+    def setup_method(self, method):
+        self.data = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+
+    def test_concatenate_stack(self):
+        for split in SPLITS_2D:
+            a = ht.array(self.data, split=split)
+            b = ht.array(self.data + 100, split=split)
+            self.assert_array_equal(
+                ht.concatenate([a, b], axis=0), np.concatenate([self.data, self.data + 100], 0)
+            )
+            self.assert_array_equal(
+                ht.concatenate([a, b], axis=1), np.concatenate([self.data, self.data + 100], 1)
+            )
+            self.assert_array_equal(ht.vstack([a, b]), np.vstack([self.data, self.data + 100]))
+            self.assert_array_equal(ht.hstack([a, b]), np.hstack([self.data, self.data + 100]))
+            self.assert_array_equal(ht.stack([a, b]), np.stack([self.data, self.data + 100]))
+        a0 = ht.array(self.data, split=0)
+        assert ht.stack([a0, a0]).split == 1  # new axis before split shifts it
+
+    def test_reshape_ravel(self):
+        for split in SPLITS_2D:
+            a = ht.array(self.data, split=split)
+            self.assert_array_equal(ht.reshape(a, (4, 6)), self.data.reshape(4, 6))
+            self.assert_array_equal(ht.reshape(a, (2, -1)), self.data.reshape(2, -1))
+            self.assert_array_equal(a.flatten(), self.data.ravel())
+
+    def test_squeeze_expand(self):
+        d = self.data.reshape(6, 1, 4)
+        a = ht.array(d, split=0)
+        self.assert_array_equal(ht.squeeze(a, 1), d.squeeze(1))
+        assert ht.squeeze(a, 1).split == 0
+        e = ht.expand_dims(ht.array(self.data, split=1), 0)
+        assert e.split == 2
+        self.assert_array_equal(e, self.data[None])
+
+    def test_flips_roll_rot(self):
+        for split in SPLITS_2D:
+            a = ht.array(self.data, split=split)
+            self.assert_array_equal(ht.flip(a, 0), np.flip(self.data, 0))
+            self.assert_array_equal(ht.fliplr(a), np.fliplr(self.data))
+            self.assert_array_equal(ht.flipud(a), np.flipud(self.data))
+            self.assert_array_equal(ht.roll(a, 2, axis=0), np.roll(self.data, 2, 0))
+            self.assert_array_equal(ht.rot90(a), np.rot90(self.data))
+
+    def test_sort_topk_unique(self):
+        rng = np.random.default_rng(3)
+        d = rng.integers(0, 50, size=(8, 6)).astype(np.float32)
+        for split in SPLITS_2D:
+            a = ht.array(d, split=split)
+            v, i = ht.sort(a, axis=1)
+            np.testing.assert_array_equal(v.numpy(), np.sort(d, axis=1))
+            v, i = ht.sort(a, axis=0, descending=True)
+            np.testing.assert_array_equal(v.numpy(), -np.sort(-d, axis=0))
+            tv, ti = ht.topk(a, 3, dim=1)
+            np.testing.assert_array_equal(tv.numpy(), -np.sort(-d, axis=1)[:, :3])
+        u = ht.unique(ht.array(np.array([3, 1, 3, 2, 1]), split=0))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+        u, inv = ht.unique(ht.array(np.array([3, 1, 3])), return_inverse=True)
+        np.testing.assert_array_equal(u.numpy()[inv.numpy()], [3, 1, 3])
+
+    def test_pad_tile_repeat(self):
+        a = ht.array(self.data, split=0)
+        self.assert_array_equal(
+            ht.pad(a, ((1, 1), (0, 2)), constant_values=7),
+            np.pad(self.data, ((1, 1), (0, 2)), constant_values=7),
+        )
+        self.assert_array_equal(ht.tile(a, (2, 3)), np.tile(self.data, (2, 3)))
+        self.assert_array_equal(ht.repeat(a, 2, axis=1), np.repeat(self.data, 2, 1))
+
+    def test_split_functions(self):
+        a = ht.array(self.data, split=0)
+        parts = ht.split(a, 3, axis=0)
+        assert len(parts) == 3
+        self.assert_array_equal(parts[0], self.data[:2])
+        vparts = ht.vsplit(a, 2)
+        self.assert_array_equal(vparts[1], self.data[3:])
+        hparts = ht.hsplit(a, 2)
+        self.assert_array_equal(hparts[0], self.data[:, :2])
+
+    def test_diag_diagonal(self):
+        a = ht.array(self.data[:4, :4], split=0)
+        self.assert_array_equal(ht.manipulations.diag(a), np.diag(self.data[:4, :4]))
+        v = ht.arange(4, split=0)
+        self.assert_array_equal(ht.manipulations.diag(v), np.diag(np.arange(4)))
+
+    def test_broadcast_swap_move(self):
+        a = ht.array(self.data, split=1)
+        self.assert_array_equal(ht.swapaxes(a, 0, 1), self.data.T)
+        assert ht.swapaxes(a, 0, 1).split == 0
+        self.assert_array_equal(ht.moveaxis(a, 0, 1), np.moveaxis(self.data, 0, 1))
+        b = ht.broadcast_to(ht.arange(4, dtype=ht.float32), (6, 4))
+        self.assert_array_equal(b, np.broadcast_to(np.arange(4.0), (6, 4)))
+
+    def test_resplit_out_of_place(self):
+        a = ht.array(self.data, split=0)
+        b = ht.manipulations.resplit(a, 1)
+        assert a.split == 0 and b.split == 1
+        self.assert_array_equal(b, self.data)
+
+
+class TestIndexing(TestCase):
+    def test_nonzero_where(self):
+        d = np.array([[1, 0, 2], [0, 3, 0]], dtype=np.float32)
+        for split in [None, 0, 1]:
+            a = ht.array(d, split=split)
+            nz = ht.nonzero(a)
+            np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(d), axis=1))
+        w = ht.where(ht.array(d, split=0) > 0, ht.array(d, split=0), ht.zeros((2, 3), split=0) - 1)
+        np.testing.assert_array_equal(w.numpy(), np.where(d > 0, d, -1))
