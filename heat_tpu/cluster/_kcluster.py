@@ -107,44 +107,60 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             centers, (k, d), x.dtype, None, x.device, x.comm, True
         )
 
-    def _assign(self, jx, centers):
+    @staticmethod
+    def _assign(jx, centers):
         """E-step: squared distances + argmin, fused on the MXU."""
         xx = jnp.sum(jx * jx, axis=1, keepdims=True)
         cc = jnp.sum(centers * centers, axis=1)[None, :]
         d2 = xx + cc - 2.0 * (jx @ centers.T)
         return jnp.argmin(d2, axis=1), jnp.min(jnp.maximum(d2, 0.0), axis=1)
 
-    def _update(self, jx, labels, centers):
+    @staticmethod
+    def _update(jx, labels, centers):
         raise NotImplementedError()
 
+    @classmethod
+    def _fit_program(cls):
+        """The WHOLE Lloyd iteration as one compiled XLA program
+        (lax.while_loop, SURVEY §3.4) — a single device dispatch per fit,
+        no per-iteration host round-trips.  Cached per class so repeated
+        fits (and new instances) skip retracing."""
+        prog = cls.__dict__.get("_FIT_PROGRAM")
+        if prog is None:
+
+            @jax.jit
+            def prog(jx, centers0, max_iter, tol):
+                def cond(state):
+                    _, it, shift = state
+                    return jnp.logical_and(it < max_iter, shift > tol)
+
+                def body(state):
+                    centers, it, _ = state
+                    labels, _ = cls._assign(jx, centers)
+                    new = cls._update(jx, labels, centers)
+                    return new, it + 1, jnp.max(jnp.abs(new - centers))
+
+                centers, n_iter, _ = jax.lax.while_loop(
+                    cond, body, (centers0, jnp.asarray(0), jnp.asarray(jnp.inf, centers0.dtype))
+                )
+                labels, d2 = cls._assign(jx, centers)
+                return centers, labels, jnp.sum(d2), n_iter
+
+            cls._FIT_PROGRAM = prog
+        return prog
+
     def fit(self, x: DNDarray):
-        """Lloyd-style iteration; each step is one compiled sharded program."""
+        """Lloyd iteration — one fused sharded XLA program per fit."""
         from ..core.sanitation import sanitize_in
 
         sanitize_in(x)
         self._initialize_cluster_centers(x)
         jx = x._jarray
-        centers = self._cluster_centers._jarray
-
-        @jax.jit
-        def step(centers):
-            labels, d2 = self._assign(jx, centers)
-            new_centers = self._update(jx, labels, centers)
-            return new_centers, labels, jnp.sum(d2)
-
-        n_iter = 0
-        for it in range(self.max_iter):
-            new_centers, _, _ = step(centers)
-            shift = float(jnp.max(jnp.abs(new_centers - centers)))
-            centers = new_centers
-            n_iter = it + 1
-            if shift <= self.tol:
-                break
-        # final assignment against the centers actually stored, so that
-        # labels_/inertia_ are consistent with cluster_centers_ (and defined
-        # even for max_iter=0)
-        labels, d2 = self._assign(jx, centers)
-        inertia = jnp.sum(d2)
+        centers0 = self._cluster_centers._jarray
+        centers, labels, inertia, n_iter = self._fit_program()(
+            jx, centers0, jnp.asarray(self.max_iter), jnp.asarray(self.tol, centers0.dtype)
+        )
+        n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray(
             x.comm.shard(centers, None), tuple(centers.shape), x.dtype, None, x.device, x.comm, True

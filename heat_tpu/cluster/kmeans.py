@@ -35,8 +35,9 @@ class KMeans(_KCluster):
             max_iter=max_iter, tol=tol, random_state=random_state,
         )
 
-    def _update(self, jx, labels, centers):
-        k = self.n_clusters
+    @staticmethod
+    def _update(jx, labels, centers):
+        k = centers.shape[0]
         onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(jx.dtype)
         sums = onehot.T @ jx          # (k, d) — MXU GEMM + implicit Allreduce
         counts = jnp.sum(onehot, axis=0)  # (k,)  — implicit Allreduce

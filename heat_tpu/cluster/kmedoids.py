@@ -31,8 +31,9 @@ class KMedoids(_KCluster):
             max_iter=max_iter, tol=0.0, random_state=random_state,
         )
 
-    def _update(self, jx, labels, centers):
-        k = self.n_clusters
+    @staticmethod
+    def _update(jx, labels, centers):
+        k = centers.shape[0]
 
         def one(c):
             m = labels == c

@@ -1,1 +1,6 @@
-"""Placeholder — populated in this round."""
+"""NN layer (reference: ``heat/nn/``): module constructors + DataParallel."""
+
+from .modules import *
+from . import modules
+from .data_parallel import DataParallel, DataParallelMultiGPU
+from . import functional

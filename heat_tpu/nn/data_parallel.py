@@ -1,0 +1,167 @@
+"""Data-parallel NN training (reference: ``heat/nn/data_parallel.py``).
+
+The reference registers per-parameter backward hooks that fire nonblocking
+MPI ``Iallreduce``s as gradients become ready, overlapping communication with
+the rest of backward (SURVEY §3.5).  The TPU-native design makes that entire
+mechanism disappear: parameters are replicated, the batch is sharded over the
+mesh, and ``jax.grad`` of the global-mean loss *is* the gradient allreduce —
+XLA's latency-hiding scheduler overlaps the psum with backward computation,
+which is exactly the hook/bucket machinery, minus the code.
+
+``DataParallel`` therefore carries the reference's API (module wrapper,
+``comm``, ``optimizer`` coordination, ``blocking`` accepted for parity) while
+the train step is ONE compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.communication import Communication, sanitize_comm
+from ..core.dndarray import DNDarray
+from .modules import Module
+
+__all__ = ["DataParallel", "DataParallelMultiGPU"]
+
+
+def _as_jax(x):
+    return x._jarray if isinstance(x, DNDarray) else x
+
+
+class DataParallel:
+    """Wrap a module for synchronous data-parallel training.
+
+    Parameters
+    ----------
+    module : Module (or flax-style object with init/apply)
+    comm : Communication, optional
+        Mesh axis the batch is sharded over (default world).
+    optimizer : DataParallelOptimizer, optional
+        If given, ``train_step`` fuses forward+backward+psum+update.
+    blocking : bool
+        Accepted for reference parity; XLA collectives are always
+        asynchronously scheduled, so both modes are the overlapped one.
+    """
+
+    def __init__(self, module: Module, comm: Optional[Communication] = None,
+                 optimizer=None, blocking: bool = False, scale_gradient_average=None):
+        self.module = module
+        self.comm = sanitize_comm(comm)
+        self.optimizer = optimizer
+        self.blocking = blocking
+        self._params = None
+        self._train_step = None
+        if optimizer is not None:
+            optimizer._attach(self)
+
+    # -- parameter management ------------------------------------------- #
+    def init(self, key=None, sample_input=None):
+        """Initialize (replicated) parameters."""
+        if key is None:
+            key = jax.random.key(0)
+        if hasattr(self.module, "init"):
+            try:
+                self._params = self.module.init(key)
+            except TypeError:
+                # flax signature: init(key, x)
+                self._params = self.module.init(key, _as_jax(sample_input))
+        else:
+            raise TypeError("module must provide init()")
+        # replicate across the mesh
+        self._params = jax.tree.map(lambda p: self.comm.shard(p, None), self._params)
+        return self._params
+
+    @property
+    def parameters(self):
+        return self._params
+
+    @parameters.setter
+    def parameters(self, params):
+        self._params = params
+
+    def state_dict(self):
+        """Flat {path: array} of parameters (torch-style checkpoint dict)."""
+        flat = jax.tree_util.tree_flatten_with_path(self._params)[0]
+        return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+    def load_state_dict(self, state):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self._params)
+        new_leaves = [jnp.asarray(state[jax.tree_util.keystr(p)]) for p, _ in flat]
+        self._params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    # -- forward -------------------------------------------------------- #
+    def forward(self, x, **kw):
+        if self._params is None:
+            self.init(sample_input=x)
+        jx = _as_jax(x)
+        y = self.module.apply(self._params, jx, **kw)
+        if isinstance(x, DNDarray):
+            split = x.split
+            y = x.comm.shard(y, split if split is not None and split < y.ndim else None)
+            return DNDarray(
+                y, tuple(y.shape), types.canonical_heat_type(y.dtype),
+                split if split is not None and split < y.ndim else None,
+                x.device, x.comm, True,
+            )
+        return y
+
+    __call__ = forward
+
+    # -- fused train step ----------------------------------------------- #
+    def make_train_step(self, loss_fn: Callable, with_rng: bool = False):
+        """Build a jitted (params, opt_state, x, y[, key]) →
+        (params, opt_state, loss) step.  The batch arrives sharded; the mean
+        loss over the GLOBAL batch makes XLA emit the gradient psum (the
+        reference's Iallreduce hooks).
+
+        ``with_rng=True`` adds a PRNG-key argument, required for stochastic
+        layers (Dropout) — without it, a Dropout layer raises so that
+        regularization can never be silently inactive during training.
+        """
+        if self.optimizer is None:
+            raise RuntimeError("make_train_step requires an attached optimizer")
+        apply = self.module.apply
+        opt = self.optimizer
+
+        def _forward(p, jx, key):
+            try:
+                return apply(p, jx, train=True, key=key)
+            except TypeError:
+                return apply(p, jx)  # flax-style apply without train/key kwargs
+
+        if with_rng:
+
+            @jax.jit
+            def step(params, opt_state, jx, jy, key):
+                def loss(p):
+                    return loss_fn(_forward(p, jx, key), jy)
+
+                lval, grads = jax.value_and_grad(loss)(params)
+                new_params, new_state = opt._update(params, grads, opt_state)
+                return new_params, new_state, lval
+
+        else:
+
+            @jax.jit
+            def step(params, opt_state, jx, jy):
+                def loss(p):
+                    return loss_fn(_forward(p, jx, None), jy)
+
+                lval, grads = jax.value_and_grad(loss)(params)
+                new_params, new_state = opt._update(params, grads, opt_state)
+                return new_params, new_state, lval
+
+        self._train_step = step
+        return step
+
+
+class DataParallelMultiGPU(DataParallel):
+    """Reference parity alias: the NCCL-node-group variant.  On TPU the
+    hierarchy is expressed by the mesh itself (see ``optim.DASO``)."""
+
+    def __init__(self, module: Module, optimizer=None, comm: Optional[Communication] = None):
+        super().__init__(module, comm=comm, optimizer=optimizer)

@@ -1,0 +1,81 @@
+"""Functional NN ops (losses etc.), ``ht.nn.functional`` — torch-style names."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+
+__all__ = ["cross_entropy", "nll_loss", "mse_loss", "l1_loss", "binary_cross_entropy", "relu", "softmax", "log_softmax"]
+
+
+def _j(x):
+    return x._jarray if isinstance(x, DNDarray) else jnp.asarray(x)
+
+
+def cross_entropy(logits, targets, reduction: str = "mean"):
+    """Softmax cross-entropy with integer class targets.
+
+    The mean over a batch-sharded axis is the implicit gradient allreduce of
+    data-parallel training.
+    """
+    jl, jt = _j(logits), _j(targets)
+    logp = jax.nn.log_softmax(jl, axis=-1)
+    nll = -jnp.take_along_axis(logp, jt[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if reduction == "mean":
+        return jnp.mean(nll)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+def nll_loss(log_probs, targets, reduction: str = "mean"):
+    jl, jt = _j(log_probs), _j(targets)
+    nll = -jnp.take_along_axis(jl, jt[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if reduction == "mean":
+        return jnp.mean(nll)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+def mse_loss(pred, target, reduction: str = "mean"):
+    d = (_j(pred) - _j(target)) ** 2
+    if reduction == "mean":
+        return jnp.mean(d)
+    if reduction == "sum":
+        return jnp.sum(d)
+    return d
+
+
+def l1_loss(pred, target, reduction: str = "mean"):
+    d = jnp.abs(_j(pred) - _j(target))
+    if reduction == "mean":
+        return jnp.mean(d)
+    if reduction == "sum":
+        return jnp.sum(d)
+    return d
+
+
+def binary_cross_entropy(pred, target, reduction: str = "mean", eps: float = 1e-7):
+    p = jnp.clip(_j(pred), eps, 1.0 - eps)
+    t = _j(target)
+    b = -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+    if reduction == "mean":
+        return jnp.mean(b)
+    if reduction == "sum":
+        return jnp.sum(b)
+    return b
+
+
+def relu(x):
+    return jax.nn.relu(_j(x))
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(_j(x), axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(_j(x), axis=axis)

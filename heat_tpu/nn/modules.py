@@ -1,0 +1,201 @@
+"""Minimal pure-JAX module system backing ``ht.nn``.
+
+The reference's ``ht.nn`` is a passthrough to ``torch.nn`` (SURVEY §2.5);
+the TPU-native equivalent exposes the same constructor names
+(``ht.nn.Linear``, ``ht.nn.ReLU``, ``ht.nn.Sequential``, …) as lightweight
+pure-functional modules: ``init(key) -> params`` (a pytree) and
+``apply(params, x) -> y``.  Arbitrary flax modules duck-type the same
+contract and work everywhere these are accepted.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Module",
+    "Linear",
+    "ReLU",
+    "Tanh",
+    "Sigmoid",
+    "GELU",
+    "Softmax",
+    "LogSoftmax",
+    "Dropout",
+    "Flatten",
+    "Sequential",
+    "Conv2d",
+    "MaxPool2d",
+]
+
+
+class Module:
+    """Base: stateless apply + parameter init."""
+
+    def init(self, key) -> Any:
+        return ()
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        raise NotImplementedError
+
+    def __call__(self, params, x, **kw):
+        return self.apply(params, x, **kw)
+
+
+class Linear(Module):
+    """Dense layer y = x Wᵀ + b (torch parameter convention: W is (out, in))."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bias = bias
+
+    def init(self, key):
+        wk, bk = jax.random.split(key)
+        bound = 1.0 / jnp.sqrt(self.in_features)
+        w = jax.random.uniform(wk, (self.out_features, self.in_features), minval=-bound, maxval=bound)
+        if self.bias:
+            b = jax.random.uniform(bk, (self.out_features,), minval=-bound, maxval=bound)
+            return {"weight": w, "bias": b}
+        return {"weight": w}
+
+    def apply(self, params, x, **kw):
+        y = x @ params["weight"].T
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class _Activation(Module):
+    fn: Callable = None
+
+    def apply(self, params, x, **kw):
+        return type(self).fn(x)
+
+
+class ReLU(_Activation):
+    fn = staticmethod(jax.nn.relu)
+
+
+class Tanh(_Activation):
+    fn = staticmethod(jnp.tanh)
+
+
+class Sigmoid(_Activation):
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+class GELU(_Activation):
+    fn = staticmethod(jax.nn.gelu)
+
+
+class Softmax(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, **kw):
+        return jax.nn.softmax(x, axis=self.dim)
+
+
+class LogSoftmax(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, **kw):
+        return jax.nn.log_softmax(x, axis=self.dim)
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        if not train or self.p == 0.0:
+            return x
+        if key is None:
+            raise ValueError("Dropout in train mode requires a PRNG key")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Flatten(Module):
+    def apply(self, params, x, **kw):
+        return x.reshape(x.shape[0], -1)
+
+
+class Conv2d(Module):
+    """2-D convolution, NCHW layout (torch convention)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int,
+                 stride: int = 1, padding: int = 0, bias: bool = True):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size if isinstance(kernel_size, tuple) else (kernel_size, kernel_size)
+        self.stride = stride if isinstance(stride, tuple) else (stride, stride)
+        self.padding = padding if isinstance(padding, tuple) else (padding, padding)
+        self.bias = bias
+
+    def init(self, key):
+        wk, bk = jax.random.split(key)
+        fan_in = self.in_channels * self.kernel_size[0] * self.kernel_size[1]
+        bound = 1.0 / jnp.sqrt(fan_in)
+        w = jax.random.uniform(
+            wk, (self.out_channels, self.in_channels) + self.kernel_size, minval=-bound, maxval=bound
+        )
+        if self.bias:
+            return {"weight": w, "bias": jax.random.uniform(bk, (self.out_channels,), minval=-bound, maxval=bound)}
+        return {"weight": w}
+
+    def apply(self, params, x, **kw):
+        y = jax.lax.conv_general_dilated(
+            x, params["weight"], window_strides=self.stride,
+            padding=[(p, p) for p in self.padding],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.bias:
+            y = y + params["bias"][None, :, None, None]
+        return y
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size: int, stride: Optional[int] = None):
+        self.kernel_size = kernel_size if isinstance(kernel_size, tuple) else (kernel_size, kernel_size)
+        s = stride if stride is not None else kernel_size
+        self.stride = s if isinstance(s, tuple) else (s, s)
+
+    def apply(self, params, x, **kw):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 1) + self.kernel_size,
+            window_strides=(1, 1) + self.stride,
+            padding="VALID",
+        )
+
+
+class Sequential(Module):
+    """Chain of modules; params is a list of per-layer pytrees."""
+
+    def __init__(self, *layers: Module):
+        self.layers = list(layers)
+
+    def init(self, key):
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        return [l.init(k) for l, k in zip(self.layers, keys)]
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        for i, (l, p) in enumerate(zip(self.layers, params)):
+            if isinstance(l, Dropout) and train and l.p > 0.0:
+                if key is None:
+                    raise ValueError(
+                        "Sequential contains Dropout: apply(train=True) requires a "
+                        "PRNG key (use make_train_step(..., with_rng=True))"
+                    )
+                key, sub = jax.random.split(key)
+                x = l.apply(p, x, train=train, key=sub)
+            else:
+                x = l.apply(p, x, train=train)
+        return x

@@ -1,1 +1,4 @@
-"""Placeholder — populated in this round."""
+"""Optimizers (reference: ``heat/optim/``)."""
+
+from .dp_optimizer import DataParallelOptimizer, DASO, SGD, Adam, AdamW
+from . import lr_scheduler

@@ -1,0 +1,272 @@
+"""Data-parallel optimizers + DASO (reference: ``heat/optim/dp_optimizer.py``).
+
+``DataParallelOptimizer`` wraps any optax optimizer (or a named torch-style
+optimizer) and coordinates with ``nn.DataParallel``'s fused train step.
+
+``DASO`` — Distributed Asynchronous and Selective Optimization — is the
+reference's hierarchical data-parallel SGD (SURVEY §2.5/§3.5): NCCL allreduce
+across each node's GPUs every step, asynchronous MPI allreduce of PARAMETERS
+across nodes every ``global_skip`` steps, blended with a staleness weight.
+The TPU translation per SURVEY §2.8: a 2-axis mesh ``('dcn', 'ici')`` —
+every step syncs gradients over the fast ``ici`` axis only (each dcn-group
+keeps its own parameter replica, sharded over 'dcn'); every ``global_skip``
+steps the parameter psum over ``dcn`` is dispatched, and — because JAX
+dispatch is asynchronous — consumed ``stale_steps`` later with the staleness
+blend, giving the reference's fire-and-forget overlap without request objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core import devices
+from ..core.communication import Communication
+
+__all__ = ["DataParallelOptimizer", "DASO", "SGD", "Adam", "AdamW"]
+
+
+def _named_optimizer(name: str, **kw):
+    table = {
+        "sgd": lambda lr=0.01, momentum=0.0, weight_decay=0.0, nesterov=False: optax.chain(
+            optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
+            optax.sgd(lr, momentum=momentum if momentum else None, nesterov=nesterov),
+        ),
+        "adam": lambda lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0: optax.adam(
+            lr, b1=betas[0], b2=betas[1], eps=eps
+        ),
+        "adamw": lambda lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=1e-2: optax.adamw(
+            lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay
+        ),
+    }
+    if name.lower() not in table:
+        raise ValueError(f"Unknown optimizer {name!r}")
+    return table[name.lower()](**kw)
+
+
+def SGD(params=None, lr: float = 0.01, momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False):
+    """torch-style constructor returning an optax optimizer."""
+    return _named_optimizer("sgd", lr=lr, momentum=momentum, weight_decay=weight_decay, nesterov=nesterov)
+
+
+def Adam(params=None, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8):
+    return _named_optimizer("adam", lr=lr, betas=betas, eps=eps)
+
+
+def AdamW(params=None, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 1e-2):
+    return _named_optimizer("adamw", lr=lr, betas=betas, eps=eps, weight_decay=weight_decay)
+
+
+class DataParallelOptimizer:
+    """Wrap an optax optimizer for use with ``nn.DataParallel``.
+
+    Accepts an optax GradientTransformation, or a name ('sgd' | 'adam' |
+    'adamw') + kwargs, mirroring ``ht.optim.DataParallelOptimizer(torch_opt)``.
+    """
+
+    def __init__(self, optimizer, blocking: bool = False, **kwargs):
+        if isinstance(optimizer, str):
+            optimizer = _named_optimizer(optimizer, **kwargs)
+        self.optax_optimizer = optimizer
+        self.blocking = blocking
+        self._dp = None
+        self._opt_state = None
+
+    def _attach(self, dp) -> None:
+        self._dp = dp
+
+    def init_state(self, params):
+        self._opt_state = self.optax_optimizer.init(params)
+        return self._opt_state
+
+    @property
+    def state(self):
+        return self._opt_state
+
+    @state.setter
+    def state(self, s):
+        self._opt_state = s
+
+    def _update(self, params, grads, opt_state):
+        updates, new_state = self.optax_optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    def step(self, params, grads):
+        """Eager parameter update (gradients already globally averaged by XLA)."""
+        if self._opt_state is None:
+            self.init_state(params)
+        new_params, self._opt_state = self._update(params, grads, self._opt_state)
+        return new_params
+
+    def zero_grad(self) -> None:
+        """No-op: JAX gradients are functional (kept for API parity)."""
+
+
+class DASO:
+    """Hierarchical async data parallelism on a ('dcn', 'ici') mesh.
+
+    Parameters (reference names): ``local_optimizer``, ``total_local_comm_size``
+    (size of the fast axis; default = all devices on one host ring),
+    ``global_skip`` (steps between inter-group syncs), ``stale_steps``
+    (dispatch-to-consume delay of the global average), ``staleness_weight``
+    (blend factor for the stale global params), ``warmup_steps`` (full sync
+    every step at the start), ``cooldown_epochs`` accepted for parity.
+    """
+
+    def __init__(
+        self,
+        local_optimizer: DataParallelOptimizer,
+        total_local_comm_size: Optional[int] = None,
+        global_skip: int = 4,
+        stale_steps: int = 1,
+        staleness_weight: float = 0.5,
+        warmup_steps: int = 4,
+        cooldown_epochs: int = 0,
+        mesh=None,
+    ):
+        if isinstance(local_optimizer, DataParallelOptimizer):
+            self.local_optimizer = local_optimizer
+        else:
+            self.local_optimizer = DataParallelOptimizer(local_optimizer)
+        self.global_skip = max(int(global_skip), 1)
+        self.stale_steps = max(int(stale_steps), 0)
+        self.staleness_weight = float(staleness_weight)
+        self.warmup_steps = int(warmup_steps)
+        self.cooldown_epochs = cooldown_epochs
+
+        if mesh is None:
+            all_devs = jax.devices()
+            n = len(all_devs)
+            ici = total_local_comm_size or self._default_ici(n)
+            if n % ici != 0:
+                raise ValueError(f"total_local_comm_size {ici} must divide device count {n}")
+            import numpy as np
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.asarray(all_devs).reshape(n // ici, ici), ("dcn", "ici"))
+        self.mesh = mesh
+        self.n_groups = mesh.shape["dcn"]
+        self.ici_size = mesh.shape["ici"]
+        self._step_count = 0
+        self._pending = None  # (dispatched global average, due_step)
+        self._train_step = None
+        self._sync_step = None
+
+    @staticmethod
+    def _default_ici(n: int) -> int:
+        ici = 1
+        while ici * 2 <= n and n % (ici * 2) == 0 and ici * 2 <= 8:
+            ici *= 2
+        return ici
+
+    # ------------------------------------------------------------------ #
+    def init(self, module, key=None, sample_input=None):
+        """Per-group parameter replicas: leading axis n_groups, sharded over dcn."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if key is None:
+            key = jax.random.key(0)
+        params = module.init(key)
+        # stack one replica per dcn group
+        stacked = jax.tree.map(lambda p: jnp.broadcast_to(p[None], (self.n_groups,) + p.shape), params)
+        sh = lambda p: jax.device_put(p, NamedSharding(self.mesh, P("dcn", *([None] * (p.ndim - 1)))))
+        self._params = jax.tree.map(sh, stacked)
+        # per-group optimizer states
+        self._opt_state = jax.tree.map(
+            lambda s: jnp.broadcast_to(s[None], (self.n_groups,) + s.shape) if hasattr(s, "ndim") else s,
+            self.local_optimizer.optax_optimizer.init(jax.tree.map(lambda p: p[0], self._params)),
+        )
+        self.module = module
+        return self._params
+
+    @property
+    def parameters(self):
+        return self._params
+
+    def _build_steps(self, loss_fn):
+        apply = self.module.apply
+        opt = self.local_optimizer.optax_optimizer
+        mesh = self.mesh
+
+        def group_step(params, opt_state, x, y):
+            # params: one group's replica (no leading axis inside shard_map/vmap)
+            def loss(p):
+                return loss_fn(apply(p, x), y)
+
+            lval, grads = jax.value_and_grad(loss)(params)
+            # the reference's per-step NCCL allreduce == psum over 'ici';
+            # here the batch of the group is already whole per call (vmap over
+            # groups); gradient averaging inside the group is implicit in the
+            # mean loss over the group's batch shard
+            updates, new_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state, lval
+
+        @jax.jit
+        def train_step(params, opt_state, xs, ys):
+            # vmap over the dcn groups: each group trains on its own batch slice
+            return jax.vmap(group_step)(params, opt_state, xs, ys)
+
+        @jax.jit
+        def global_average(params):
+            return jax.tree.map(lambda p: jnp.mean(p, axis=0, keepdims=True), params)
+
+        @jax.jit
+        def blend(params, avg, w):
+            return jax.tree.map(
+                lambda p, a: (1.0 - w) * p + w * jnp.broadcast_to(a, p.shape), params, avg
+            )
+
+        self._train_step = train_step
+        self._global_average = global_average
+        self._blend = blend
+
+    def step(self, loss_fn, x, y):
+        """One DASO step on a global batch (leading axis divisible by n_groups).
+
+        Every step: per-group sync training (the 'ici' tier).  Every
+        ``global_skip`` steps: dispatch the cross-group parameter average (the
+        'dcn' tier); consume it ``stale_steps`` later with the staleness blend.
+        During warmup, sync fully every step.
+        """
+        if self._train_step is None:
+            self._build_steps(loss_fn)
+        jx = x._jarray if hasattr(x, "_jarray") else jnp.asarray(x)
+        jy = y._jarray if hasattr(y, "_jarray") else jnp.asarray(y)
+        g = self.n_groups
+        xs = jx.reshape((g, jx.shape[0] // g) + jx.shape[1:])
+        ys = jy.reshape((g, jy.shape[0] // g) + jy.shape[1:])
+
+        self._params, self._opt_state, losses = self._train_step(self._params, self._opt_state, xs, ys)
+        self._step_count += 1
+        t = self._step_count
+
+        if t <= self.warmup_steps:
+            avg = self._global_average(self._params)
+            self._params = self._blend(self._params, avg, 1.0)  # full sync
+        else:
+            if self._pending is not None and t >= self._pending[1]:
+                avg, _ = self._pending
+                self._params = self._blend(self._params, avg, self.staleness_weight)
+                self._pending = None
+            # dispatch a new global average only when none is in flight —
+            # otherwise stale_steps > global_skip would overwrite the pending
+            # average forever and the dcn tier would never sync
+            if t % self.global_skip == 0 and self._pending is None:
+                # dispatched now (async under JAX), consumed stale_steps later
+                avg = self._global_average(self._params)
+                if self.stale_steps == 0:
+                    self._params = self._blend(self._params, avg, self.staleness_weight)
+                else:
+                    self._pending = (avg, t + self.stale_steps)
+        return float(jnp.mean(losses))
+
+    def consolidated_params(self):
+        """The cross-group averaged parameters (for eval/checkpoint)."""
+        avg = self._global_average(self._params)
+        return jax.tree.map(lambda a: a[0], avg)
+
+    def zero_grad(self) -> None:
+        """No-op (API parity)."""

@@ -1,0 +1,33 @@
+"""Learning-rate schedules (reference: ``heat/optim/lr_scheduler.py``).
+
+The reference thin-wraps ``torch.optim.lr_scheduler`` with DASO-skip
+awareness; here the schedules are optax-native factories with the same names.
+"""
+
+from __future__ import annotations
+
+import optax
+
+__all__ = ["StepLR", "ExponentialLR", "CosineAnnealingLR", "LambdaLR"]
+
+
+def StepLR(lr: float, step_size: int, gamma: float = 0.1):
+    """Decay lr by ``gamma`` every ``step_size`` steps."""
+    return optax.exponential_decay(
+        init_value=lr, transition_steps=step_size, decay_rate=gamma, staircase=True
+    )
+
+
+def ExponentialLR(lr: float, gamma: float):
+    return optax.exponential_decay(init_value=lr, transition_steps=1, decay_rate=gamma)
+
+
+def CosineAnnealingLR(lr: float, T_max: int, eta_min: float = 0.0):
+    return optax.cosine_decay_schedule(init_value=lr, decay_steps=T_max, alpha=eta_min / lr if lr else 0.0)
+
+
+def LambdaLR(lr: float, lr_lambda):
+    def schedule(step):
+        return lr * lr_lambda(step)
+
+    return schedule

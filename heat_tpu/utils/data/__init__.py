@@ -3,3 +3,6 @@
 from . import matrixgallery
 from . import spherical
 from .spherical import create_spherical_dataset, create_clusters
+from .datatools import Dataset, DataLoader, dataset_shuffle, dataset_ishuffle
+from .mnist import MNISTDataset
+from .partial_dataset import PartialH5Dataset

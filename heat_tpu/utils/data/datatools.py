@@ -1,0 +1,129 @@
+"""Dataset/DataLoader (reference: ``heat/utils/data/datatools.py``).
+
+The reference wraps DNDarrays for per-rank batch iteration with a per-epoch
+global shuffle exchanging samples across ranks via Alltoall (SURVEY §2.5).
+Here a Dataset holds sharded global arrays; the shuffle is one device-side
+permutation gather (XLA emits the all-to-all), and ``ishuffle`` exploits
+JAX's async dispatch to overlap the next epoch's shuffle with training.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from ...core.dndarray import DNDarray
+
+__all__ = ["Dataset", "DataLoader", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """Holds one or more global arrays aligned on the sample axis."""
+
+    def __init__(self, array: Union[DNDarray, Sequence[DNDarray]], labels: Optional[DNDarray] = None,
+                 ishuffle: bool = False, test_set: bool = False):
+        arrays = [array] if isinstance(array, DNDarray) else list(array)
+        if labels is not None:
+            arrays.append(labels)
+        n = arrays[0].shape[0]
+        for a in arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the sample axis length")
+        self.arrays = arrays
+        self.has_labels = labels is not None
+        self.ishuffle = ishuffle
+        self.test_set = test_set
+        self._pending = None  # async-dispatched shuffled arrays
+
+    def __len__(self) -> int:
+        return self.arrays[0].shape[0]
+
+    def __getitem__(self, idx):
+        items = [a[idx] for a in self.arrays]
+        return items[0] if len(items) == 1 else tuple(items)
+
+    def shuffle(self, seed: Optional[int] = None):
+        """Global permutation of the sample axis (reference: Alltoall exchange)."""
+        key = jax.random.key(seed if seed is not None else np.random.randint(2**31))
+        n = len(self)
+        perm = jax.random.permutation(key, n)
+        new = []
+        for a in self.arrays:
+            g = a._jarray[perm]
+            g = a.comm.shard(g, a.split)
+            new.append(DNDarray(g, a.gshape, a.dtype, a.split, a.device, a.comm, True))
+        self.arrays = new
+
+    def ishuffle_start(self, seed: Optional[int] = None):
+        """Dispatch next epoch's shuffle asynchronously (JAX async dispatch)."""
+        key = jax.random.key(seed if seed is not None else np.random.randint(2**31))
+        perm = jax.random.permutation(key, len(self))
+        self._pending = [a._jarray[perm] for a in self.arrays]
+
+    def ishuffle_finish(self):
+        if self._pending is None:
+            return
+        new = []
+        for a, g in zip(self.arrays, self._pending):
+            g = a.comm.shard(g, a.split)
+            new.append(DNDarray(g, a.gshape, a.dtype, a.split, a.device, a.comm, True))
+        self.arrays = new
+        self._pending = None
+
+
+def dataset_shuffle(dataset: Dataset, attrs=None) -> None:
+    """Reference free-function API."""
+    dataset.shuffle()
+
+
+def dataset_ishuffle(dataset: Dataset, attrs=None) -> None:
+    dataset.ishuffle_start()
+
+
+class DataLoader:
+    """Iterate global batches of a Dataset/DNDarray.
+
+    Batches are slices along the (sharded) sample axis; with ``shuffle=True``
+    the dataset is globally re-permuted each epoch (``ishuffle`` overlaps it
+    with the tail of the previous epoch).
+    """
+
+    def __init__(self, dataset=None, batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, ishuffle: bool = False, lcl_dataset=None):
+        if dataset is None:
+            dataset = lcl_dataset
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        if dataset is None:
+            raise ValueError("DataLoader requires a dataset")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        # the dataset's own ishuffle flag turns on async shuffle too
+        # (reference usage: MNISTDataset(ishuffle=True) + DataLoader(shuffle=True))
+        self.ishuffle = ishuffle or getattr(dataset, "ishuffle", False)
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self):
+        if self.shuffle:
+            if self.ishuffle and self.dataset._pending is not None:
+                self.dataset.ishuffle_finish()
+            else:
+                self.dataset.shuffle(seed=self._epoch)
+        n = len(self.dataset)
+        nb = len(self)
+        for b in range(nb):
+            lo = b * self.batch_size
+            hi = min(lo + self.batch_size, n)
+            if self.ishuffle and self.shuffle and b == nb - 1:
+                # overlap next epoch's shuffle with the last batch
+                self.dataset.ishuffle_start(seed=self._epoch + 1)
+            yield self.dataset[lo:hi]
+        self._epoch += 1

@@ -1,0 +1,89 @@
+"""MNIST dataset (reference: ``heat/utils/data/mnist.py``).
+
+The reference wraps torchvision's MNIST with rank-sliced loading.  Here:
+reads the standard idx files from ``root`` when present (no network in this
+environment), else generates a deterministic synthetic stand-in with the
+same shapes/dtypes so the DataParallel/DASO pipelines run end-to-end.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ...core import factories, types
+from .datatools import Dataset
+
+__all__ = ["MNISTDataset"]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def _find(root: str, names) -> Optional[str]:
+    for n in names:
+        for cand in (os.path.join(root, n), os.path.join(root, "MNIST", "raw", n)):
+            for suffix in ("", ".gz"):
+                if os.path.exists(cand + suffix):
+                    return cand + suffix
+    return None
+
+
+def _synthetic(n: int, seed: int):
+    """Deterministic digit-like blobs: class k = gaussian bump at position k."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    yy, xx = np.mgrid[0:28, 0:28].astype(np.float32)
+    cx = 4 + 2.2 * (labels % 5)
+    cy = 7 + 11 * (labels // 5)
+    imgs = np.exp(
+        -((xx[None] - cx[:, None, None]) ** 2 + (yy[None] - cy[:, None, None]) ** 2) / 14.0
+    ).astype(np.float32)
+    imgs += rng.normal(0, 0.05, imgs.shape).astype(np.float32)
+    return (imgs * 255).clip(0, 255).astype(np.uint8), labels
+
+
+class MNISTDataset(Dataset):
+    """MNIST as a sharded Dataset (images float32 in [0,1], int32 labels)."""
+
+    def __init__(self, root: str = "./data", train: bool = True, transform=None,
+                 target_transform=None, ishuffle: bool = False, test_set: bool = False,
+                 split: int = 0, synthetic_n: int = 4096):
+        train = train and not test_set
+        img_names = (
+            ["train-images-idx3-ubyte", "train-images.idx3-ubyte"]
+            if train
+            else ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"]
+        )
+        lbl_names = (
+            ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"]
+            if train
+            else ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"]
+        )
+        img_path = _find(root, img_names)
+        lbl_path = _find(root, lbl_names)
+        if img_path and lbl_path:
+            imgs = _read_idx(img_path)
+            labels = _read_idx(lbl_path).astype(np.int32)
+            self.synthetic = False
+        else:
+            imgs, labels = _synthetic(synthetic_n if train else synthetic_n // 4, seed=0 if train else 1)
+            self.synthetic = True
+        x = imgs.astype(np.float32) / 255.0
+        if transform is not None:
+            x = np.asarray([transform(i) for i in x])
+        images = factories.array(x, split=split)
+        targets = factories.array(labels, split=split)
+        super().__init__(images, labels=targets, ishuffle=ishuffle, test_set=test_set)
+        self.images = images
+        self.targets = targets

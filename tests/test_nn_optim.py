@@ -1,0 +1,177 @@
+"""NN/optim/data-tools tests (reference: heat/nn/tests, heat/optim/tests,
+heat/utils/data tests)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestModules:
+    def test_linear_relu_forward(self):
+        import jax
+
+        m = ht.nn.Sequential(ht.nn.Linear(4, 8), ht.nn.ReLU(), ht.nn.Linear(8, 2))
+        params = m.init(jax.random.key(0))
+        x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+        y = m.apply(params, x)
+        assert y.shape == (16, 2)
+        # relu clamp check through the stack
+        relu_out = ht.nn.ReLU().apply((), np.array([-1.0, 2.0]))
+        np.testing.assert_array_equal(np.asarray(relu_out), [0.0, 2.0])
+
+    def test_conv_pool(self):
+        import jax
+
+        m = ht.nn.Sequential(ht.nn.Conv2d(1, 4, 3, padding=1), ht.nn.ReLU(), ht.nn.MaxPool2d(2))
+        params = m.init(jax.random.key(1))
+        x = np.random.default_rng(1).normal(size=(2, 1, 8, 8)).astype(np.float32)
+        y = m.apply(params, x)
+        assert y.shape == (2, 4, 4, 4)
+
+    def test_dropout_train_eval(self):
+        import jax
+
+        d = ht.nn.Dropout(0.5)
+        x = np.ones((100,), dtype=np.float32)
+        out_eval = d.apply((), x, train=False)
+        np.testing.assert_array_equal(np.asarray(out_eval), x)
+        out_train = d.apply((), x, train=True, key=jax.random.key(0))
+        assert 0 < np.count_nonzero(np.asarray(out_train)) < 100
+
+
+class TestDataParallel(TestModules):
+    def _setup(self):
+        import jax
+
+        ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=1024)
+        model = ht.nn.Sequential(
+            ht.nn.Flatten(), ht.nn.Linear(784, 32), ht.nn.ReLU(), ht.nn.Linear(32, 10)
+        )
+        opt = ht.optim.DataParallelOptimizer("adam", lr=2e-3)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        params = dp.init(jax.random.key(0))
+        state = opt.init_state(params)
+        return ds, dp, opt, params, state
+
+    def test_mlp_training_loss_decreases(self):
+        ds, dp, opt, params, state = self._setup()
+        step = dp.make_train_step(ht.nn.functional.cross_entropy)
+        loader = ht.utils.data.DataLoader(ds, batch_size=256, shuffle=True)
+        losses = []
+        for _ in range(4):
+            for xb, yb in loader:
+                params, state, l = step(params, state, xb._jarray, yb._jarray)
+                losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.8
+
+    def test_forward_returns_dndarray(self):
+        ds, dp, opt, params, state = self._setup()
+        out = dp(ds.images[:32])
+        assert isinstance(out, ht.DNDarray)
+        assert out.shape == (32, 10)
+        assert out.split == 0
+
+    def test_state_dict_roundtrip(self):
+        ds, dp, opt, params, state = self._setup()
+        sd = dp.state_dict()
+        assert len(sd) > 0
+        dp.load_state_dict({k: np.asarray(v) for k, v in sd.items()})
+        out1 = dp(ds.images[:8]).numpy()
+        assert np.isfinite(out1).all()
+
+
+class TestDASO:
+    def test_hierarchical_training(self):
+        ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=1024)
+        model = ht.nn.Sequential(
+            ht.nn.Flatten(), ht.nn.Linear(784, 32), ht.nn.ReLU(), ht.nn.Linear(32, 10)
+        )
+        daso = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer("adam", lr=2e-3),
+            total_local_comm_size=2, global_skip=4, stale_steps=2, warmup_steps=3,
+        )
+        assert daso.n_groups == 4
+        daso.init(model)
+        losses = [
+            daso.step(ht.nn.functional.cross_entropy, ds.images[:512], ds.targets[:512])
+            for _ in range(25)
+        ]
+        assert losses[-1] < losses[0] * 0.7
+        # blending keeps replicas together
+        import jax.numpy as jnp
+
+        w = daso.parameters[1]["weight"]
+        div = float(jnp.max(jnp.abs(w - jnp.mean(w, axis=0, keepdims=True))))
+        assert div < 1.0
+        cp = daso.consolidated_params()
+        assert cp[1]["weight"].shape == (32, 784)
+
+    def test_invalid_group_size(self):
+        with pytest.raises(ValueError):
+            ht.optim.DASO(ht.optim.DataParallelOptimizer("sgd", lr=0.1), total_local_comm_size=3)
+
+
+class TestDataTools:
+    def test_loader_batches(self):
+        x = ht.arange(40, dtype=ht.float32, split=0).reshape(40, 1) if False else ht.array(
+            np.arange(40, dtype=np.float32).reshape(40, 1), split=0
+        )
+        y = ht.array(np.arange(40, dtype=np.int32), split=0)
+        ds = ht.utils.data.Dataset(x, labels=y)
+        loader = ht.utils.data.DataLoader(ds, batch_size=16)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert batches[0][0].shape == (16, 1)
+        assert batches[2][0].shape == (8, 1)
+        loader = ht.utils.data.DataLoader(ds, batch_size=16, drop_last=True)
+        assert len(list(loader)) == 2
+
+    def test_global_shuffle_preserves_pairs(self):
+        x = ht.array(np.arange(32, dtype=np.float32).reshape(32, 1), split=0)
+        y = ht.array(np.arange(32, dtype=np.int32), split=0)
+        ds = ht.utils.data.Dataset(x, labels=y)
+        ds.shuffle(seed=0)
+        xs, ys = ds.arrays[0].numpy().ravel(), ds.arrays[1].numpy()
+        np.testing.assert_array_equal(xs.astype(np.int32), ys)  # pairs move together
+        assert not np.array_equal(ys, np.arange(32))  # actually permuted
+        np.testing.assert_array_equal(np.sort(ys), np.arange(32))
+
+    def test_ishuffle_overlap(self):
+        x = ht.array(np.arange(32, dtype=np.float32).reshape(32, 1), split=0)
+        ds = ht.utils.data.Dataset(x, ishuffle=True)
+        loader = ht.utils.data.DataLoader(ds, batch_size=8, shuffle=True, ishuffle=True)
+        for _ in loader:
+            pass
+        assert ds._pending is not None  # next epoch's shuffle was dispatched
+        for _ in loader:
+            pass
+
+    def test_mnist_synthetic(self):
+        ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=256)
+        assert ds.synthetic
+        assert ds.images.shape == (256, 28, 28)
+        assert 0.0 <= float(ds.images.min().item()) and float(ds.images.max().item()) <= 1.0
+        assert set(np.unique(ds.targets.numpy())) <= set(range(10))
+
+    def test_partial_h5(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        p = str(tmp_path / "t.h5")
+        data = np.arange(100, dtype=np.float32).reshape(50, 2)
+        with h5py.File(p, "w") as f:
+            f.create_dataset("data", data=data)
+        ds = ht.utils.data.PartialH5Dataset(p, initial_load=20)
+        chunks = list(ds)
+        assert len(chunks) == 3
+        got = np.concatenate([c.numpy() for c in chunks], axis=0)
+        np.testing.assert_array_equal(got, data)
+
+
+class TestLRSchedulers:
+    def test_schedules(self):
+        s = ht.optim.lr_scheduler.StepLR(1.0, step_size=10, gamma=0.1)
+        assert float(s(0)) == pytest.approx(1.0)
+        assert float(s(10)) == pytest.approx(0.1)
+        c = ht.optim.lr_scheduler.CosineAnnealingLR(1.0, T_max=100)
+        assert float(c(0)) == pytest.approx(1.0)
+        assert float(c(100)) == pytest.approx(0.0, abs=1e-6)
