@@ -1,0 +1,20 @@
+"""KMeans on synthetic blobs — the reference's flagship demo (config[2] shape).
+
+Run (CPU mesh): XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/kmeans_demo.py
+"""
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    data = ht.utils.data.create_spherical_dataset(num_samples_cluster=10_000)
+    print(f"data: {data.shape}, split={data.split} over {data.comm.size} shards")
+    km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", random_state=0)
+    km.fit(data)
+    print(f"converged in {km.n_iter_} iterations, inertia={km.inertia_:.1f}")
+    print("centers (mean per cluster):")
+    print(km.cluster_centers_.numpy().mean(axis=1))
+
+
+if __name__ == "__main__":
+    main()

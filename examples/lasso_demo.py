@@ -1,0 +1,27 @@
+"""Lasso sparse-recovery demo (reference: ``examples/lasso``)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    n, d = 2048, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.zeros(d, dtype=np.float32)
+    w_true[[1, 4, 9]] = [2.0, -3.0, 1.5]
+    y = X @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+
+    hX = ht.array(X, split=0)
+    hy = ht.array(y.reshape(-1, 1), split=0)
+    lasso = ht.regression.Lasso(lam=0.01, max_iter=200)
+    lasso.fit(hX, hy)
+    print("true nonzeros :", np.nonzero(w_true)[0].tolist())
+    coef = lasso.coef_.numpy().ravel()
+    print("found nonzeros:", np.nonzero(np.abs(coef) > 0.05)[0].tolist())
+    print("coefficients  :", np.round(coef, 2).tolist())
+
+
+if __name__ == "__main__":
+    main()

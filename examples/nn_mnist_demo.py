@@ -1,0 +1,38 @@
+"""DataParallel MLP on MNIST — BASELINE config[3] (reference NN demo)."""
+
+import jax
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    ds = ht.utils.data.MNISTDataset(root="./data", train=True)
+    loader = ht.utils.data.DataLoader(ds, batch_size=256, shuffle=True)
+    model = ht.nn.Sequential(
+        ht.nn.Flatten(),
+        ht.nn.Linear(784, 128), ht.nn.ReLU(),
+        ht.nn.Linear(128, 64), ht.nn.ReLU(),
+        ht.nn.Linear(64, 10),
+    )
+    opt = ht.optim.DataParallelOptimizer("adam", lr=1e-3)
+    dp = ht.nn.DataParallel(model, optimizer=opt)
+    params = dp.init(jax.random.key(0))
+    state = opt.init_state(params)
+    step = dp.make_train_step(ht.nn.functional.cross_entropy)
+
+    for epoch in range(3):
+        last = None
+        for xb, yb in loader:
+            params, state, last = step(params, state, xb._jarray, yb._jarray)
+        print(f"epoch {epoch}: loss={float(last):.4f}")
+
+    dp.parameters = params
+    import numpy as np
+
+    logits = dp(ds.images)
+    acc = (np.argmax(logits.numpy(), axis=1) == ds.targets.numpy()).mean()
+    print(f"train accuracy: {acc:.3f}  (synthetic={ds.synthetic})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Signal processing (reference: ``heat/core/signal.py``).
+
+1-D ``convolve`` with full/same/valid modes.  The reference exchanges halos
+(Isend/Irecv with neighbors) and runs local ``torch.conv1d``; here the
+default path is one global XLA convolution (the partitioner materializes the
+boundary exchange), and an explicit shard_map halo path
+(``parallel.halo``) demonstrates the manual-control skeleton.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import types
+from .dndarray import DNDarray
+from .sanitation import sanitize_in
+
+__all__ = ["convolve", "convolve2d"]
+
+
+def _conv1d_full(a: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Full correlation-free convolution via XLA conv (MXU-eligible)."""
+    n, m = a.shape[0], v.shape[0]
+    # conv_general_dilated computes correlation; flip the kernel for convolution
+    lhs = a.reshape(1, 1, n)
+    rhs = v[::-1].reshape(1, 1, m)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)]
+    )
+    return out.reshape(-1)
+
+
+def convolve(a: DNDarray, v: DNDarray, mode: str = "full", stride: int = 1) -> DNDarray:
+    """Discrete 1-D convolution of ``a`` with kernel ``v`` (numpy modes)."""
+    from . import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v)
+    if a.ndim != 1 or v.ndim != 1:
+        raise ValueError("convolve requires 1-D inputs")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"Unsupported mode {mode!r}")
+    if stride != 1:
+        raise NotImplementedError("stride != 1 not supported (reference parity)")
+    n, m = a.shape[0], v.shape[0]
+    signal = a  # output metadata follows the SIGNAL even if operands swap
+    if n < m:
+        a, v = v, a
+        n, m = m, n
+    dt = types.promote_types(a.dtype, v.dtype)
+    if types.heat_type_is_exact(dt):
+        work_dt = types.float32
+    else:
+        work_dt = dt
+    ja = a._jarray.astype(work_dt.jax_dtype())
+    jv = v._jarray.astype(work_dt.jax_dtype())
+
+    full = _conv1d_full(ja, jv)
+    if mode == "full":
+        res = full
+    elif mode == "same":
+        lo = (m - 1) // 2
+        res = full[lo : lo + n]
+    else:  # valid
+        res = full[m - 1 : m - 1 + n - m + 1]
+    if types.heat_type_is_exact(dt):
+        res = jnp.round(res).astype(dt.jax_dtype())
+    split = signal.split
+    res = signal.comm.shard(res, split)
+    return DNDarray(
+        res, tuple(res.shape), types.canonical_heat_type(res.dtype), split,
+        signal.device, signal.comm, True,
+    )
+
+
+def convolve2d(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
+    """2-D convolution (extension beyond the reference's 1-D surface)."""
+    from . import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v)
+    if a.ndim != 2 or v.ndim != 2:
+        raise ValueError("convolve2d requires 2-D inputs")
+    n0, n1 = a.shape
+    m0, m1 = v.shape
+    lhs = a._jarray.astype(jnp.float32).reshape(1, 1, n0, n1)
+    rhs = v._jarray.astype(jnp.float32)[::-1, ::-1].reshape(1, 1, m0, m1)
+    if mode == "full":
+        pad = [(m0 - 1, m0 - 1), (m1 - 1, m1 - 1)]
+    elif mode == "same":
+        pad = [((m0 - 1) // 2, m0 // 2), ((m1 - 1) // 2, m1 // 2)]
+    elif mode == "valid":
+        pad = [(0, 0), (0, 0)]
+    else:
+        raise ValueError(f"Unsupported mode {mode!r}")
+    out = jax.lax.conv_general_dilated(lhs, rhs, window_strides=(1, 1), padding=pad)
+    res = out.reshape(out.shape[2], out.shape[3])
+    res = a.comm.shard(res, a.split)
+    return DNDarray(
+        res, tuple(res.shape), types.canonical_heat_type(res.dtype), a.split, a.device, a.comm, True
+    )

@@ -1,0 +1,161 @@
+"""Tile views over distributed arrays (reference: ``heat/core/tiling.py``).
+
+The reference's ``SplitTiles``/``SquareDiagTiles`` give per-tile
+``(rank, row, col)`` addressing with async tile send/recv — infrastructure
+for its blocked QR/matmul.  Under XLA, cross-shard tile motion is implicit,
+so these classes reduce to *index algebra* over the global array: a tile is
+a slice, reads/writes are sharded gathers/scatters.  The API (tile_locations,
+tile_dimensions, ``__getitem__``/``__setitem__``) is kept for parity and for
+algorithms that want explicit block addressing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """One tile per mesh shard along every axis (reference semantics)."""
+
+    def __init__(self, arr: DNDarray):
+        self.__arr = arr
+        comm = arr.comm
+        sizes = []
+        for dim, g in enumerate(arr.gshape):
+            counts, _ = comm.counts_displs_shape(arr.gshape, dim)
+            sizes.append(np.asarray(counts, dtype=np.int64))
+        self.__tile_dims = sizes
+        self.__tile_ends = [np.cumsum(s) for s in sizes]
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_dimensions(self):
+        """Per-axis tile edge lengths (list of per-shard sizes)."""
+        return self.__tile_dims
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Which shard owns each tile along the split axis (None split → 0)."""
+        comm = self.__arr.comm
+        split = self.__arr.split
+        shape = tuple(comm.size for _ in self.__arr.gshape)
+        locs = np.zeros(shape, dtype=np.int64)
+        if split is not None:
+            idx = [None] * len(shape)
+            view = np.arange(comm.size)
+            expand = [1] * len(shape)
+            expand[split] = comm.size
+            locs[...] = view.reshape(expand)
+        return locs
+
+    def _slices(self, key) -> Tuple[slice, ...]:
+        key_t = key if isinstance(key, tuple) else (key,)
+        slices = []
+        for dim in range(self.__arr.ndim):
+            ends = self.__tile_ends[dim]
+            starts = np.concatenate([[0], ends[:-1]])
+            if dim < len(key_t) and key_t[dim] is not None and not (
+                isinstance(key_t[dim], slice) and key_t[dim] == slice(None)
+            ):
+                k = key_t[dim]
+                if isinstance(k, slice):
+                    lo = starts[k.start or 0]
+                    hi = ends[(k.stop or len(ends)) - 1]
+                    slices.append(slice(int(lo), int(hi)))
+                else:
+                    slices.append(slice(int(starts[int(k)]), int(ends[int(k)])))
+            else:
+                slices.append(slice(0, int(ends[-1])))
+        return tuple(slices)
+
+    def __getitem__(self, key):
+        return self.__arr._jarray[self._slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        jarr = self.__arr._jarray.at[self._slices(key)].set(
+            value._jarray if isinstance(value, DNDarray) else value
+        )
+        self.__arr._jarray = self.__arr.comm.shard(jarr, self.__arr.split)
+
+
+class SquareDiagTiles:
+    """Square tiles along the diagonal (reference: blocked QR infrastructure).
+
+    ``tiles_per_proc`` square blocks per shard along the split axis; exposes
+    row/col decomposition indices and tile get/set by (row, col).
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if arr.ndim != 2:
+            raise ValueError("SquareDiagTiles requires a 2-D array")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        self.__arr = arr
+        m, n = arr.gshape
+        nprocs = arr.comm.size
+        ntiles = max(1, min(nprocs * tiles_per_proc, min(m, n)))
+        base = min(m, n) // ntiles
+        row_per = np.full(ntiles, base, dtype=np.int64)
+        row_per[: min(m, n) - base * ntiles] += 1
+        # rows may extend past the square part
+        rows = list(row_per)
+        if m > n:
+            rows.append(m - int(np.sum(row_per)))
+            rows = [r for r in rows if r > 0]
+        cols = list(row_per)
+        if n > m:
+            cols.append(n - int(np.sum(row_per)))
+            cols = [c for c in cols if c > 0]
+        self.__row_per_proc_list = rows
+        self.__col_per_proc_list = cols
+        self.__row_ends = np.cumsum(rows)
+        self.__col_ends = np.cumsum(cols)
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_rows(self) -> int:
+        return len(self.__row_per_proc_list)
+
+    @property
+    def tile_columns(self) -> int:
+        return len(self.__col_per_proc_list)
+
+    @property
+    def row_indices(self):
+        return [0] + list(self.__row_ends[:-1])
+
+    @property
+    def col_indices(self):
+        return [0] + list(self.__col_ends[:-1])
+
+    def _slice(self, row: int, col: int) -> Tuple[slice, slice]:
+        rs = 0 if row == 0 else int(self.__row_ends[row - 1])
+        re = int(self.__row_ends[row])
+        cs = 0 if col == 0 else int(self.__col_ends[col - 1])
+        ce = int(self.__col_ends[col])
+        return slice(rs, re), slice(cs, ce)
+
+    def __getitem__(self, key):
+        row, col = key
+        return self.__arr._jarray[self._slice(row, col)]
+
+    def __setitem__(self, key, value) -> None:
+        row, col = key
+        jarr = self.__arr._jarray.at[self._slice(row, col)].set(
+            value._jarray if isinstance(value, DNDarray) else value
+        )
+        self.__arr._jarray = self.__arr.comm.shard(jarr, self.__arr.split)

@@ -1,1 +1,4 @@
-"""Placeholder — populated in this round."""
+"""FFT operations (reference: ``heat/fft/``)."""
+
+from .fft import *
+from . import fft

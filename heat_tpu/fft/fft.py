@@ -1,0 +1,154 @@
+"""FFT namespace (reference: ``heat/fft/fft.py``).
+
+The reference's rule: transforms along non-split dims are local; a transform
+hitting the split axis resplits to move it local, transforms, and resplits
+back ("transpose method", SURVEY §2.2).  Under XLA the same data movement is
+derived from the sharding — each function here simply preserves the input
+split on the output and lets the partitioner insert the all-to-alls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = [
+    "fft", "fft2", "fftn", "fftfreq", "fftshift",
+    "hfft", "hfft2", "hfftn",
+    "ifft", "ifft2", "ifftn", "ifftshift", "ihfft", "ihfft2", "ihfftn",
+    "irfft", "irfft2", "irfftn",
+    "rfft", "rfft2", "rfftfreq", "rfftn",
+]
+
+
+def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
+    if split is not None and split >= jarr.ndim:
+        split = None
+    jarr = proto.comm.shard(jarr, split)
+    return DNDarray(
+        jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
+    )
+
+
+def _fft_op(op_name: str, x: DNDarray, n=None, axis=-1, norm=None) -> DNDarray:
+    sanitize_in(x)
+    op = getattr(jnp.fft, op_name)
+    res = op(x._jarray, n=n, axis=axis, norm=norm)
+    return _wrap(res, x.split, x)
+
+
+def _fftn_op(op_name: str, x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    sanitize_in(x)
+    op = getattr(jnp.fft, op_name)
+    res = op(x._jarray, s=s, axes=axes, norm=norm)
+    return _wrap(res, x.split, x)
+
+
+def fft(x, n=None, axis=-1, norm=None) -> DNDarray:
+    """1-D discrete Fourier transform along ``axis``."""
+    return _fft_op("fft", x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm=None) -> DNDarray:
+    return _fft_op("ifft", x, n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm=None) -> DNDarray:
+    return _fft_op("rfft", x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm=None) -> DNDarray:
+    return _fft_op("irfft", x, n=n, axis=axis, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm=None) -> DNDarray:
+    return _fft_op("hfft", x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm=None) -> DNDarray:
+    return _fft_op("ihfft", x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    return _fftn_op("fft2", x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    return _fftn_op("ifft2", x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    return _fftn_op("rfft2", x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    return _fftn_op("irfft2", x, s=s, axes=axes, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    sanitize_in(x)
+    if s is not None:
+        raise NotImplementedError("hfft2 with explicit shape not supported")
+    res = jnp.fft.hfft(jnp.fft.fft(x._jarray, axis=axes[0], norm=norm), axis=axes[1], norm=norm)
+    return _wrap(res, x.split, x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    sanitize_in(x)
+    if s is not None:
+        raise NotImplementedError("ihfft2 with explicit shape not supported")
+    res = jnp.fft.ifft(jnp.fft.ihfft(x._jarray, axis=axes[1], norm=norm), axis=axes[0], norm=norm)
+    return _wrap(res, x.split, x)
+
+
+def fftn(x, s=None, axes=None, norm=None) -> DNDarray:
+    return _fftn_op("fftn", x, s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm=None) -> DNDarray:
+    return _fftn_op("ifftn", x, s=s, axes=axes, norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm=None) -> DNDarray:
+    return _fftn_op("rfftn", x, s=s, axes=axes, norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm=None) -> DNDarray:
+    return _fftn_op("irfftn", x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm=None) -> DNDarray:
+    raise NotImplementedError("hfftn is not provided by jnp.fft; use hfft per-axis")
+
+
+def ihfftn(x, s=None, axes=None, norm=None) -> DNDarray:
+    raise NotImplementedError("ihfftn is not provided by jnp.fft; use ihfft per-axis")
+
+
+def fftfreq(n: int, d: float = 1.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    from ..core import factories
+
+    res = jnp.fft.fftfreq(n, d=d)
+    return factories.array(res, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def rfftfreq(n: int, d: float = 1.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    from ..core import factories
+
+    res = jnp.fft.rfftfreq(n, d=d)
+    return factories.array(res, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def fftshift(x, axes=None) -> DNDarray:
+    sanitize_in(x)
+    return _wrap(jnp.fft.fftshift(x._jarray, axes=axes), x.split, x)
+
+
+def ifftshift(x, axes=None) -> DNDarray:
+    sanitize_in(x)
+    return _wrap(jnp.fft.ifftshift(x._jarray, axes=axes), x.split, x)

@@ -127,10 +127,27 @@ class DataParallel:
         apply = self.module.apply
         opt = self.optimizer
 
-        def _forward(p, jx, key):
-            try:
+        # decide the calling convention ONCE from the signature — catching
+        # TypeError around the call would swallow genuine train-path errors
+        # and silently fall back to eval mode
+        import inspect
+
+        try:
+            sig = inspect.signature(apply)
+            accepts_train = "train" in sig.parameters or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
+            )
+        except (TypeError, ValueError):
+            accepts_train = False
+
+        if accepts_train:
+
+            def _forward(p, jx, key):
                 return apply(p, jx, train=True, key=key)
-            except TypeError:
+
+        else:
+
+            def _forward(p, jx, key):
                 return apply(p, jx)  # flax-style apply without train/key kwargs
 
         if with_rng:

@@ -1,1 +1,5 @@
-"""Placeholder — populated in this round."""
+"""Distributed sparse matrices (reference: ``heat/sparse/``)."""
+
+from .dcsr_matrix import DCSR_matrix
+from .factories import sparse_csr_matrix, sparse_csc_matrix
+from ._arithmetics import add, mul

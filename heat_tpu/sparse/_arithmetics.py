@@ -1,0 +1,41 @@
+"""Sparse elementwise ops (reference: ``heat/sparse/arithmetics.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import types
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["add", "mul"]
+
+
+def _binary(t1: DCSR_matrix, t2: DCSR_matrix, densify_op=None) -> DCSR_matrix:
+    """``densify_op=None`` → native sparse+sparse add; otherwise the
+    elementwise op runs fused-dense then re-sparsifies (one fused TPU kernel)."""
+    if not isinstance(t1, DCSR_matrix) or not isinstance(t2, DCSR_matrix):
+        raise TypeError("sparse binary ops require DCSR_matrix operands")
+    if t1.shape != t2.shape:
+        raise ValueError(f"shapes {t1.shape} and {t2.shape} do not match")
+    if densify_op is None:
+        res = jsparse.bcoo_sum_duplicates((t1.larray + t2.larray))
+    else:
+        dense = densify_op(t1.larray.todense(), t2.larray.todense())
+        res = jsparse.BCOO.fromdense(dense)
+    dt = types.canonical_heat_type(res.data.dtype)
+    return DCSR_matrix(res, int(res.nse), t1.shape, dt, t1.split, t1.device, t1.comm, True)
+
+
+def add(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
+    """Elementwise sparse + sparse."""
+    return _binary(t1, t2)
+
+
+def mul(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
+    """Elementwise sparse * sparse (intersection of patterns)."""
+    return _binary(t1, t2, jnp.multiply)
+
+
+DCSR_matrix.__add__ = add
+DCSR_matrix.__mul__ = mul

@@ -1,0 +1,154 @@
+"""Distributed sparse CSR matrix (reference: ``heat/sparse/dcsr_matrix.py``).
+
+``DCSR_matrix``: globally a CSR matrix split along rows (split=0 only, like
+the reference), locally a ``jax.experimental.sparse.BCOO`` block.  Sparse
+kernels on TPU route through XLA's scatter/gather; matmul against dense
+operands uses the BCOO dot_general path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core import devices as ht_devices
+from ..core import types
+from ..core.communication import Communication, sanitize_comm
+
+__all__ = ["DCSR_matrix"]
+
+
+class DCSR_matrix:
+    """Distributed CSR: global shape, row-split over the mesh (split ∈ {None, 0})."""
+
+    def __init__(self, array: jsparse.BCOO, gnnz: int, gshape: Tuple[int, int],
+                 dtype, split: Optional[int], device, comm: Communication, balanced: bool = True):
+        self.__array = array
+        self.__gnnz = gnnz
+        self.__gshape = tuple(gshape)
+        self.__dtype = types.canonical_heat_type(dtype)
+        self.__split = split
+        self.__device = device
+        self.__comm = comm
+        self.__balanced = balanced
+
+    # ------------------------------------------------------------------ #
+    @property
+    def larray(self) -> jsparse.BCOO:
+        return self.__array
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.__gshape
+
+    @property
+    def gshape(self) -> Tuple[int, int]:
+        return self.__gshape
+
+    @property
+    def lshape(self) -> Tuple[int, int]:
+        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
+        return lshape
+
+    @property
+    def nnz(self) -> int:
+        return self.__gnnz
+
+    @property
+    def gnnz(self) -> int:
+        return self.__gnnz
+
+    @property
+    def lnnz(self) -> int:
+        return int(self.__array.nse)
+
+    @property
+    def dtype(self):
+        return self.__dtype
+
+    @property
+    def split(self) -> Optional[int]:
+        return self.__split
+
+    @property
+    def device(self):
+        return self.__device
+
+    @property
+    def comm(self) -> Communication:
+        return self.__comm
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def data(self):
+        """Non-zero values (reference CSR attribute)."""
+        return self.__array.data
+
+    @property
+    def indices(self):
+        """Column indices of the non-zeros."""
+        return self.__array.indices[:, 1]
+
+    @property
+    def indptr(self):
+        """CSR row pointers (computed from COO rows)."""
+        rows = self.__array.indices[:, 0]
+        counts = jnp.bincount(rows, length=self.__gshape[0])
+        return jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])
+
+    # reference aliases
+    lindptr = indptr
+    lindices = indices
+    ldata = data
+
+    # ------------------------------------------------------------------ #
+    def todense(self):
+        from ..core.dndarray import DNDarray
+
+        dense = self.__array.todense()
+        dense = self.__comm.shard(dense, self.__split)
+        return DNDarray(
+            dense, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm, True
+        )
+
+    def astype(self, dtype) -> "DCSR_matrix":
+        dtype = types.canonical_heat_type(dtype)
+        arr = jsparse.BCOO(
+            (self.__array.data.astype(dtype.jax_dtype()), self.__array.indices),
+            shape=self.__array.shape,
+        )
+        return DCSR_matrix(arr, self.__gnnz, self.__gshape, dtype, self.__split,
+                           self.__device, self.__comm, self.__balanced)
+
+    def copy(self) -> "DCSR_matrix":
+        return DCSR_matrix(self.__array, self.__gnnz, self.__gshape, self.__dtype,
+                           self.__split, self.__device, self.__comm, self.__balanced)
+
+    def __matmul__(self, other):
+        from ..core.dndarray import DNDarray
+
+        if isinstance(other, DNDarray):
+            res = self.__array @ other._jarray
+            res = self.__comm.shard(res, self.__split)
+            return DNDarray(
+                res, tuple(res.shape), types.canonical_heat_type(res.dtype),
+                self.__split, self.__device, self.__comm, True,
+            )
+        if isinstance(other, DCSR_matrix):
+            res = (self.__array @ other.larray).sum_duplicates()
+            return DCSR_matrix(res, int(res.nse), (self.__gshape[0], other.gshape[1]),
+                               self.__dtype, self.__split, self.__device, self.__comm, True)
+        raise TypeError(f"unsupported matmul operand {type(other)}")
+
+    def __repr__(self) -> str:
+        return (
+            f"DCSR_matrix(shape={self.__gshape}, nnz={self.__gnnz}, "
+            f"dtype=ht.{self.__dtype.__name__}, split={self.__split})"
+        )

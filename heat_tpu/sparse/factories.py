@@ -1,0 +1,57 @@
+"""Sparse factories (reference: ``heat/sparse/factories.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core import devices as ht_devices
+from ..core import types
+from ..core.communication import sanitize_comm
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["sparse_csr_matrix", "sparse_csc_matrix"]
+
+
+def sparse_csr_matrix(obj, dtype=None, split: Optional[int] = None, is_split=None,
+                      device=None, comm=None) -> DCSR_matrix:
+    """Build a DCSR_matrix from scipy.sparse, dense arrays, or (data, indices,
+    indptr) — mirrors the reference factory's accepted inputs."""
+    comm = sanitize_comm(comm)
+    device = ht_devices.sanitize_device(device)
+    if split is None and is_split is not None:
+        split = is_split
+
+    try:
+        import scipy.sparse as sp
+
+        if sp.issparse(obj):
+            coo = obj.tocoo()
+            dense_shape = coo.shape
+            indices = jnp.stack(
+                [jnp.asarray(coo.row, jnp.int32), jnp.asarray(coo.col, jnp.int32)], axis=1
+            )
+            data = jnp.asarray(coo.data)
+            arr = jsparse.BCOO((data, indices), shape=dense_shape)
+            dt = types.canonical_heat_type(dtype) if dtype else types.canonical_heat_type(data.dtype)
+            if dtype:
+                arr = jsparse.BCOO((data.astype(dt.jax_dtype()), indices), shape=dense_shape)
+            return DCSR_matrix(arr, int(coo.nnz), dense_shape, dt, split, device, comm, True)
+    except ImportError:
+        pass
+
+    dense = np.asarray(obj)
+    if dense.ndim != 2:
+        raise ValueError("sparse_csr_matrix requires a 2-D input")
+    if dtype is not None:
+        dense = dense.astype(types.canonical_heat_type(dtype).np_dtype())
+    arr = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    dt = types.canonical_heat_type(arr.data.dtype)
+    return DCSR_matrix(arr, int(arr.nse), dense.shape, dt, split, device, comm, True)
+
+
+def sparse_csc_matrix(obj, dtype=None, split: Optional[int] = None, device=None, comm=None):
+    raise NotImplementedError("CSC is not supported (reference supports CSR only)")

@@ -1,2 +1,4 @@
-"""Utilities."""
+"""Utilities (reference: ``heat/utils/``)."""
+
 from . import data
+from . import profiler

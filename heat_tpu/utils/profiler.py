@@ -1,0 +1,50 @@
+"""Profiling shim (SURVEY §5.1).
+
+The reference has no built-in tracer (external perun only).  On TPU we get a
+first-class story: this wraps ``jax.profiler`` so benchmarks are one-liner
+instrumented, plus a wall-clock timer that forces completion (the tunneled
+platform's ``block_until_ready`` can be a no-op, so timers fetch a scalar).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = ["trace", "timer", "sync", "annotate"]
+
+
+def sync(x=None) -> None:
+    """Force device completion (fetch-based; tunnel-safe)."""
+    if x is None:
+        return
+    arr = getattr(x, "_jarray", x)
+    try:
+        np.asarray(jax.device_get(arr.ravel()[:1] if hasattr(arr, "ravel") else arr))
+    except Exception:
+        jax.block_until_ready(arr)
+
+
+@contextlib.contextmanager
+def timer(label: str = "", result_holder: Optional[dict] = None, sync_on=None):
+    """Wall-clock a block; forces completion of ``sync_on`` before stopping."""
+    t0 = time.perf_counter()
+    yield
+    sync(sync_on)
+    dt = time.perf_counter() - t0
+    if result_holder is not None:
+        result_holder[label or "elapsed"] = dt
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/heat_tpu_trace"):
+    """XProf/TensorBoard trace of the block (``jax.profiler.trace``)."""
+    with jax.profiler.trace(logdir):
+        yield
+
+
+annotate = jax.profiler.TraceAnnotation

@@ -1,0 +1,196 @@
+"""I/O, FFT, sparse, signal, tiling tests (reference: test_io.py,
+heat/fft/tests, heat/sparse/tests, test_signal.py, test_tiling.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestSignal(TestCase):
+    def test_convolve_modes(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=37).astype(np.float32)
+        v = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        for split in [None, 0]:
+            for mode in ("full", "same", "valid"):
+                got = ht.convolve(ht.array(a, split=split), ht.array(v), mode=mode)
+                np.testing.assert_allclose(got.numpy(), np.convolve(a, v, mode=mode), atol=1e-4)
+
+    def test_convolve_int_and_swap(self):
+        a = np.array([1, 2, 3], dtype=np.int32)
+        v = np.array([0, 1, 0, 0, 0], dtype=np.int32)
+        got = ht.convolve(ht.array(a), ht.array(v), mode="full")
+        np.testing.assert_array_equal(got.numpy(), np.convolve(a, v))
+        assert got.dtype == ht.int32
+
+    def test_convolve_errors(self):
+        with pytest.raises(ValueError):
+            ht.convolve(ht.ones((2, 2)), ht.ones(3))
+        with pytest.raises(ValueError):
+            ht.convolve(ht.ones(5), ht.ones(3), mode="bogus")
+
+    def test_convolve2d(self):
+        from scipy.signal import convolve2d as sconv
+
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(9, 9)).astype(np.float32)
+        v = rng.normal(size=(3, 3)).astype(np.float32)
+        for mode in ("full", "same", "valid"):
+            got = ht.core.signal.convolve2d(ht.array(a, split=0), ht.array(v), mode=mode)
+            np.testing.assert_allclose(got.numpy(), sconv(a, v, mode=mode), atol=1e-3)
+
+
+class TestFFT(TestCase):
+    def setup_method(self, method):
+        self.x = np.random.default_rng(2).normal(size=(8, 16)).astype(np.float32)
+
+    def test_fft_family(self):
+        for split in [None, 0, 1]:
+            a = ht.array(self.x, split=split)
+            np.testing.assert_allclose(ht.fft.fft(a).numpy(), np.fft.fft(self.x), atol=1e-3)
+            np.testing.assert_allclose(ht.fft.rfft(a).numpy(), np.fft.rfft(self.x), atol=1e-3)
+            np.testing.assert_allclose(
+                ht.fft.fft(a, axis=0).numpy(), np.fft.fft(self.x, axis=0), atol=1e-3
+            )
+
+    def test_roundtrips(self):
+        a = ht.array(self.x, split=0)
+        np.testing.assert_allclose(ht.fft.ifft(ht.fft.fft(a)).numpy().real, self.x, atol=1e-4)
+        np.testing.assert_allclose(ht.fft.irfft(ht.fft.rfft(a), n=16).numpy(), self.x, atol=1e-4)
+        np.testing.assert_allclose(
+            ht.fft.ifftn(ht.fft.fftn(a)).numpy().real, self.x, atol=1e-4
+        )
+
+    def test_freq_shift(self):
+        np.testing.assert_allclose(ht.fft.fftfreq(16).numpy(), np.fft.fftfreq(16), atol=1e-6)
+        np.testing.assert_allclose(ht.fft.rfftfreq(16).numpy(), np.fft.rfftfreq(16), atol=1e-6)
+        a = ht.array(self.x, split=0)
+        np.testing.assert_allclose(ht.fft.fftshift(a).numpy(), np.fft.fftshift(self.x))
+
+    def test_split_preserved(self):
+        a = ht.array(self.x, split=1)
+        assert ht.fft.fft(a).split == 1
+
+
+class TestIO(TestCase):
+    def test_hdf5_roundtrip(self, tmp_path):
+        pytest.importorskip("h5py")
+        p = str(tmp_path / "x.h5")
+        a = ht.random.randn(16, 4, split=0)
+        ht.save(a, p, "data")
+        for split in [None, 0, 1]:
+            b = ht.load(p, "data", split=split)
+            np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-6)
+            assert b.split == split
+
+    def test_csv_roundtrip(self, tmp_path):
+        p = str(tmp_path / "x.csv")
+        a = ht.random.randn(10, 3, split=0)
+        ht.save(a, p)
+        b = ht.load(p, split=0)
+        np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-5)
+
+    def test_csv_header(self, tmp_path):
+        p = str(tmp_path / "h.csv")
+        with open(p, "w") as f:
+            f.write("col1,col2\n1.0,2.0\n3.0,4.0\n")
+        b = ht.load_csv(p, header_lines=1)
+        np.testing.assert_allclose(b.numpy(), [[1, 2], [3, 4]])
+
+    def test_npy(self, tmp_path):
+        p = str(tmp_path / "x.npy")
+        data = np.arange(20.0, dtype=np.float32).reshape(5, 4)
+        np.save(p, data)
+        b = ht.load(p, split=0)
+        np.testing.assert_array_equal(b.numpy(), data)
+        # directory of npy files
+        d = tmp_path / "dir"
+        d.mkdir()
+        np.save(str(d / "a.npy"), data)
+        np.save(str(d / "b.npy"), data + 20)
+        c = ht.core.io.load_npy_from_path(str(d), split=0)
+        assert c.shape == (10, 4)
+
+    def test_unsupported_ext(self, tmp_path):
+        with pytest.raises(ValueError):
+            ht.load(str(tmp_path / "x.xyz"))
+
+    def test_checkpoint_pytree(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        tree = {"layer": {"w": np.ones((3, 2), np.float32)}, "step": np.asarray(7)}
+        ht.core.io.save_checkpoint(tree, p)
+        back = ht.core.io.load_checkpoint(tree, p)
+        np.testing.assert_array_equal(np.asarray(back["layer"]["w"]), tree["layer"]["w"])
+        assert int(back["step"]) == 7
+
+
+class TestSparse(TestCase):
+    def setup_method(self, method):
+        import scipy.sparse as sp
+
+        self.scipy_mat = sp.random(16, 8, density=0.25, format="csr", random_state=0, dtype=np.float32)
+
+    def test_factory_and_todense(self):
+        s = ht.sparse.sparse_csr_matrix(self.scipy_mat, split=0)
+        assert s.shape == (16, 8)
+        assert s.nnz == self.scipy_mat.nnz
+        assert s.split == 0
+        np.testing.assert_allclose(s.todense().numpy(), self.scipy_mat.toarray())
+
+    def test_from_dense(self):
+        dense = np.array([[1.0, 0.0], [0.0, 2.0]], dtype=np.float32)
+        s = ht.sparse.sparse_csr_matrix(dense)
+        assert s.nnz == 2
+        np.testing.assert_allclose(s.todense().numpy(), dense)
+
+    def test_csr_attributes(self):
+        s = ht.sparse.sparse_csr_matrix(self.scipy_mat)
+        np.testing.assert_array_equal(np.asarray(s.indptr), self.scipy_mat.indptr)
+
+    def test_arithmetic(self):
+        s1 = ht.sparse.sparse_csr_matrix(self.scipy_mat)
+        s2 = ht.sparse.sparse_csr_matrix(self.scipy_mat * 2)
+        np.testing.assert_allclose((s1 + s2).todense().numpy(), 3 * self.scipy_mat.toarray(), atol=1e-5)
+        np.testing.assert_allclose(
+            (s1 * s2).todense().numpy(), 2 * self.scipy_mat.toarray() ** 2, atol=1e-5
+        )
+
+    def test_spmm(self):
+        s = ht.sparse.sparse_csr_matrix(self.scipy_mat, split=0)
+        v = ht.random.randn(8, 3)
+        np.testing.assert_allclose(
+            (s @ v).numpy(), self.scipy_mat.toarray() @ v.numpy(), atol=1e-4
+        )
+
+
+class TestTiling(TestCase):
+    def test_split_tiles(self):
+        a = ht.array(np.arange(64.0, dtype=np.float32).reshape(16, 4), split=0)
+        t = ht.core.tiling.SplitTiles(a)
+        assert sum(t.tile_dimensions[0]) == 16
+        first = np.asarray(t[0])
+        np.testing.assert_array_equal(first, a.numpy()[:2])
+        t[0] = np.zeros_like(first)
+        assert float(a.numpy()[:2].sum()) == 0.0
+
+    def test_square_diag_tiles(self):
+        a = ht.array(np.arange(64.0, dtype=np.float32).reshape(8, 8), split=0)
+        t = ht.core.tiling.SquareDiagTiles(a, tiles_per_proc=1)
+        assert t.tile_rows >= 1 and t.tile_columns >= 1
+        blk = np.asarray(t[0, 0])
+        assert blk.shape[0] == blk.shape[1]  # square diagonal tile
+        t[0, 0] = np.zeros_like(blk)
+        assert float(a.numpy()[: blk.shape[0], : blk.shape[1]].sum()) == 0.0
+
+
+class TestProfiler(TestCase):
+    def test_timer(self):
+        holder = {}
+        x = ht.random.randn(64, 64)
+        with ht.utils.profiler.timer("mm", holder, sync_on=None):
+            y = x @ x
+        ht.utils.profiler.sync(y)
+        assert "mm" in holder and holder["mm"] >= 0.0
