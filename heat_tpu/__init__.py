@@ -29,5 +29,6 @@ from . import utils
 from . import fft
 from . import sparse
 from . import parallel
+from . import ops
 
 __version__ = core.version.__version__

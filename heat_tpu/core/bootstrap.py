@@ -1,0 +1,68 @@
+"""Multi-host bootstrap (SURVEY §7 M0: mesh bootstrap).
+
+The reference's world is implicit in ``mpirun``; the TPU-native analogue is
+``jax.distributed.initialize`` (one process per host, all chips addressed
+collectively) followed by mesh construction.  ``init_distributed()`` wraps
+both; on a single host it is a no-op that still installs the default mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["init_distributed", "finalize_distributed", "local_device_count", "device_count"]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("x",),
+) -> None:
+    """Initialize multi-host JAX (if configured) and install the default mesh.
+
+    With no arguments, honors the standard JAX env bootstrap (TPU pods
+    auto-discover their coordinator) when several processes are configured;
+    single-process runs skip straight to mesh installation.
+    """
+    import jax
+
+    if coordinator_address is not None or num_processes not in (None, 1):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    from . import devices
+    from .devices import make_mesh, use_mesh
+
+    if mesh_shape is not None:
+        mesh = make_mesh(shape=tuple(mesh_shape), axis_names=tuple(axis_names))
+    else:
+        mesh = make_mesh(axis_names=tuple(axis_names))
+    use_mesh(mesh)
+
+
+def finalize_distributed() -> None:
+    """Shut down the multi-host runtime (reference: implicit MPI_Finalize)."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # not initialized
+
+
+def local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()

@@ -1,0 +1,12 @@
+"""Custom TPU kernels (Pallas) for the framework's hot ops.
+
+XLA's fusion covers most of the ops surface; these kernels target the spots
+where manual control of the VMEM working set wins (SURVEY §2.7): the KMeans
+assignment step (cdist+argmin fused so the (n, k) distance matrix never
+touches HBM).  Every kernel has a jnp fallback and is selected automatically
+(`interpret=True` on CPU so the same code path is testable on the dev mesh).
+"""
+
+from .kmeans_kernels import fused_assign
+
+__all__ = ["fused_assign"]

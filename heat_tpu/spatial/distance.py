@@ -100,7 +100,13 @@ def cdist_ring(x: DNDarray, y: Optional[DNDarray] = None) -> DNDarray:
     if y is None:
         y = x
     comm = x.comm
-    if x.split != 0 or y.split != 0 or x.shape[0] % comm.size or y.shape[0] % comm.size:
+    if (
+        comm.size == 1
+        or x.split != 0
+        or y.split != 0
+        or x.shape[0] % comm.size
+        or y.shape[0] % comm.size
+    ):
         return cdist(x, y, quadratic_expansion=True)
 
     def step(x_blk, y_blk, src):

@@ -83,6 +83,10 @@ class TestDataParallel(TestModules):
 
 class TestDASO:
     def test_hierarchical_training(self):
+        import jax
+
+        if len(jax.devices()) % 2:
+            pytest.skip("DASO test needs an even device count")
         ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=1024)
         model = ht.nn.Sequential(
             ht.nn.Flatten(), ht.nn.Linear(784, 32), ht.nn.ReLU(), ht.nn.Linear(32, 10)
@@ -91,7 +95,7 @@ class TestDASO:
             ht.optim.DataParallelOptimizer("adam", lr=2e-3),
             total_local_comm_size=2, global_skip=4, stale_steps=2, warmup_steps=3,
         )
-        assert daso.n_groups == 4
+        assert daso.n_groups == len(jax.devices()) // 2
         daso.init(model)
         losses = [
             daso.step(ht.nn.functional.cross_entropy, ds.images[:512], ds.targets[:512])

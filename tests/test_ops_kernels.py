@@ -1,0 +1,30 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+class TestFusedAssign:
+    def test_matches_oracle(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(1000, 32)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        lab, d2 = ht.ops.fused_assign(x, c)
+        D = ((np.asarray(x)[:, None, :] - np.asarray(c)[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(lab), D.argmin(1))
+        np.testing.assert_allclose(np.asarray(d2), D.min(1), atol=1e-2)
+
+    def test_ragged_rows(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        # row count not divisible by the kernel tile → padding path
+        x = jnp.asarray(rng.normal(size=(1537, 8)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+        lab, d2 = ht.ops.fused_assign(x, c)
+        assert lab.shape == (1537,)
+        D = ((np.asarray(x)[:, None, :] - np.asarray(c)[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(lab), D.argmin(1))
