@@ -54,6 +54,7 @@ __all__ = [
     "sub",
     "subtract",
     "sum",
+    "true_divide",
 ]
 
 
@@ -84,6 +85,7 @@ def div(t1, t2, out=None, where=None) -> DNDarray:
 
 
 divide = div
+true_divide = div
 
 
 def floordiv(t1, t2) -> DNDarray:

@@ -37,6 +37,12 @@ __all__ = [
     "min",
     "minimum",
     "percentile",
+    "quantile",
+    "nanmax",
+    "nanmin",
+    "nanmean",
+    "nanstd",
+    "nanvar",
     "skew",
     "std",
     "var",
@@ -177,10 +183,6 @@ def histogram(x, bins=10, range=None, weights=None, density=None):
     return h, e
 
 
-def _moment_stat(x, axis, fn_name, unbiased_correction=None, **kw):
-    pass
-
-
 def kurtosis(x, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:
     """Kurtosis (Fisher: excess kurtosis). Distributed via global moments."""
     ax = sanitize_axis(x.shape, axis)
@@ -223,6 +225,37 @@ def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
 def median(x, axis=None, keepdims: bool = False) -> DNDarray:
     """Median — the reference does distributed selection; XLA sorts globally."""
     return percentile(x, 50.0, axis=axis, keepdims=keepdims)
+
+
+def quantile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+    """q-th quantile(s) (q in [0, 1]) — percentile/100. Accepts scalar or array-like q."""
+    if isinstance(q, DNDarray):
+        qs = q * 100.0
+    elif np.isscalar(q):
+        qs = float(q) * 100.0
+    else:
+        qs = np.asarray(q, dtype=np.float32) * 100.0
+    return percentile(x, qs, axis=axis, out=out, interpolation=interpolation, keepdims=keepdims)
+
+
+def nanmax(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.nanmax, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def nanmin(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _reduce_op(jnp.nanmin, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def nanmean(x, axis=None) -> DNDarray:
+    return _reduce_op(jnp.nanmean, x, axis=axis)
+
+
+def nanstd(x, axis=None, ddof: int = 0) -> DNDarray:
+    return _reduce_op(jnp.nanstd, x, axis=axis, ddof=ddof)
+
+
+def nanvar(x, axis=None, ddof: int = 0) -> DNDarray:
+    return _reduce_op(jnp.nanvar, x, axis=axis, ddof=ddof)
 
 
 def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:

@@ -4,12 +4,13 @@ The reference contains three reusable comm patterns buried inside ops:
 the **ring pipeline** (``spatial.cdist``), the **halo exchange**
 (``signal.convolve``) and the **all-to-all axis swap** (``resplit_``).
 Here they are public, named utilities built on ``shard_map`` +
-``lax.ppermute``/``lax.all_to_all`` — and they double as the building
-blocks of sequence/context parallelism (ring attention's KV rotation is
-exactly ``ring_map``) if transformer workloads are layered on top.
+``lax.ppermute``/``lax.all_to_all`` — and ``ring_attention`` demonstrates
+the sequence/context-parallel composition they enable (ring attention's KV
+rotation IS the cdist ring).
 """
 
 from .ring import ring_map
 from .halo import halo_exchange, with_halos
+from .ring_attention import ring_self_attention
 
-__all__ = ["ring_map", "halo_exchange", "with_halos"]
+__all__ = ["ring_map", "halo_exchange", "with_halos", "ring_self_attention"]

@@ -1,0 +1,89 @@
+"""Ring attention: sequence-parallel exact attention over the mesh ring.
+
+SURVEY §5.7: the reference has no attention, but its ring skeleton
+(``spatial.cdist``) is exactly ring attention's KV rotation.  This module is
+that composition made concrete — blockwise (flash-style) softmax
+accumulation while K/V blocks rotate via ``lax.ppermute`` over the ICI ring,
+so sequence length scales with the mesh: each chip holds S/p of the sequence
+and peak memory is one block pair.
+
+Shapes: ``q, k, v`` are ``(S, d)`` sharded along the sequence axis over
+``comm``; batch/heads compose via ``jax.vmap`` outside.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_self_attention"]
+
+
+def ring_self_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):
+    """Exact softmax attention with ring-rotated K/V (global result, S-sharded)."""
+    S, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    axis, size = comm.axis, comm.size
+    if size == 1 or S % size != 0:
+        s = (q @ k.T) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        return jax.nn.softmax(s, axis=-1) @ v
+
+    blk = S // size
+
+    def shard_fn(q_blk, k_blk, v_blk):
+        my = lax.axis_index(axis)
+        q_pos = my * blk + jnp.arange(blk)
+
+        def step(carry, i):
+            k_rot, v_rot, m, l, acc = carry
+            src = (my + i) % size
+
+            def attend(operands):
+                m, l, acc = operands
+                s = (q_blk @ k_rot.T) * scale  # (blk, blk)
+                if causal:
+                    kv_pos = src * blk + jnp.arange(blk)
+                    mask = q_pos[:, None] >= kv_pos[None, :]
+                    s = jnp.where(mask, s, -jnp.inf)
+                m_step = jnp.max(s, axis=1)
+                m_new = jnp.maximum(m, m_step)
+                # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) → 0
+                safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                p = jnp.exp(s - safe_m[:, None])
+                p = jnp.where(jnp.isfinite(s), p, 0.0)
+                corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+                l_new = l * corr + jnp.sum(p, axis=1)
+                acc_new = acc * corr[:, None] + p @ v_rot
+                return m_new, l_new, acc_new
+
+            if causal:
+                # skip the two GEMMs entirely when the whole K/V block is in
+                # the future of every query here (~2x causal FLOP saving)
+                fully_future = src * blk > my * blk + (blk - 1)
+                m, l, acc = lax.cond(fully_future, lambda o: o, attend, (m, l, acc))
+            else:
+                m, l, acc = attend((m, l, acc))
+            perm = [((j + 1) % size, j) for j in range(size)]
+            k_next = lax.ppermute(k_rot, axis, perm)
+            v_next = lax.ppermute(v_rot, axis, perm)
+            return (k_next, v_next, m, l, acc), None
+
+        m0 = jnp.full((blk,), -jnp.inf, q_blk.dtype)
+        l0 = jnp.zeros((blk,), q_blk.dtype)
+        acc0 = jnp.zeros((blk, d), q_blk.dtype)
+        (k_f, v_f, m, l, acc), _ = lax.scan(
+            step, (k_blk, v_blk, m0, l0, acc0), jnp.arange(size)
+        )
+        return acc / jnp.maximum(l, 1e-30)[:, None]
+
+    mapped = comm.shard_map(
+        shard_fn, in_splits=((2, 0), (2, 0), (2, 0)), out_splits=(2, 0)
+    )
+    return mapped(q, k, v)
