@@ -274,6 +274,47 @@ class Communication:
     def Scan(self, x):
         return self.Exscan(x) + x
 
+    def Reduce(self, x, root: int = 0, op: str = "sum"):
+        """Reduce to shard ``root``; other shards receive zeros (XLA is SPMD —
+        every shard computes; the root-masking preserves MPI semantics)."""
+        red = self.Allreduce(x, op)
+        mine = lax.axis_index(self.__axis) == root
+        return jnp.where(mine, red, jnp.zeros_like(red))
+
+    def Scatter(self, x, root: int = 0, axis: int = 0):
+        """Shard ``root``'s block, split along ``axis``, one piece per shard."""
+        src = self.Bcast(x, root=root)
+        n = self.size
+        idx = lax.axis_index(self.__axis)
+        piece = src.shape[axis] // n
+        return lax.dynamic_slice_in_dim(src, idx * piece, piece, axis=axis)
+
+    def Gather(self, x, root: int = 0, axis: int = 0):
+        """All blocks concatenated on shard ``root`` (others receive the same
+        buffer zeroed — SPMD equivalence of the MPI rooted gather)."""
+        full = lax.all_gather(x, self.__axis, axis=axis, tiled=True)
+        mine = lax.axis_index(self.__axis) == root
+        return jnp.where(mine, full, jnp.zeros_like(full))
+
+    # nonblocking names: EVERY XLA collective is asynchronously dispatched,
+    # so the I* forms are the same ops; Wait == block_until_ready
+    Iallreduce = Allreduce
+    Iallgather = Allgather
+    Ialltoall = Alltoall
+    Ibcast = Bcast
+    Isend = Send
+    Irecv = Send
+
+    @staticmethod
+    def Wait(x):
+        """Block until a dispatched result is ready (reference MPIRequest.Wait)."""
+        return jax.block_until_ready(x)
+
+    def Barrier(self) -> None:
+        """Host-level barrier: forces completion of all enqueued work."""
+        tok = jax.device_put(jnp.zeros(()), self.sharding(0, None))
+        jax.block_until_ready(tok)
+
     # convenience: run fn under shard_map over this communicator
     def shard_map(self, fn, in_splits, out_splits, check_vma: bool = False):
         """Wrap ``fn`` in a ``shard_map`` where each argument is split per ``in_splits``.
@@ -345,3 +386,20 @@ def sanitize_comm(comm: Optional[Communication]) -> Communication:
     if isinstance(comm, Communication):
         return comm
     raise TypeError(f"Expected Communication or None, got {type(comm)}")
+
+
+# reference-name aliases: the class the reference calls MPICommunication is
+# this mesh-backed Communication; MPI_WORLD/MPI_SELF resolve lazily so that
+# importing the module does not force device initialization
+MPICommunication = Communication
+
+
+def __getattr__(name):
+    if name == "MPI_WORLD":
+        return world()
+    if name == "MPI_SELF":
+        import jax
+        from jax.sharding import Mesh
+
+        return Communication(Mesh(np.asarray(jax.devices()[:1]), ("x",)), "x")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -8,7 +8,14 @@ from . import types
 from ._operations import _binary_op, _local_op
 from .dndarray import DNDarray
 
-__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "frexp", "modf", "round", "sgn", "sign", "trunc"]
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "frexp", "modf", "nan_to_num", "round", "sgn", "sign", "trunc"]
+
+
+def nan_to_num(x, nan: float = 0.0, posinf=None, neginf=None, out=None):
+    """Replace NaN/±inf with finite numbers (numpy semantics)."""
+    return _local_op(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x, out=out
+    )
 
 
 def abs(x, out=None, dtype=None) -> DNDarray:

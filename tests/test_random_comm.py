@@ -160,3 +160,30 @@ class TestParallelPrimitives:
         np.testing.assert_allclose(hn[4:8], [1, 2, 3, 4 if p > 2 else 0])
         # shard 0 slab gets zero halo_prev
         np.testing.assert_allclose(hn[0:4], [0, 0, 1, 2])
+
+
+class TestRootedCollectives:
+    def test_reduce_scatter_gather_barrier(self):
+        import jax.numpy as jnp
+
+        comm = ht.communication.get_comm()
+        p = comm.size
+        x = ht.arange(p, dtype=ht.float32, split=0)
+        m1 = comm.shard_map(lambda v: comm.Reduce(v), in_splits=((1, 0),), out_splits=(1, 0))
+        r = np.asarray(m1(x._jarray))
+        assert r[0] == p * (p - 1) / 2 and (r[1:] == 0).all()
+        m2 = comm.shard_map(lambda v: comm.Gather(v), in_splits=((1, 0),), out_splits=(1, 0))
+        g = np.asarray(m2(x._jarray))
+        np.testing.assert_allclose(g[:p], np.arange(p))
+        np.testing.assert_allclose(g[p:], 0)
+        full = ht.arange(p, dtype=ht.float32)
+        m3 = comm.shard_map(lambda v: comm.Scatter(v), in_splits=((1, None),), out_splits=(1, 0))
+        np.testing.assert_allclose(np.asarray(m3(full._jarray)), np.arange(p))
+        comm.Barrier()
+
+    def test_reference_aliases(self):
+        comm = ht.communication.get_comm()
+        assert ht.communication.MPICommunication is ht.communication.Communication
+        assert ht.communication.MPI_WORLD.size == comm.size
+        assert ht.communication.MPI_SELF.size == 1
+        assert comm.Iallreduce is comm.Allreduce or comm.Iallreduce.__func__ is comm.Allreduce.__func__
