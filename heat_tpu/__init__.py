@@ -32,3 +32,11 @@ from . import parallel
 from . import ops
 
 __version__ = core.version.__version__
+
+
+def __getattr__(name):
+    # MPI_WORLD / MPI_SELF are lazy in core.communication (the mesh may not be
+    # initialized at import time); forward them here for `ht.MPI_WORLD` parity.
+    if name in ("MPI_WORLD", "MPI_SELF"):
+        return getattr(core.communication, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -16,6 +16,7 @@ __all__ = [
     "all",
     "allclose",
     "any",
+    "count_nonzero",
     "isclose",
     "isfinite",
     "isinf",
@@ -37,6 +38,11 @@ def all(x, axis=None, out=None, keepdims=False) -> DNDarray:
 
 def any(x, axis=None, out=None, keepdims=False) -> DNDarray:
     return _reduce_op(jnp.any, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def count_nonzero(x, axis=None, keepdims=False) -> DNDarray:
+    """Count non-zero elements along axis (implicit Allreduce over the split)."""
+    return _reduce_op(jnp.count_nonzero, x, axis=axis, keepdims=keepdims)
 
 
 def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:

@@ -21,17 +21,26 @@ from .sanitation import sanitize_in
 from .stride_tricks import sanitize_axis, sanitize_shape
 
 __all__ = [
+    "array_split",
+    "atleast_1d",
+    "atleast_2d",
+    "atleast_3d",
     "balance",
     "broadcast_arrays",
     "broadcast_to",
     "collect",
     "column_stack",
     "concatenate",
+    "delete",
     "diag",
     "diagonal",
     "dsplit",
     "expand_dims",
     "flatten",
+    "insert",
+    "ndim",
+    "size",
+    "unfold",
     "flip",
     "fliplr",
     "flipud",
@@ -68,6 +77,141 @@ def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
     return DNDarray(
         jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
     )
+
+
+def array_split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """Like :func:`split` but allows section counts that do not divide the axis
+    (numpy ``array_split`` semantics)."""
+    axis = sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, DNDarray):
+        indices_or_sections = indices_or_sections.numpy()
+    if isinstance(indices_or_sections, (list, tuple, np.ndarray)):
+        bounds = list(np.asarray(indices_or_sections).ravel())
+    else:
+        n = int(indices_or_sections)
+        if n <= 0:
+            raise ValueError("number of sections must be larger than 0")
+        length = x.shape[axis]
+        sizes = [length // n + (1 if i < length % n else 0) for i in range(n)]
+        bounds = list(np.cumsum(sizes)[:-1])
+    return split(x, bounds, axis=axis)
+
+
+def atleast_1d(*arrays):
+    """View each input with at least 1 dimension (numpy semantics)."""
+    res = []
+    for a in arrays:
+        if not isinstance(a, DNDarray):
+            a = factories.array(a)
+        res.append(a if a.ndim >= 1 else reshape(a, (1,)))
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_2d(*arrays):
+    """View each input with at least 2 dimensions; 1-D becomes (1, N)."""
+    res = []
+    for a in arrays:
+        if not isinstance(a, DNDarray):
+            a = factories.array(a)
+        if a.ndim == 0:
+            res.append(reshape(a, (1, 1)))
+        elif a.ndim == 1:
+            res.append(expand_dims(a, 0))
+        else:
+            res.append(a)
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_3d(*arrays):
+    """View each input with at least 3 dimensions (numpy promotion rules)."""
+    res = []
+    for a in arrays:
+        if not isinstance(a, DNDarray):
+            a = factories.array(a)
+        if a.ndim == 0:
+            res.append(reshape(a, (1, 1, 1)))
+        elif a.ndim == 1:
+            res.append(expand_dims(expand_dims(a, 0), -1))
+        elif a.ndim == 2:
+            res.append(expand_dims(a, -1))
+        else:
+            res.append(a)
+    return res[0] if len(res) == 1 else res
+
+
+def delete(x: DNDarray, obj, axis: Optional[int] = None) -> DNDarray:
+    """Remove sub-arrays at the given indices along axis (numpy semantics)."""
+    j = x._jarray
+    if axis is None:
+        j = j.reshape(-1)
+        axis_n = 0
+    else:
+        axis_n = sanitize_axis(x.shape, axis)
+    if isinstance(obj, DNDarray):
+        obj = obj.numpy()
+    if isinstance(obj, (list, tuple)):
+        obj = np.asarray(obj)
+    res = jnp.delete(j, obj, axis=axis_n)
+    out_split = (0 if x.split is not None else None) if axis is None else x.split
+    return _wrap(res, out_split, x)
+
+
+def insert(x: DNDarray, obj, values, axis: Optional[int] = None) -> DNDarray:
+    """Insert values before the given indices along axis (numpy semantics)."""
+    j = x._jarray
+    if axis is None:
+        j = j.reshape(-1)
+        axis_n = 0
+    else:
+        axis_n = sanitize_axis(x.shape, axis)
+    if isinstance(obj, DNDarray):
+        obj = obj.numpy()
+    if isinstance(obj, (list, tuple)):
+        obj = np.asarray(obj)
+    if isinstance(values, DNDarray):
+        values = values._jarray
+    res = jnp.insert(j, obj, values, axis=axis_n)
+    out_split = (0 if x.split is not None else None) if axis is None else x.split
+    return _wrap(res, out_split, x)
+
+
+def ndim(x) -> int:
+    """Number of dimensions (numpy free-function parity)."""
+    if isinstance(x, DNDarray):
+        return x.ndim
+    return np.ndim(x)
+
+
+def size(x) -> int:
+    """Total number of elements (numpy free-function parity)."""
+    if isinstance(x, DNDarray):
+        return x.size
+    return np.size(x)
+
+
+def unfold(x: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:
+    """Sliding windows of ``size`` every ``step`` along ``axis``.
+
+    torch.Tensor.unfold semantics (reference: ``heat.unfold``): axis ``axis``
+    becomes ``(shape[axis] - size) // step + 1`` windows and a new trailing
+    axis of length ``size`` holds each window.  A distributed split on
+    ``axis`` requires neighbor halos in the reference; XLA derives the
+    equivalent collective from the gather below.
+    """
+    axis = sanitize_axis(x.shape, axis)
+    if size < 1 or step < 1:
+        raise ValueError("size and step must be >= 1")
+    length = x.shape[axis]
+    if size > length:
+        raise ValueError(f"size {size} exceeds axis length {length}")
+    n_windows = (length - size) // step + 1
+    starts = jnp.arange(n_windows) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]  # (n_windows, size)
+    res = jnp.take(x._jarray, idx, axis=axis)  # axis -> (n_windows, size)
+    # move the window-content axis to the end
+    res = jnp.moveaxis(res, axis + 1, -1)
+    split = x.split
+    return _wrap(res, split, x)
 
 
 def balance(x: DNDarray, copy: bool = False) -> DNDarray:

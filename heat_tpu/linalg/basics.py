@@ -26,7 +26,9 @@ from ..core.stride_tricks import sanitize_axis
 
 __all__ = [
     "cross",
+    "det",
     "dot",
+    "inv",
     "matmul",
     "matrix_norm",
     "norm",
@@ -49,6 +51,25 @@ def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
     return DNDarray(
         jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, proto.device, proto.comm, True
     )
+
+
+def det(a: DNDarray) -> DNDarray:
+    """Determinant of a (batch of) square matrix (beyond-reference extra).
+
+    The factorization is inherently sequential, so the computation is
+    replicated; batch dims of a batched input stay sharded.
+    """
+    sanitize_in(a)
+    res = jnp.linalg.det(a._jarray.astype(jnp.promote_types(a._jarray.dtype, jnp.float32)))
+    split = a.split if a.split is not None and a.split < res.ndim else None
+    return _wrap(res, split, a)
+
+
+def inv(a: DNDarray) -> DNDarray:
+    """Inverse of a (batch of) square matrix (beyond-reference extra)."""
+    sanitize_in(a)
+    res = jnp.linalg.inv(a._jarray.astype(jnp.promote_types(a._jarray.dtype, jnp.float32)))
+    return _wrap(res, a.split, a)
 
 
 def _matmul_result_split(sa: Optional[int], sb: Optional[int], nd_out: int) -> Optional[int]:

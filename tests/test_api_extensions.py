@@ -1,0 +1,146 @@
+"""Tests for API-parity extensions (array_split, unfold, delete/insert,
+atleast_*, count_nonzero, linalg.inv/det, sparse.todense, MPI_* exports).
+
+Reference test style (SURVEY §4): numpy as the oracle, split sweep for
+distributed coverage.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestArraySplit(TestCase):
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    @pytest.mark.parametrize("sections", [2, 4, [1, 3, 5]])
+    def test_array_split_matches_numpy(self, split, sections):
+        n = np.arange(42, dtype=np.float32).reshape(6, 7)
+        x = ht.array(n, split=split)
+        for axis in (0, 1):
+            got = ht.array_split(x, sections, axis=axis)
+            want = np.array_split(n, sections, axis=axis)
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                self.assert_array_equal(g, w)
+
+    def test_split_requires_divisibility(self):
+        x = ht.arange(10)
+        with pytest.raises(ValueError):
+            ht.split(x, 3)
+        # array_split allows it
+        parts = ht.array_split(x, 3)
+        assert [p.shape[0] for p in parts] == [4, 3, 3]
+
+
+class TestAtleastND(TestCase):
+    def test_atleast_1d(self):
+        assert ht.atleast_1d(ht.array(3.0)).shape == (1,)
+        a = ht.arange(4)
+        assert ht.atleast_1d(a).shape == (4,)
+        res = ht.atleast_1d(ht.array(1), ht.arange(2))
+        assert isinstance(res, list) and res[0].shape == (1,) and res[1].shape == (2,)
+
+    def test_atleast_2d(self):
+        assert ht.atleast_2d(ht.array(3.0)).shape == (1, 1)
+        assert ht.atleast_2d(ht.arange(4, split=0)).shape == (1, 4)
+        n = np.arange(6).reshape(2, 3)
+        self.assert_array_equal(ht.atleast_2d(ht.array(n, split=0)), n)
+
+    def test_atleast_3d(self):
+        assert ht.atleast_3d(ht.array(3.0)).shape == (1, 1, 1)
+        assert ht.atleast_3d(ht.arange(4)).shape == (1, 4, 1)
+        assert ht.atleast_3d(ht.zeros((2, 3), split=0)).shape == (2, 3, 1)
+        assert ht.atleast_3d(ht.zeros((2, 3, 4), split=1)).shape == (2, 3, 4)
+
+
+class TestDeleteInsert(TestCase):
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_delete(self, split):
+        n = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(n, split=split)
+        self.assert_array_equal(ht.delete(x, 2, axis=0), np.delete(n, 2, axis=0))
+        self.assert_array_equal(ht.delete(x, [0, 3], axis=1), np.delete(n, [0, 3], axis=1))
+        self.assert_array_equal(ht.delete(x, 5), np.delete(n, 5))
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_insert(self, split):
+        n = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(n, split=split)
+        self.assert_array_equal(ht.insert(x, 1, 42.0, axis=0), np.insert(n, 1, 42.0, axis=0))
+        self.assert_array_equal(ht.insert(x, 3, 7.0, axis=1), np.insert(n, 3, 7.0, axis=1))
+        self.assert_array_equal(ht.insert(x, 0, -1.0), np.insert(n, 0, -1.0))
+
+
+class TestUnfold(TestCase):
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    @pytest.mark.parametrize("axis,size,step", [(0, 2, 1), (1, 3, 2), (1, 6, 1)])
+    def test_unfold_matches_torch(self, split, axis, size, step):
+        n = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(n, split=split)
+        want = torch.from_numpy(n).unfold(axis, size, step).numpy()
+        self.assert_array_equal(ht.unfold(x, axis, size, step), want)
+
+    def test_unfold_validation(self):
+        x = ht.arange(5)
+        with pytest.raises(ValueError):
+            ht.unfold(x, 0, 6)
+        with pytest.raises(ValueError):
+            ht.unfold(x, 0, 2, 0)
+
+
+class TestCountNonzero(TestCase):
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_count_nonzero(self, split):
+        n = np.array([[0, 1, 2, 0], [3, 0, 0, 4], [0, 0, 0, 0]], dtype=np.float32)
+        x = ht.array(n, split=split)
+        assert int(ht.count_nonzero(x)) == np.count_nonzero(n)
+        self.assert_array_equal(ht.count_nonzero(x, axis=0), np.count_nonzero(n, axis=0))
+        self.assert_array_equal(ht.count_nonzero(x, axis=1), np.count_nonzero(n, axis=1))
+
+
+class TestInvDet(TestCase):
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_inv(self, split):
+        rng = np.random.default_rng(0)
+        n = (rng.standard_normal((5, 5)) + 5 * np.eye(5)).astype(np.float32)
+        x = ht.array(n, split=split)
+        self.assert_array_equal(ht.linalg.inv(x), np.linalg.inv(n), rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_det(self, split):
+        n = np.array([[2.0, 1.0], [1.0, 3.0]], dtype=np.float32)
+        x = ht.array(n, split=split)
+        assert np.allclose(float(ht.linalg.det(x)), 5.0, rtol=1e-5)
+
+    def test_batched(self):
+        rng = np.random.default_rng(1)
+        n = (rng.standard_normal((3, 4, 4)) + 4 * np.eye(4)).astype(np.float32)
+        x = ht.array(n, split=0)
+        self.assert_array_equal(ht.linalg.inv(x), np.linalg.inv(n), rtol=1e-3, atol=1e-4)
+        self.assert_array_equal(ht.linalg.det(x), np.linalg.det(n), rtol=1e-3, atol=1e-3)
+
+
+class TestNdimSize(TestCase):
+    def test_free_functions(self):
+        x = ht.zeros((3, 4), split=0)
+        assert ht.ndim(x) == 2 and ht.size(x) == 12
+        assert ht.ndim([[1, 2]]) == 2 and ht.size([1, 2, 3]) == 3
+
+
+class TestTopLevelExports(TestCase):
+    def test_mpi_world_self(self):
+        assert ht.MPI_WORLD is not None
+        assert ht.MPI_SELF.size == 1
+        assert ht.MPI_WORLD.size >= 1
+
+    def test_sparse_todense(self):
+        import scipy.sparse as sps
+
+        s = sps.random(6, 5, density=0.3, format="csr", random_state=0)
+        d = ht.sparse.sparse_csr_matrix(s, split=0)
+        got = ht.sparse.todense(d)
+        np.testing.assert_allclose(got.numpy(), s.toarray(), rtol=1e-6)
