@@ -1,0 +1,210 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference inherits its native layer from torch/MPI (SURVEY §2.7 — zero
+first-party native code); here the framework carries its own: a threaded
+mmap CSV engine (byte-range splitting with line fixup, exactly the
+reference's parallel-CSV strategy run across threads instead of ranks) and
+the shard/chunk math.  Compiled on demand with g++ into ``libheatnative.so``
+next to this file; every entry point has a pure-Python fallback so the
+package works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csvparse.cc")
+_SO = os.path.join(_HERE, "libheatnative.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        warnings.warn(f"heat_tpu native build failed ({e}); using Python fallbacks")
+        return False
+
+
+def _load():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        stale = not os.path.exists(_SO) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if stale and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            warnings.warn(f"heat_tpu native load failed ({e}); using Python fallbacks")
+            _build_failed = True
+            return None
+        lib.csv_index_open.restype = ctypes.c_void_p
+        lib.csv_index_open.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+        lib.csv_index_close.restype = None
+        lib.csv_index_close.argtypes = [ctypes.c_void_p]
+        lib.csv_index_rows.restype = ctypes.c_int64
+        lib.csv_index_rows.argtypes = [ctypes.c_void_p]
+        lib.csv_index_cols.restype = ctypes.c_int64
+        lib.csv_index_cols.argtypes = [ctypes.c_void_p, ctypes.c_char]
+        lib.csv_index_parse.restype = ctypes.c_int64
+        lib.csv_index_parse.argtypes = [
+            ctypes.c_void_p, ctypes.c_char, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        lib.csv_write.restype = ctypes.c_int64
+        lib.csv_write.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.chunk_counts_displs.restype = ctypes.c_int64
+        lib.chunk_counts_displs.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is built and loadable."""
+    return _load() is not None
+
+
+class CsvIndex:
+    """A reusable row index over a CSV file: one mmap + line scan serves
+    dims and any number of window parses (the per-shard hyperslab reads)."""
+
+    def __init__(self, path: str, skiprows: int = 0, nthreads: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.csv_index_open(path.encode(), skiprows, nthreads)
+        if not self._h:
+            raise OSError(f"cannot open/index {path}")
+
+    @property
+    def nrows(self) -> int:
+        return int(self._lib.csv_index_rows(self._h))
+
+    def ncols(self, sep: str = ",") -> int:
+        return int(self._lib.csv_index_cols(self._h, sep.encode()[:1]))
+
+    def parse(self, sep: str = ",", row_begin: int = 0, row_end: int | None = None,
+              ncols: int | None = None, nthreads: int = 0) -> np.ndarray:
+        if row_end is None:
+            row_end = self.nrows
+        if ncols is None:
+            ncols = self.ncols(sep)
+        out = np.empty((max(row_end - row_begin, 0), ncols), dtype=np.float64)
+        rc = self._lib.csv_index_parse(
+            self._h, sep.encode()[:1], row_begin, row_end, ncols,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), nthreads,
+        )
+        if rc == -3:
+            raise ValueError("ragged CSV: rows have inconsistent column counts")
+        if rc != 0:
+            raise ValueError(f"csv parse failed (rc={rc})")
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.csv_index_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def csv_dims(path: str, sep: str = ",", skiprows: int = 0, nthreads: int = 0):
+    """(nrows, ncols) of the data region of a CSV file, or None on fallback."""
+    if _load() is None:
+        return None
+    try:
+        with CsvIndex(path, skiprows, nthreads) as idx:
+            return idx.nrows, idx.ncols(sep)
+    except OSError:
+        return None
+
+
+def csv_parse(path: str, sep: str = ",", skiprows: int = 0,
+              row_begin: int = 0, row_end: int | None = None,
+              ncols: int | None = None, nthreads: int = 0) -> np.ndarray | None:
+    """Parse rows [row_begin, row_end) into a float64 (rows, ncols) array.
+
+    Returns None when the native library is unavailable or the file cannot
+    be opened (caller falls back); raises ValueError on malformed data.
+    """
+    if _load() is None:
+        return None
+    try:
+        idx = CsvIndex(path, skiprows, nthreads)
+    except OSError:
+        return None
+    with idx:
+        if row_end is not None and row_end > idx.nrows:
+            return None
+        try:
+            return idx.parse(sep, row_begin, row_end, ncols, nthreads)
+        except ValueError:
+            raise
+
+
+def csv_write(path: str, data: np.ndarray, sep: str = ",", decimals: int = -1,
+              float32_repr: bool = False, nthreads: int = 0) -> bool:
+    """Write a 2-D float array as CSV; returns False on fallback."""
+    lib = _load()
+    if lib is None:
+        return False
+    arr = np.ascontiguousarray(data, dtype=np.float64)
+    if arr.ndim != 2:
+        raise ValueError("csv_write expects a 2-D array")
+    rc = lib.csv_write(
+        path.encode(), arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        arr.shape[0], arr.shape[1], sep.encode()[:1], decimals,
+        1 if float32_repr else 0, nthreads,
+    )
+    return rc == 0
+
+
+def chunk_counts_displs(n: int, nproc: int):
+    """Per-rank (counts, displs) of the ceil-div grid, or None on fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    counts = (ctypes.c_int64 * nproc)()
+    displs = (ctypes.c_int64 * nproc)()
+    rc = lib.chunk_counts_displs(n, nproc, counts, displs)
+    if rc != 0:
+        return None
+    return np.ctypeslib.as_array(counts).copy(), np.ctypeslib.as_array(displs).copy()

@@ -1,0 +1,321 @@
+// Native CSV engine for heat_tpu (C ABI, loaded via ctypes).
+//
+// The reference (heat/core/io.py::load_csv, SURVEY §2.2) parses CSV in
+// parallel by byte-range splitting across MPI ranks with line fixup at the
+// boundaries.  Here the same byte-range strategy runs across threads of the
+// single controller process: the file is mmap'ed, split into blocks, each
+// thread aligns its block start to the next newline, and rows are parsed
+// with std::from_chars (locale-free, no allocation).  A row-offset index is
+// built once (csv_index_open) and reused for dims and any number of
+// [row_begin, row_end) window parses — the per-shard hyperslabs of a
+// split=0 load.
+//
+// Semantics match numpy.genfromtxt: blank lines are skipped anywhere in the
+// file; empty fields parse as NaN; rows whose column count differs from the
+// first data row are an error (parse returns -3).
+//
+// Exported functions (0/handle on success, negative codes / NULL on error):
+//   csv_index_open(path, skiprows, nthreads) -> handle
+//   csv_index_rows(handle)
+//   csv_index_cols(handle, sep)
+//   csv_index_parse(handle, sep, row_begin, row_end, ncols, out, nthreads)
+//   csv_index_close(handle)
+//   csv_write(path, data, nrows, ncols, sep, decimals, float32_repr, nthreads)
+//   chunk_counts_displs(n, nproc, counts, displs)
+
+#include <algorithm>
+#include <atomic>
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct MappedFile {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+
+  bool open(const char* path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0) { ::close(fd); fd = -1; return false; }
+    size = static_cast<size_t>(st.st_size);
+    if (size == 0) { data = nullptr; return true; }
+    void* p = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) { ::close(fd); fd = -1; return false; }
+    madvise(p, size, MADV_SEQUENTIAL);
+    data = static_cast<const char*>(p);
+    return true;
+  }
+
+  ~MappedFile() {
+    if (data) munmap(const_cast<char*>(data), size);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+int pick_threads(int nthreads, size_t work_items) {
+  if (nthreads <= 0) nthreads = static_cast<int>(std::thread::hardware_concurrency());
+  if (nthreads < 1) nthreads = 1;
+  if (static_cast<size_t>(nthreads) > work_items) nthreads = static_cast<int>(std::max<size_t>(1, work_items));
+  return nthreads;
+}
+
+bool is_blank(const char* lo, const char* hi) {
+  for (const char* p = lo; p < hi; ++p) {
+    if (*p != '\n' && *p != '\r' && *p != ' ' && *p != '\t') return false;
+  }
+  return true;
+}
+
+// Offsets (into the mapped file) of the first byte of every non-blank line,
+// skipping the first `skiprows` raw lines; offsets[i+1] bounds line i.
+// Parallel: per-block newline counts, prefix sum, per-block offset fill,
+// then a compaction pass dropping blank lines (genfromtxt semantics).
+std::vector<size_t> line_offsets(const MappedFile& f, int64_t skiprows, int nthreads) {
+  std::vector<size_t> offsets;
+  if (f.size == 0) return offsets;
+  nthreads = pick_threads(nthreads, f.size / (1 << 16) + 1);
+  size_t block = (f.size + nthreads - 1) / nthreads;
+
+  std::vector<size_t> counts(nthreads, 0);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back([&, t] {
+      size_t lo = t * block, hi = std::min(f.size, lo + block);
+      const char* p = f.data + lo;
+      const char* end = f.data + hi;
+      size_t c = 0;
+      while (p < end) {
+        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+        if (!nl) break;
+        ++c;
+        p = nl + 1;
+      }
+      counts[t] = c;
+    });
+  }
+  for (auto& th : ts) th.join();
+  ts.clear();
+
+  std::vector<size_t> starts(nthreads + 1, 0);
+  for (int t = 0; t < nthreads; ++t) starts[t + 1] = starts[t] + counts[t];
+  size_t total_newlines = starts[nthreads];
+  size_t nlines = total_newlines + (f.data[f.size - 1] != '\n' ? 1 : 0);
+  offsets.assign(nlines + 1, f.size);
+  offsets[0] = 0;
+
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back([&, t] {
+      size_t lo = t * block, hi = std::min(f.size, lo + block);
+      const char* p = f.data + lo;
+      const char* end = f.data + hi;
+      size_t idx = starts[t] + 1;  // newline k ends line k
+      while (p < end) {
+        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+        if (!nl) break;
+        size_t next_line_start = static_cast<size_t>(nl - f.data) + 1;
+        if (idx < offsets.size() && next_line_start < f.size) offsets[idx] = next_line_start;
+        ++idx;
+        p = nl + 1;
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+
+  // skip raw header lines, then compact away blank lines anywhere
+  size_t first = std::min<size_t>(skiprows > 0 ? static_cast<size_t>(skiprows) : 0,
+                                  offsets.empty() ? 0 : offsets.size() - 1);
+  std::vector<size_t> kept;
+  kept.reserve(offsets.size() - first);
+  for (size_t i = first; i + 1 < offsets.size(); ++i) {
+    if (!is_blank(f.data + offsets[i], f.data + offsets[i + 1])) kept.push_back(offsets[i]);
+  }
+  kept.push_back(f.size);
+  // bound each kept line by the next kept start: rebuild as [start..., size];
+  // a kept line that was followed by blanks ends at the blank's start, which
+  // is fine — parse_line trims trailing \r\n/whitespace.
+  return kept;
+}
+
+int64_t count_cols(const char* lo, const char* hi, char sep) {
+  int64_t cols = 1;
+  for (const char* p = lo; p < hi; ++p) {
+    if (*p == sep) ++cols;
+    if (*p == '\n') break;
+  }
+  return cols;
+}
+
+// Parse one line of exactly `ncols` values; false on column-count mismatch
+// (genfromtxt raises on ragged rows). Empty fields parse as NaN.
+bool parse_line(const char* lo, const char* hi, char sep, double* out, int64_t ncols) {
+  // clip to the first newline (a kept line followed by removed blank lines
+  // may span to the next kept offset)
+  const char* nl = static_cast<const char*>(memchr(lo, '\n', hi - lo));
+  if (nl) hi = nl;
+  while (hi > lo && (hi[-1] == '\r' || hi[-1] == ' ' || hi[-1] == '\t')) --hi;
+  if (count_cols(lo, hi, sep) != ncols) return false;
+  const char* p = lo;
+  for (int64_t c = 0; c < ncols; ++c) {
+    while (p < hi && (*p == ' ' || *p == '\t')) ++p;
+    double v;
+    auto [next, ec] = std::from_chars(p, hi, v);
+    if (ec != std::errc()) {
+      v = std::nan("");  // empty/non-numeric field (genfromtxt semantics)
+      next = p;
+    }
+    out[c] = v;
+    p = next;
+    while (p < hi && *p != sep) ++p;
+    if (p < hi) ++p;  // skip separator
+  }
+  return true;
+}
+
+struct CsvIndex {
+  MappedFile f;
+  std::vector<size_t> offsets;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* csv_index_open(const char* path, int64_t skiprows, int nthreads) {
+  auto* idx = new CsvIndex();
+  if (!idx->f.open(path)) { delete idx; return nullptr; }
+  idx->offsets = line_offsets(idx->f, skiprows, nthreads);
+  return idx;
+}
+
+void csv_index_close(void* handle) {
+  delete static_cast<CsvIndex*>(handle);
+}
+
+int64_t csv_index_rows(void* handle) {
+  auto* idx = static_cast<CsvIndex*>(handle);
+  return idx->offsets.size() >= 2 ? static_cast<int64_t>(idx->offsets.size() - 1) : 0;
+}
+
+int64_t csv_index_cols(void* handle, char sep) {
+  auto* idx = static_cast<CsvIndex*>(handle);
+  if (idx->offsets.size() < 2) return 0;
+  return count_cols(idx->f.data + idx->offsets[0], idx->f.data + idx->offsets[1], sep);
+}
+
+int64_t csv_index_parse(void* handle, char sep, int64_t row_begin, int64_t row_end,
+                        int64_t ncols, double* out, int nthreads) {
+  auto* idx = static_cast<CsvIndex*>(handle);
+  int64_t nrows = csv_index_rows(handle);
+  if (row_begin < 0 || row_end > nrows || row_begin > row_end) return -2;
+  int64_t span = row_end - row_begin;
+  if (span == 0) return 0;
+
+  nthreads = pick_threads(nthreads, static_cast<size_t>(span));
+  int64_t rows_per = (span + nthreads - 1) / nthreads;
+  std::atomic<int64_t> bad{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back([&, t] {
+      int64_t lo = row_begin + t * rows_per;
+      int64_t hi = std::min<int64_t>(row_end, lo + rows_per);
+      for (int64_t r = lo; r < hi; ++r) {
+        if (!parse_line(idx->f.data + idx->offsets[r], idx->f.data + idx->offsets[r + 1],
+                        sep, out + (r - row_begin) * ncols, ncols)) {
+          bad.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  return bad.load() ? -3 : 0;
+}
+
+int64_t csv_write(const char* path, const double* data, int64_t nrows,
+                  int64_t ncols, char sep, int decimals, int float32_repr,
+                  int nthreads) {
+  if (nrows < 0 || ncols <= 0) return -2;
+  nthreads = pick_threads(nthreads, static_cast<size_t>(std::max<int64_t>(nrows, 1)));
+  int64_t rows_per = (nrows + nthreads - 1) / nthreads;
+
+  std::vector<std::string> chunks(nthreads);
+  std::atomic<int64_t> bad{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back([&, t] {
+      int64_t lo = t * rows_per, hi = std::min<int64_t>(nrows, lo + rows_per);
+      if (lo >= hi) return;
+      std::string& buf = chunks[t];
+      buf.reserve(static_cast<size_t>((hi - lo) * ncols * 16));
+      char tmp[512];
+      for (int64_t r = lo; r < hi; ++r) {
+        for (int64_t c = 0; c < ncols; ++c) {
+          double val = data[r * ncols + c];
+          std::to_chars_result res;
+          if (decimals >= 0) {
+            res = std::to_chars(tmp, tmp + sizeof(tmp), val,
+                                std::chars_format::fixed, decimals);
+          } else if (float32_repr) {
+            // shortest round-trip of the FLOAT value: matches numpy's repr
+            // of float32 data ("0.1", not "0.10000000149011612")
+            res = std::to_chars(tmp, tmp + sizeof(tmp), static_cast<float>(val));
+          } else {
+            res = std::to_chars(tmp, tmp + sizeof(tmp), val);
+          }
+          if (res.ec != std::errc()) {
+            bad.fetch_add(1, std::memory_order_relaxed);
+            res.ptr = tmp;  // append nothing for this value
+          }
+          buf.append(tmp, res.ptr);
+          buf.push_back(c + 1 < ncols ? sep : '\n');
+        }
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  if (bad.load()) return -5;
+
+  FILE* out = fopen(path, "wb");
+  if (!out) return -1;
+  for (auto& c : chunks) {
+    if (!c.empty() && fwrite(c.data(), 1, c.size(), out) != c.size()) {
+      fclose(out);
+      return -4;
+    }
+  }
+  fclose(out);
+  return 0;
+}
+
+// ---------------------------------------------------------------------- //
+// shard/chunk math (reference: communication.py::chunk / counts_displs)
+// ---------------------------------------------------------------------- //
+int64_t chunk_counts_displs(int64_t n, int64_t nproc,
+                            int64_t* counts, int64_t* displs) {
+  if (nproc <= 0) return -2;
+  // ceil-div grid: first ranks get ceil(n/nproc), trailing ranks may be empty
+  int64_t c = (n + nproc - 1) / nproc;
+  int64_t off = 0;
+  for (int64_t r = 0; r < nproc; ++r) {
+    int64_t lo = std::min(off, n), hi = std::min(off + c, n);
+    counts[r] = hi - lo;
+    displs[r] = lo;
+    off += c;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -107,25 +107,53 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 # ---------------------------------------------------------------------- #
 def load_csv(path: str, header_lines: int = 0, sep: str = ",", dtype=types.float32,
              encoding: str = "utf-8", split: Optional[int] = None, device=None, comm=None) -> DNDarray:
-    """Parallel CSV ingest (reference: byte-range split + line fixup; here a
-    chunked numpy parse, sharded on placement)."""
-    data = np.genfromtxt(path, delimiter=sep, skip_header=header_lines, encoding=encoding)
-    if data.ndim == 1:
-        # single data row parses 1-D; sniff the first DATA line to decide
-        with open(path, encoding=encoding) as f:
-            for _ in range(header_lines):
-                f.readline()
-            first_data_line = f.readline()
-        if sep in first_data_line:
-            data = data.reshape(-1, len(first_data_line.rstrip("\n").split(sep)))
+    """Parallel CSV ingest (reference: byte-range split across ranks with line
+    fixup).  The native C++ engine (``heat_tpu._native``) runs the same
+    byte-range strategy across threads — mmap, parallel line indexing,
+    ``from_chars`` parsing; numpy ``genfromtxt`` is the fallback."""
+    from .. import _native
+
+    parsed = None
+    if encoding.replace("-", "").lower() in ("utf8", "ascii"):
+        parsed = _native.csv_parse(path, sep=sep, skiprows=header_lines)
+    if parsed is not None:
+        # genfromtxt shape rules: multi-column → 2-D, single column → 1-D,
+        # single value → 0-d scalar
+        if parsed.shape == (1, 1):
+            data = parsed.reshape(())
+        elif parsed.shape[1] > 1:
+            data = parsed
+        else:
+            data = parsed.reshape(-1)
+    else:
+        data = np.genfromtxt(path, delimiter=sep, skip_header=header_lines, encoding=encoding)
+        if data.ndim == 1:
+            # single data row parses 1-D; sniff the first DATA line to decide
+            with open(path, encoding=encoding) as f:
+                for _ in range(header_lines):
+                    f.readline()
+                first_data_line = f.readline()
+            if sep in first_data_line:
+                data = data.reshape(-1, len(first_data_line.rstrip("\n").split(sep)))
     return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def save_csv(data: DNDarray, path: str, header_lines: Optional[List[str]] = None,
              sep: str = ",", decimals: int = -1, truncate: bool = True) -> None:
+    from .. import _native
+
     arr = data.numpy()
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
+    if (
+        not header_lines
+        and np.issubdtype(arr.dtype, np.floating)
+        and _native.csv_write(
+            path, arr, sep=sep, decimals=decimals,
+            float32_repr=(arr.dtype == np.float32),
+        )
+    ):
+        return
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
     header = "\n".join(header_lines) if header_lines else ""
     np.savetxt(path, arr, delimiter=sep, fmt=fmt, header=header, comments="")

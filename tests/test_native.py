@@ -1,0 +1,212 @@
+"""Tests for the native C++ runtime layer (heat_tpu._native).
+
+Oracle: numpy genfromtxt/savetxt.  The native engine mirrors the reference's
+parallel-CSV strategy (byte-range split + line fixup, heat/core/io.py) across
+threads; these tests also cover the ctypes fallback contract.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import _native
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native library unavailable (no toolchain)"
+)
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    rng = np.random.default_rng(42)
+    data = rng.standard_normal((500, 7))
+    p = tmp_path / "data.csv"
+    np.savetxt(p, data, delimiter=",")
+    return str(p), data
+
+
+class TestCsvDims:
+    def test_dims(self, csv_file):
+        p, data = csv_file
+        assert _native.csv_dims(p) == (500, 7)
+
+    def test_dims_with_header(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("a,b\n1,2\n3,4\n")
+        assert _native.csv_dims(str(p), skiprows=1) == (2, 2)
+
+    def test_no_trailing_newline(self, tmp_path):
+        p = tmp_path / "n.csv"
+        p.write_text("1,2\n3,4")
+        assert _native.csv_dims(str(p)) == (2, 2)
+
+    def test_empty_file(self, tmp_path):
+        p = tmp_path / "e.csv"
+        p.write_text("")
+        assert _native.csv_dims(str(p)) == (0, 0)
+
+    def test_trailing_blank_lines(self, tmp_path):
+        p = tmp_path / "b.csv"
+        p.write_text("1,2\n3,4\n\n\n")
+        assert _native.csv_dims(str(p)) == (2, 2)
+
+
+class TestCsvParse:
+    def test_full_parse(self, csv_file):
+        p, data = csv_file
+        got = _native.csv_parse(p)
+        np.testing.assert_allclose(got, data, rtol=1e-12)
+
+    def test_window_parse(self, csv_file):
+        p, data = csv_file
+        got = _native.csv_parse(p, row_begin=100, row_end=150)
+        np.testing.assert_allclose(got, data[100:150], rtol=1e-12)
+
+    def test_missing_fields_are_nan(self, tmp_path):
+        p = tmp_path / "m.csv"
+        p.write_text("1,,3\n4,5,\n")
+        got = _native.csv_parse(str(p))
+        assert np.isnan(got[0, 1]) and np.isnan(got[1, 2])
+        assert got[0, 0] == 1 and got[1, 1] == 5
+
+    def test_crlf(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("1,2\r\n3,4\r\n")
+        got = _native.csv_parse(str(p))
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_semicolon_sep(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_text("1;2\n3;4\n")
+        got = _native.csv_parse(str(p), sep=";")
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_bad_range(self, csv_file):
+        p, _ = csv_file
+        assert _native.csv_parse(p, row_begin=400, row_end=9999) is None
+
+    def test_blank_lines_skipped(self, tmp_path):
+        # genfromtxt skips blank lines anywhere; the native path must match
+        p = tmp_path / "blank.csv"
+        p.write_text("1,2\n\n3,4\n   \n5,6\n")
+        got = _native.csv_parse(str(p))
+        np.testing.assert_allclose(got, [[1, 2], [3, 4], [5, 6]])
+
+    def test_ragged_rows_raise(self, tmp_path):
+        p = tmp_path / "ragged.csv"
+        p.write_text("1,2,3\n4,5\n")
+        with pytest.raises(ValueError):
+            _native.csv_parse(str(p))
+
+    def test_index_reuse(self, csv_file):
+        p, data = csv_file
+        with _native.CsvIndex(p) as idx:
+            assert idx.nrows == 500 and idx.ncols() == 7
+            a = idx.parse(row_begin=0, row_end=10)
+            b = idx.parse(row_begin=490, row_end=500)
+        np.testing.assert_allclose(a, data[:10], rtol=1e-12)
+        np.testing.assert_allclose(b, data[490:], rtol=1e-12)
+
+
+class TestCsvWrite:
+    def test_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((100, 5))
+        p = str(tmp_path / "w.csv")
+        assert _native.csv_write(p, data)
+        back = _native.csv_parse(p)
+        np.testing.assert_allclose(back, data, rtol=1e-12)
+
+    def test_decimals(self, tmp_path):
+        p = str(tmp_path / "d.csv")
+        assert _native.csv_write(p, np.array([[1.23456, 2.5]]), decimals=2)
+        assert open(p).read().strip() == "1.23,2.50"
+
+    def test_float32_repr_compact(self, tmp_path):
+        # float32 data must print its float32 shortest repr ("0.1"), not the
+        # float64 expansion ("0.10000000149011612")
+        p = str(tmp_path / "f32.csv")
+        assert _native.csv_write(p, np.array([[0.1]], dtype=np.float32), float32_repr=True)
+        assert open(p).read().strip() == "0.1"
+
+    def test_huge_fixed_value_matches_savetxt(self, tmp_path):
+        # 1e300 in fixed notation is ~300 digits; must be written faithfully
+        # (np.savetxt '%.3f' behavior), never as buffer-overflow garbage
+        p = str(tmp_path / "big.csv")
+        assert _native.csv_write(p, np.array([[1e300]]), decimals=3)
+        got = open(p).read().strip()
+        assert got == "%.3f" % 1e300
+
+    def test_fixed_overflow_fails_loudly(self, tmp_path):
+        # decimals large enough to overflow the format buffer must error,
+        # not write garbage
+        p = str(tmp_path / "big2.csv")
+        assert not _native.csv_write(p, np.array([[1e300]]), decimals=400)
+
+
+class TestChunkMath:
+    @pytest.mark.parametrize("n,nproc", [(13, 4), (8, 8), (3, 8), (0, 4), (100, 7)])
+    def test_counts_displs(self, n, nproc):
+        counts, displs = _native.chunk_counts_displs(n, nproc)
+        assert counts.sum() == n
+        # ceil-div grid: matches the Python comm.chunk math
+        c = -(-n // nproc) if n else 0
+        for r in range(nproc):
+            lo, hi = min(r * c, n), min(r * c + c, n)
+            assert counts[r] == hi - lo
+            assert displs[r] == lo
+
+
+class TestIoIntegration:
+    def test_load_csv_native_path(self, csv_file):
+        p, data = csv_file
+        x = ht.load_csv(p, split=0)
+        np.testing.assert_allclose(x.numpy(), data.astype(np.float32), rtol=1e-5)
+        assert x.split == 0
+
+    def test_load_csv_header(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("colA,colB\n1.5,2.5\n3.5,4.5\n")
+        x = ht.load_csv(str(p), header_lines=1)
+        np.testing.assert_allclose(x.numpy(), [[1.5, 2.5], [3.5, 4.5]])
+
+    def test_load_csv_single_column(self, tmp_path):
+        p = tmp_path / "one.csv"
+        p.write_text("1.0\n2.0\n3.0\n")
+        x = ht.load_csv(str(p))
+        assert x.shape == (3,)
+
+    def test_load_csv_scalar(self, tmp_path):
+        # genfromtxt returns a 0-d scalar for a single-value file
+        p = tmp_path / "scalar.csv"
+        p.write_text("5.0\n")
+        x = ht.load_csv(str(p))
+        assert x.shape == () and float(x) == 5.0
+
+    def test_load_csv_unusual_encoding_falls_back(self, tmp_path):
+        p = tmp_path / "l1.csv"
+        p.write_bytes("1.5,2.5\n".encode("latin-1"))
+        x = ht.load_csv(str(p), encoding="latin-1")
+        np.testing.assert_allclose(x.numpy(), [[1.5, 2.5]])
+
+    def test_save_csv_float32_compact(self, tmp_path):
+        x = ht.array(np.array([[0.1, 0.2]], dtype=np.float32))
+        p = str(tmp_path / "c.csv")
+        ht.save_csv(x, p)
+        assert open(p).read().strip() == "0.1,0.2"
+
+    def test_save_csv_native_path(self, tmp_path):
+        x = ht.arange(12, dtype=ht.float32).reshape((3, 4))
+        p = str(tmp_path / "out.csv")
+        ht.save_csv(x, p)
+        back = np.genfromtxt(p, delimiter=",")
+        np.testing.assert_allclose(back, x.numpy())
+
+    def test_save_csv_with_header_falls_back(self, tmp_path):
+        x = ht.arange(4, dtype=ht.float32).reshape((2, 2))
+        p = str(tmp_path / "hdr.csv")
+        ht.save_csv(x, p, header_lines=["a,b"])
+        lines = open(p).read().strip().splitlines()
+        assert lines[0] == "a,b" and len(lines) == 3
