@@ -1,89 +1,96 @@
 """heat_tpu benchmark — prints ONE JSON line for the driver.
 
-Primary metric (BASELINE.md): distributed-matmul TFLOPS/chip on the
-`ht.matmul` path (config[0]: 4096x4096 float32).  vs_baseline is measured
-against torch-CPU running the identical GEMM on this host (the only
-reference implementation available in this environment — BASELINE.json has
-no published numbers and the reference mount is empty).
-Secondary numbers (KMeans iter/s, TSQR) ride along in "extra".
+Primary metric (BASELINE.json north star): distributed-matmul TFLOPS/chip on
+the public ``ht.matmul`` path at **16384x16384 float32** (the north-star
+workload).  vs_baseline compares achieved TFLOPS against torch-CPU running
+the 4096 GEMM on this host (the only reference implementation available in
+this environment — BASELINE.json has no published numbers and the reference
+mount is empty); TFLOPS/TFLOPS is size-comparable.
+Secondary numbers (4096 GEMM, bf16 GEMM, KMeans iter/s) ride in "extra".
 
 Timing notes: on the tunneled axon platform ``block_until_ready`` does not
-actually block, so completion is forced by fetching a scalar.  METHODOLOGY
-(changed from the first revision, numbers are not comparable to it): the
-CHAIN GEMMs run as ONE fused jitted ``lax.scan`` program through the public
-``ht.matmul``, so per-GEMM time measures on-device compute and excludes
-per-dispatch/tunnel latency entirely; the chained values are rescaled each
-step to stay finite in float32.
+actually block, so completion is forced by fetching a scalar.  METHODOLOGY:
+the CHAIN GEMMs run as ONE fused jitted ``lax.scan`` program through the
+public ``ht.matmul``, so per-GEMM time measures on-device compute and
+excludes per-dispatch/tunnel latency entirely; the chained values are
+rescaled each step to stay finite.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
 import numpy as np
 
-CHAIN = 100
+
+def _gemm_seconds(ht, jax, n: int, dtype, iters: int) -> float:
+    """Per-GEMM seconds for an n x n chain through the public ht.matmul."""
+    a = ht.random.randn(n, n, dtype=dtype, split=0)
+    b = ht.random.randn(n, n, dtype=dtype, split=1)
+    scale = float(1.0 / np.sqrt(n))  # keeps chained values finite
+
+    @functools.partial(jax.jit, static_argnames="iters")
+    def chain(a, b, iters):
+        def body(c, _):
+            return (ht.matmul(c, b) * scale), None
+
+        c, _ = jax.lax.scan(body, a, None, length=iters)
+        return c
+
+    float(chain(a, b, iters)._jarray[0, 0])  # compile + warm
+    t0 = time.perf_counter()
+    c = chain(a, b, iters)
+    _ = float(c._jarray[0, 0])  # forces completion through the tunnel
+    return (time.perf_counter() - t0) / iters
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
     import heat_tpu as ht
 
-    n = 4096
-    flops = 2.0 * n * n * n
-
-    # --- heat_tpu distributed matmul (split=0 @ split=1), f32 ------------ #
-    a = ht.random.randn(n, n, dtype=ht.float32, split=0)
-    b = ht.random.randn(n, n, dtype=ht.float32, split=1)
-
-    # the chain runs through the framework's public matmul (DNDarray is a
-    # pytree, so the whole chain is ONE jitted XLA program — per-GEMM cost
-    # is measured without per-dispatch tunnel latency)
-    import functools
-
-    import jax as _jax
-
-    scale = float(1.0 / np.sqrt(n))  # keeps the chained values finite in f32
-
-    @functools.partial(_jax.jit, static_argnames="iters")
-    def chain(a, b, iters):
-        import heat_tpu as _ht
-
-        def body(c, _):
-            return (_ht.matmul(c, b) * scale), None
-
-        c, _ = _jax.lax.scan(body, a, None, length=iters)
-        return c
-
-    float(chain(a, b, CHAIN)._jarray[0, 0])  # compile + warm
-    t0 = time.perf_counter()
-    c = chain(a, b, CHAIN)
-    _ = float(c._jarray[0, 0])  # forces completion through the tunnel
-    t_ht = (time.perf_counter() - t0) / CHAIN
-    tflops = flops / t_ht / 1e12
     n_chips = max(len(jax.devices()), 1)
-    tflops_per_chip = tflops / n_chips
+    extra = {"platform": jax.devices()[0].platform, "n_chips": n_chips}
 
-    extra = {"platform": jax.devices()[0].platform, "n_chips": n_chips,
-             "matmul_wallclock_s": round(t_ht, 6), "chain_iters": CHAIN}
+    # --- headline: 16384^2 f32 (north-star config) ----------------------- #
+    N = 16384
+    t_big = _gemm_seconds(ht, jax, N, ht.float32, iters=20)
+    tflops_big = 2.0 * N * N * N / t_big / 1e12 / n_chips
+    extra["matmul_16384_wallclock_s"] = round(t_big, 6)
 
-    # --- torch-CPU reference for the same GEMM --------------------------- #
+    # --- secondary GEMM configs ------------------------------------------ #
+    t_4096 = _gemm_seconds(ht, jax, 4096, ht.float32, iters=100)
+    extra["matmul_4096_f32_tflops_per_chip"] = round(
+        2.0 * 4096**3 / t_4096 / 1e12 / n_chips, 3
+    )
+    try:
+        t_bf16 = _gemm_seconds(ht, jax, N, ht.bfloat16, iters=20)
+        extra["matmul_16384_bf16_tflops_per_chip"] = round(
+            2.0 * N**3 / t_bf16 / 1e12 / n_chips, 3
+        )
+    except Exception as e:  # bf16 path must never sink the bench
+        extra["bf16_error"] = str(e)[:80]
+
+    # --- torch-CPU reference for the 4096 GEMM --------------------------- #
+    vs_baseline = 1.0
     try:
         import torch
 
-        ta = torch.randn(n, n, dtype=torch.float32)
-        tb = torch.randn(n, n, dtype=torch.float32)
+        ta = torch.randn(4096, 4096, dtype=torch.float32)
+        tb = torch.randn(4096, 4096, dtype=torch.float32)
         ta @ tb  # warmup
         t0 = time.perf_counter()
-        tc = ta @ tb
+        ta @ tb
         t_torch = time.perf_counter() - t0
-        extra["torch_cpu_wallclock_s"] = round(t_torch, 5)
-        vs_baseline = t_torch / t_ht  # speedup over torch-CPU wall-clock
+        torch_tflops = 2.0 * 4096**3 / t_torch / 1e12
+        extra["torch_cpu_4096_tflops"] = round(torch_tflops, 3)
+        # TFLOPS-vs-TFLOPS: size-normalized speedup of the whole accelerator
+        # complement over the host reference (tflops_big is per-chip)
+        vs_baseline = tflops_big * n_chips / torch_tflops
     except Exception:
-        vs_baseline = 1.0
+        pass
 
     # --- KMeans iter/sec (scaled-down config[2]) ------------------------- #
     try:
@@ -99,8 +106,8 @@ def main() -> None:
         extra["kmeans_error"] = str(e)[:80]
 
     print(json.dumps({
-        "metric": "dist_matmul_4096_f32_tflops_per_chip",
-        "value": round(tflops_per_chip, 3),
+        "metric": "dist_matmul_16384_f32_tflops_per_chip",
+        "value": round(tflops_big, 3),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(vs_baseline, 3),
         "extra": extra,

@@ -148,7 +148,7 @@ class CsvIndex:
 
 def csv_dims(path: str, sep: str = ",", skiprows: int = 0, nthreads: int = 0):
     """(nrows, ncols) of the data region of a CSV file, or None on fallback."""
-    if _load() is None:
+    if _load() is None or len(sep) != 1:
         return None
     try:
         with CsvIndex(path, skiprows, nthreads) as idx:
@@ -165,7 +165,7 @@ def csv_parse(path: str, sep: str = ",", skiprows: int = 0,
     Returns None when the native library is unavailable or the file cannot
     be opened (caller falls back); raises ValueError on malformed data.
     """
-    if _load() is None:
+    if _load() is None or len(sep) != 1:
         return None
     try:
         idx = CsvIndex(path, skiprows, nthreads)
@@ -174,17 +174,14 @@ def csv_parse(path: str, sep: str = ",", skiprows: int = 0,
     with idx:
         if row_end is not None and row_end > idx.nrows:
             return None
-        try:
-            return idx.parse(sep, row_begin, row_end, ncols, nthreads)
-        except ValueError:
-            raise
+        return idx.parse(sep, row_begin, row_end, ncols, nthreads)
 
 
 def csv_write(path: str, data: np.ndarray, sep: str = ",", decimals: int = -1,
               float32_repr: bool = False, nthreads: int = 0) -> bool:
     """Write a 2-D float array as CSV; returns False on fallback."""
     lib = _load()
-    if lib is None:
+    if lib is None or len(sep) != 1:
         return False
     arr = np.ascontiguousarray(data, dtype=np.float64)
     if arr.ndim != 2:

@@ -73,8 +73,11 @@ int pick_threads(int nthreads, size_t work_items) {
   return nthreads;
 }
 
-bool is_blank(const char* lo, const char* hi) {
+// blank line or comment line ('#' first non-ws char) — both skipped, matching
+// numpy.genfromtxt's defaults
+bool is_skippable(const char* lo, const char* hi) {
   for (const char* p = lo; p < hi; ++p) {
+    if (*p == '#') return true;
     if (*p != '\n' && *p != '\r' && *p != ' ' && *p != '\t') return false;
   }
   return true;
@@ -141,7 +144,7 @@ std::vector<size_t> line_offsets(const MappedFile& f, int64_t skiprows, int nthr
   std::vector<size_t> kept;
   kept.reserve(offsets.size() - first);
   for (size_t i = first; i + 1 < offsets.size(); ++i) {
-    if (!is_blank(f.data + offsets[i], f.data + offsets[i + 1])) kept.push_back(offsets[i]);
+    if (!is_skippable(f.data + offsets[i], f.data + offsets[i + 1])) kept.push_back(offsets[i]);
   }
   kept.push_back(f.size);
   // bound each kept line by the next kept start: rebuild as [start..., size];
@@ -163,14 +166,17 @@ int64_t count_cols(const char* lo, const char* hi, char sep) {
 // (genfromtxt raises on ragged rows). Empty fields parse as NaN.
 bool parse_line(const char* lo, const char* hi, char sep, double* out, int64_t ncols) {
   // clip to the first newline (a kept line followed by removed blank lines
-  // may span to the next kept offset)
+  // may span to the next kept offset) and strip an inline '#' comment
   const char* nl = static_cast<const char*>(memchr(lo, '\n', hi - lo));
   if (nl) hi = nl;
+  const char* cm = static_cast<const char*>(memchr(lo, '#', hi - lo));
+  if (cm) hi = cm;
   while (hi > lo && (hi[-1] == '\r' || hi[-1] == ' ' || hi[-1] == '\t')) --hi;
   if (count_cols(lo, hi, sep) != ncols) return false;
   const char* p = lo;
   for (int64_t c = 0; c < ncols; ++c) {
     while (p < hi && (*p == ' ' || *p == '\t')) ++p;
+    if (p < hi && *p == '+') ++p;  // from_chars rejects a leading '+'
     double v;
     auto [next, ec] = std::from_chars(p, hi, v);
     if (ec != std::errc()) {

@@ -100,6 +100,26 @@ class TestCsvParse:
         with pytest.raises(ValueError):
             _native.csv_parse(str(p))
 
+    def test_comment_lines_skipped(self, tmp_path):
+        # genfromtxt skips '#' comment lines and strips inline comments
+        p = tmp_path / "cmt.csv"
+        p.write_text("# header note\n1,2\n3,4 # inline\n")
+        got = _native.csv_parse(str(p))
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_leading_plus_sign(self, tmp_path):
+        p = tmp_path / "plus.csv"
+        p.write_text("+3,4\n-5,+6.5\n")
+        got = _native.csv_parse(str(p))
+        np.testing.assert_allclose(got, [[3, 4], [-5, 6.5]])
+
+    def test_multichar_sep_falls_back(self, tmp_path):
+        p = tmp_path / "mc.csv"
+        p.write_text("1::2\n")
+        assert _native.csv_parse(str(p), sep="::") is None
+        assert _native.csv_dims(str(p), sep="::") is None
+        assert not _native.csv_write(str(tmp_path / "o.csv"), np.ones((1, 2)), sep="::")
+
     def test_index_reuse(self, csv_file):
         p, data = csv_file
         with _native.CsvIndex(p) as idx:
