@@ -154,10 +154,15 @@ std::vector<size_t> line_offsets(const MappedFile& f, int64_t skiprows, int nthr
 }
 
 int64_t count_cols(const char* lo, const char* hi, char sep) {
+  // clip to line end and strip an inline '#' comment, exactly as parse_line
+  // does — a separator inside a comment must not count as a column
+  const char* nl = static_cast<const char*>(memchr(lo, '\n', hi - lo));
+  if (nl) hi = nl;
+  const char* cm = static_cast<const char*>(memchr(lo, '#', hi - lo));
+  if (cm) hi = cm;
   int64_t cols = 1;
   for (const char* p = lo; p < hi; ++p) {
     if (*p == sep) ++cols;
-    if (*p == '\n') break;
   }
   return cols;
 }

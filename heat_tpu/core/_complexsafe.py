@@ -1,0 +1,124 @@
+"""Complex-safe placement for accelerator transports without native complex.
+
+Some TPU transports (the experimental ``axon`` tunnel in particular) cannot
+materialize complex buffers on device: the first complex allocation fails with
+``UNIMPLEMENTED`` *and poisons the backend for every subsequent op* (verified
+empirically — after one complex creation even float ops fail until the process
+exits).  XLA:TPU proper supports complex64, so this is a transport limitation,
+not a compiler one; real multi-chip deployments are unaffected.
+
+Strategy (mirrors the reference's device seam, ``heat/core/devices.py``): when
+the default backend is such a transport, complex arrays are *physically* kept
+on the host CPU backend while retaining their logical ``split``/``comm``
+metadata.  All complex compute then runs on the CPU backend (which supports
+complex natively); real-valued results migrate back to the accelerator at the
+next ``Communication.shard`` placement.  The seam is three interception
+points:
+
+- :func:`guard` inside ``Communication.shard`` — complex results stay on host;
+- :func:`colocate` inside ``_operations._binary_op`` — mixed complex/real
+  operand pairs are pulled to the host backend before dispatch;
+- :func:`creation_ctx` around eager creation calls in ``factories`` /
+  ``fft`` / ``DNDarray.astype`` — complex allocations are born on host.
+
+Set ``HEAT_TPU_FORCE_HOST_COMPLEX=1`` to force the host path on any backend
+(used by the test suite to exercise this mode on CPU).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import nullcontext
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "native_complex_supported",
+    "is_complex",
+    "to_host_backend",
+    "guard",
+    "colocate",
+    "creation_ctx",
+]
+
+# transports that cannot hold complex buffers on device
+_DENYLIST = ("axon",)
+
+
+@lru_cache(maxsize=1)
+def native_complex_supported() -> bool:
+    """True when the default backend can materialize complex arrays."""
+    if os.environ.get("HEAT_TPU_FORCE_HOST_COMPLEX", "") == "1":
+        return False
+    try:
+        return jax.default_backend() not in _DENYLIST
+    except Exception:
+        return True
+
+
+@lru_cache(maxsize=1)
+def _cpu_device():
+    return jax.local_devices(backend="cpu")[0]
+
+
+def is_complex(x) -> bool:
+    if isinstance(x, complex):
+        return True
+    dt = getattr(x, "dtype", None)
+    try:
+        return dt is not None and jnp.issubdtype(dt, jnp.complexfloating)
+    except TypeError:
+        return False
+
+
+def to_host_backend(arr):
+    """Commit ``arr`` to the host CPU backend.
+
+    Always device_put (a no-op copy when already resident) — an array that is
+    merely *placed* on cpu but uncommitted would let later ops dispatch to the
+    default (denylisted) backend.
+    """
+    if isinstance(arr, jax.core.Tracer):
+        return arr
+    return jax.device_put(arr, _cpu_device())
+
+
+def guard(arr):
+    """Keep complex arrays on the host backend in non-native mode.
+
+    Returns the (possibly moved) array, or None if no special handling applies
+    — the caller proceeds with normal mesh placement.
+    """
+    if native_complex_supported() or isinstance(arr, jax.core.Tracer):
+        return None
+    if is_complex(arr):
+        return to_host_backend(arr)
+    return None
+
+
+def colocate(j1, j2):
+    """Pull a mixed operand pair to the host backend when either side is
+    complex (non-native mode only); scalars pass through untouched."""
+    if native_complex_supported():
+        return j1, j2
+    if is_complex(j1) or is_complex(j2):
+        if isinstance(j1, jax.Array):
+            j1 = to_host_backend(j1)
+        if isinstance(j2, jax.Array):
+            j2 = to_host_backend(j2)
+    return j1, j2
+
+
+def creation_ctx(dtype):
+    """Context manager: create complex arrays on the host backend."""
+    if dtype is None or native_complex_supported():
+        return nullcontext()
+    try:
+        cpx = jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+    except TypeError:
+        return nullcontext()
+    if cpx:
+        return jax.default_device(_cpu_device())
+    return nullcontext()

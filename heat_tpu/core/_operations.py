@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import sanitation, types
+from . import _complexsafe, sanitation, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
@@ -118,6 +118,7 @@ def _binary_op(
 
     j1 = a1._jarray if isinstance(a1, DNDarray) else a1
     j2 = a2._jarray if isinstance(a2, DNDarray) else a2
+    j1, j2 = _complexsafe.colocate(j1, j2)
     result = op(j1, j2, **fn_kwargs)
     if res_split is not None and res_split >= result.ndim:
         res_split = None
@@ -126,13 +127,16 @@ def _binary_op(
     if out is not None:
         if where is not None:
             w = where._jarray if isinstance(where, DNDarray) else jnp.asarray(where)
-            result = jnp.where(w, result, out._jarray)
+            w, result = _complexsafe.colocate(w, result)
+            ob, result = _complexsafe.colocate(out._jarray, result)
+            result = jnp.where(w, result, ob)
             result = comm.shard(result, res_split)
         sanitation.sanitize_out(out, result.shape, res_split, device)
         out._jarray = result.astype(out.dtype.jax_dtype())
         return out
     if where is not None:
         w = where._jarray if isinstance(where, DNDarray) else jnp.asarray(where)
+        w, result = _complexsafe.colocate(w, result)
         result = comm.shard(jnp.where(w, result, jnp.zeros_like(result)), res_split)
     return DNDarray(
         result,

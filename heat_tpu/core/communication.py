@@ -181,6 +181,11 @@ class Communication:
         computation-follows-data propagation and ``split`` remains *logical*
         metadata (SURVEY §7, hard part #1 — padding-free best-effort design).
         """
+        from ._complexsafe import guard
+
+        hosted = guard(array)
+        if hosted is not None:
+            return hosted  # complex on a transport without native complex
         if split is not None:
             split = split % array.ndim if array.ndim else None
         if split is not None and (

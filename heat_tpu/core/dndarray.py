@@ -231,8 +231,14 @@ class DNDarray:
     # basic conversions
     # ------------------------------------------------------------------ #
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
+        from . import _complexsafe
+
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jax_dtype())
+        jdt = dtype.jax_dtype()
+        src = self.__array
+        if jnp.issubdtype(jdt, jnp.complexfloating) and not _complexsafe.native_complex_supported():
+            src = _complexsafe.to_host_backend(src)
+        casted = src.astype(jdt)
         # honor JAX canonicalization (64→32-bit when x64 is off) in metadata
         dtype = types.canonical_heat_type(casted.dtype)
         if copy:
@@ -245,7 +251,16 @@ class DNDarray:
 
     def numpy(self) -> np.ndarray:
         """Gather the global array to host memory as a numpy array."""
-        return np.asarray(jax.device_get(self.__array))
+        try:
+            return np.asarray(jax.device_get(self.__array))
+        except jax.errors.JaxRuntimeError:
+            if jnp.issubdtype(self.__array.dtype, jnp.complexfloating):
+                # some TPU transports cannot ship complex buffers to host;
+                # move the real/imag planes separately and recombine
+                re = np.asarray(jax.device_get(jnp.real(self.__array)))
+                im = np.asarray(jax.device_get(jnp.imag(self.__array)))
+                return (re + 1j * im).astype(self.__dtype.np_dtype())
+            raise
 
     def __array__(self, dtype=None) -> np.ndarray:
         a = self.numpy()

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import devices, types
+from . import _complexsafe, devices, types
 from .communication import Communication, sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
@@ -93,9 +93,13 @@ def array(
         npa = np.asarray(obj)
         if npa.dtype == object:
             raise TypeError("invalid data of type object")
-        jarr = jnp.asarray(npa)
+        with _complexsafe.creation_ctx(npa.dtype):
+            jarr = jnp.asarray(npa)
     if dtype is not None:
-        jarr = jarr.astype(types.canonical_heat_type(dtype).jax_dtype())
+        jdt = types.canonical_heat_type(dtype).jax_dtype()
+        if jnp.issubdtype(jdt, jnp.complexfloating) and not _complexsafe.native_complex_supported():
+            jarr = _complexsafe.to_host_backend(jarr)
+        jarr = jarr.astype(jdt)
     while jarr.ndim < ndmin:
         jarr = jarr[jnp.newaxis]
     eff_split = split if split is not None else is_split
@@ -111,13 +115,18 @@ def _filled(shape, value, dtype, split, device, comm, like=None) -> DNDarray:
     dtype = types.canonical_heat_type(dtype)
     comm_s = sanitize_comm(comm)
     split_s = sanitize_axis(shape, split)
-    sharding = comm_s.sharding(len(shape), split_s)
-    # jnp.full with out_sharding materializes each shard on its own device —
-    # no host round-trip, no full replica (TPU-friendly for huge arrays)
-    try:
-        jarr = jnp.full(shape, value, dtype=dtype.jax_dtype(), out_sharding=sharding)
-    except (TypeError, ValueError):
-        jarr = comm_s.shard(jnp.full(shape, value, dtype=dtype.jax_dtype()), split_s)
+    jdt = dtype.jax_dtype()
+    if jnp.issubdtype(jdt, jnp.complexfloating) and not _complexsafe.native_complex_supported():
+        with _complexsafe.creation_ctx(jdt):
+            jarr = jnp.full(shape, value, dtype=jdt)
+    else:
+        sharding = comm_s.sharding(len(shape), split_s)
+        # jnp.full with out_sharding materializes each shard on its own device —
+        # no host round-trip, no full replica (TPU-friendly for huge arrays)
+        try:
+            jarr = jnp.full(shape, value, dtype=jdt, out_sharding=sharding)
+        except (TypeError, ValueError):
+            jarr = comm_s.shard(jnp.full(shape, value, dtype=jdt), split_s)
     return DNDarray(jarr, shape, dtype, split_s, devices.sanitize_device(device), comm_s, True)
 
 

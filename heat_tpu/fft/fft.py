@@ -35,17 +35,28 @@ def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
     )
 
 
+def _fft_in(x: DNDarray):
+    """FFT compute involves complex intermediates for every transform; on
+    transports without native complex the whole transform runs on the host
+    backend (real results migrate back at the next placement)."""
+    from ..core import _complexsafe
+
+    if _complexsafe.native_complex_supported():
+        return x._jarray
+    return _complexsafe.to_host_backend(x._jarray)
+
+
 def _fft_op(op_name: str, x: DNDarray, n=None, axis=-1, norm=None) -> DNDarray:
     sanitize_in(x)
     op = getattr(jnp.fft, op_name)
-    res = op(x._jarray, n=n, axis=axis, norm=norm)
+    res = op(_fft_in(x), n=n, axis=axis, norm=norm)
     return _wrap(res, x.split, x)
 
 
 def _fftn_op(op_name: str, x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     sanitize_in(x)
     op = getattr(jnp.fft, op_name)
-    res = op(x._jarray, s=s, axes=axes, norm=norm)
+    res = op(_fft_in(x), s=s, axes=axes, norm=norm)
     return _wrap(res, x.split, x)
 
 
@@ -94,7 +105,7 @@ def hfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     sanitize_in(x)
     if s is not None:
         raise NotImplementedError("hfft2 with explicit shape not supported")
-    res = jnp.fft.hfft(jnp.fft.fft(x._jarray, axis=axes[0], norm=norm), axis=axes[1], norm=norm)
+    res = jnp.fft.hfft(jnp.fft.fft(_fft_in(x), axis=axes[0], norm=norm), axis=axes[1], norm=norm)
     return _wrap(res, x.split, x)
 
 
@@ -102,7 +113,7 @@ def ihfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     sanitize_in(x)
     if s is not None:
         raise NotImplementedError("ihfft2 with explicit shape not supported")
-    res = jnp.fft.ifft(jnp.fft.ihfft(x._jarray, axis=axes[1], norm=norm), axis=axes[0], norm=norm)
+    res = jnp.fft.ifft(jnp.fft.ihfft(_fft_in(x), axis=axes[1], norm=norm), axis=axes[0], norm=norm)
     return _wrap(res, x.split, x)
 
 

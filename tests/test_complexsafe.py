@@ -1,0 +1,85 @@
+"""Complex-safe placement mode (``heat_tpu/core/_complexsafe.py``).
+
+Some TPU transports cannot hold complex buffers on device (one complex
+allocation poisons the whole backend — observed on the experimental axon
+tunnel).  In that mode complex arrays live on the host CPU backend while
+keeping their logical split metadata.  These tests force the mode via
+``HEAT_TPU_FORCE_HOST_COMPLEX=1`` in a subprocess so the main CPU suite keeps
+exercising the native path.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import _complexsafe
+
+assert not _complexsafe.native_complex_supported()
+
+# fft of a split array, complex result, oracle check
+ht.random.seed(7)
+z = ht.random.randn(12, 6, split=0)
+f = ht.fft.fft(z, axis=1)
+np.testing.assert_allclose(f.numpy(), np.fft.fft(z.numpy(), axis=1), rtol=1e-4, atol=1e-4)
+assert f.split == 0
+
+# real-result transforms come back to the default placement path
+g = ht.fft.irfft(ht.fft.rfft(z, axis=0), n=12, axis=0)
+np.testing.assert_allclose(g.numpy(), z.numpy(), rtol=1e-4, atol=1e-4)
+
+# factories with complex dtype
+c = ht.full((3, 3), 2 - 1j, dtype=ht.complex64)
+np.testing.assert_allclose(c.numpy(), np.full((3, 3), 2 - 1j, np.complex64))
+zz = ht.zeros((2, 2), dtype=ht.complex128)
+assert np.iscomplexobj(zz.numpy())
+
+# complex math + mixed real/complex arithmetic (colocation path)
+w = f * 2.0 + ht.conj(f)
+np.testing.assert_allclose(
+    w.numpy(), 2 * np.fft.fft(z.numpy(), axis=1) + np.conj(np.fft.fft(z.numpy(), axis=1)),
+    rtol=1e-4, atol=1e-4,
+)
+np.testing.assert_allclose(
+    np.asarray(ht.angle(f)), np.angle(np.fft.fft(z.numpy(), axis=1)), rtol=1e-4, atol=1e-4
+)
+
+# astype to complex and back
+cast = z.astype(ht.complex64)
+assert cast.dtype is ht.complex64
+back = cast.real.astype(ht.float32)
+np.testing.assert_allclose(back.numpy(), z.numpy(), rtol=1e-6)
+
+# python complex scalar against a float DNDarray
+s = z * (1 + 1j)
+np.testing.assert_allclose(s.numpy(), z.numpy() * (1 + 1j), rtol=1e-5)
+print("COMPLEXSAFE_OK")
+"""
+
+
+def test_host_complex_mode():
+    env = dict(os.environ)
+    env["HEAT_TPU_FORCE_HOST_COMPLEX"] = "1"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=600,
+    )
+    assert "COMPLEXSAFE_OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_native_mode_flag_default():
+    from heat_tpu.core import _complexsafe
+
+    # in the CPU test environment complex is natively supported
+    assert _complexsafe.native_complex_supported()
