@@ -107,13 +107,42 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             centers, (k, d), x.dtype, None, x.device, x.comm, True
         )
 
+    # rows per E-step block: bounds the materialized (block, k) distance
+    # tile so the fit scales to BASELINE's 1e8-row config without an n×k
+    # buffer ever existing in HBM (the X matrix itself is the footprint)
+    _ASSIGN_BLOCK = 1 << 20
+
     @staticmethod
     def _assign(jx, centers):
-        """E-step: squared distances + argmin, fused on the MXU."""
-        xx = jnp.sum(jx * jx, axis=1, keepdims=True)
+        """E-step: squared distances + argmin, fused on the MXU.
+
+        For large n the rows are processed in fixed-size blocks via lax.map —
+        XLA keeps each (block, k) distance tile on-chip and the result is just
+        the (n,) labels/min-distances.
+        """
         cc = jnp.sum(centers * centers, axis=1)[None, :]
-        d2 = xx + cc - 2.0 * (jx @ centers.T)
-        return jnp.argmin(d2, axis=1), jnp.min(jnp.maximum(d2, 0.0), axis=1)
+
+        def block_assign(xb):
+            xx = jnp.sum(xb * xb, axis=1, keepdims=True)
+            d2 = xx + cc - 2.0 * (xb @ centers.T)
+            return jnp.argmin(d2, axis=1), jnp.min(jnp.maximum(d2, 0.0), axis=1)
+
+        n = jx.shape[0]
+        blk = _KCluster._ASSIGN_BLOCK
+        if n <= blk:
+            return block_assign(jx)
+        # body processed in fixed blocks, remainder rows as one tail block —
+        # the full n×k tile never materializes for ANY n > blk
+        body = (n // blk) * blk
+        labels, d2min = jax.lax.map(
+            block_assign, jx[:body].reshape(n // blk, blk, jx.shape[1])
+        )
+        labels, d2min = labels.reshape(body), d2min.reshape(body)
+        if body < n:
+            tl, td = block_assign(jx[body:])
+            labels = jnp.concatenate([labels, tl])
+            d2min = jnp.concatenate([d2min, td])
+        return labels, d2min
 
     @staticmethod
     def _update(jx, labels, centers):
@@ -125,7 +154,12 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         (lax.while_loop, SURVEY §3.4) — a single device dispatch per fit,
         no per-iteration host round-trips.  Cached per class so repeated
         fits (and new instances) skip retracing."""
-        prog = cls.__dict__.get("_FIT_PROGRAM")
+        cache = cls.__dict__.get("_FIT_PROGRAM")
+        if cache is None:
+            cache = {}
+            cls._FIT_PROGRAM = cache
+        # the E/M block size is baked into the trace — key the cache on it
+        prog = cache.get(_KCluster._ASSIGN_BLOCK)
         if prog is None:
 
             @jax.jit
@@ -146,7 +180,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
                 labels, d2 = cls._assign(jx, centers)
                 return centers, labels, jnp.sum(d2), n_iter
 
-            cls._FIT_PROGRAM = prog
+            cache[_KCluster._ASSIGN_BLOCK] = prog
         return prog
 
     def fit(self, x: DNDarray):

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+import jax
 import jax.numpy as jnp
 
 from ._kcluster import _KCluster
@@ -38,9 +39,35 @@ class KMeans(_KCluster):
     @staticmethod
     def _update(jx, labels, centers):
         k = centers.shape[0]
-        onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(jx.dtype)
-        sums = onehot.T @ jx          # (k, d) — MXU GEMM + implicit Allreduce
-        counts = jnp.sum(onehot, axis=0)  # (k,)  — implicit Allreduce
+        n = jx.shape[0]
+
+        def block_stats(xb, lb):
+            onehot = (lb[:, None] == jnp.arange(k)[None, :]).astype(xb.dtype)
+            return onehot.T @ xb, jnp.sum(onehot, axis=0)  # MXU GEMM + implicit Allreduce
+
+        blk = _KCluster._ASSIGN_BLOCK
+        if n <= blk:
+            sums, counts = block_stats(jx, labels)
+        else:
+            # accumulate per-block (k, d)/(k,) stats so no n×k one-hot buffer
+            # ever materializes — scales the M-step to BASELINE's 1e8 rows;
+            # remainder rows are folded in as one tail block
+            body = (n // blk) * blk
+
+            def scan_body(carry, xs):
+                s, c = carry
+                xb, lb = xs
+                bs, bc = block_stats(xb, lb)
+                return (s + bs, c + bc), None
+
+            (sums, counts), _ = jax.lax.scan(
+                scan_body,
+                (jnp.zeros((k, jx.shape[1]), jx.dtype), jnp.zeros((k,), jx.dtype)),
+                (jx[:body].reshape(n // blk, blk, jx.shape[1]), labels[:body].reshape(n // blk, blk)),
+            )
+            if body < n:
+                ts, tc = block_stats(jx[body:], labels[body:])
+                sums, counts = sums + ts, counts + tc
         safe = jnp.maximum(counts, 1.0)
         new = sums / safe[:, None]
         # empty clusters keep their previous center (reference behavior)

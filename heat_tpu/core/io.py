@@ -25,10 +25,12 @@ __all__ = [
     "load",
     "load_csv",
     "load_hdf5",
+    "load_netcdf",
     "load_npy_from_path",
     "save",
     "save_csv",
     "save_hdf5",
+    "save_netcdf",
     "supports_hdf5",
     "supports_netcdf",
     "load_checkpoint",
@@ -46,12 +48,15 @@ def supports_hdf5() -> bool:
 
 
 def supports_netcdf() -> bool:
+    """netCDF-4 is supported through the netCDF4 library or, failing that,
+    through h5py (netCDF-4 files are HDF5 containers; classic CDF-1/2 files
+    still need the netCDF4 library)."""
     try:
         import netCDF4  # noqa: F401
 
         return True
     except ImportError:
-        return False
+        return supports_hdf5()
 
 
 # ---------------------------------------------------------------------- #
@@ -176,6 +181,111 @@ def load_npy_from_path(path: str, dtype=types.float32, split: int = 0, device=No
 
 
 # ---------------------------------------------------------------------- #
+# netCDF (reference: heat/core/io.py::load_netcdf/save_netcdf)
+# ---------------------------------------------------------------------- #
+def load_netcdf(path: str, variable: str, dtype=types.float32, split: Optional[int] = None,
+                device=None, comm=None) -> DNDarray:
+    """Load a variable from a netCDF file, hyperslab-parallel like
+    :func:`load_hdf5`.
+
+    Uses the netCDF4 library when present; otherwise reads netCDF-4 files
+    through h5py (netCDF-4 data files ARE HDF5 containers).  Classic-format
+    (CDF-1/2, magic ``CDF\\x01``/``CDF\\x02``) files require the netCDF4
+    library.
+    """
+    try:
+        import netCDF4  # noqa: F401
+    except ImportError:
+        with open(path, "rb") as fh:
+            magic = fh.read(4)
+        if magic[:3] == b"CDF":
+            raise RuntimeError(
+                "classic-format netCDF (CDF-1/2) needs the netCDF4 library, "
+                "which is not available; re-save as netCDF-4/HDF5"
+            )
+        return load_hdf5(path, variable, dtype=dtype, split=split, device=device, comm=comm)
+    import jax
+    import netCDF4
+
+    comm = sanitize_comm(comm)
+    with netCDF4.Dataset(path, "r") as f:
+        var = f.variables[variable]
+        gshape = tuple(var.shape)
+        if split is None or comm.n_processes == 1:
+            data = np.asarray(var[...])
+            return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+        # multi-host: each process reads only its hyperslab (like load_hdf5)
+        nproc, rank = comm.n_processes, comm.rank
+        n = gshape[split]
+        c = -(-n // nproc)
+        lo, hi = min(rank * c, n), min(rank * c + c, n)
+        slices = tuple(
+            slice(lo, hi) if i == split else slice(0, s) for i, s in enumerate(gshape)
+        )
+        data = np.asarray(var[slices]).astype(types.canonical_heat_type(dtype).np_dtype())
+    sharding = comm.sharding(len(gshape), split)
+    jarr = jax.make_array_from_process_local_data(sharding, data, gshape)
+    dev = devices.sanitize_device(device)
+    return DNDarray(jarr, gshape, types.canonical_heat_type(dtype), split, dev, comm, True)
+
+
+def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w",
+                dimension_names=None, **kwargs) -> None:
+    """Write a DNDarray as a netCDF variable.
+
+    With netCDF4 available this writes through it; otherwise an HDF5 file
+    with attached dimension scales is produced via h5py — readable by the
+    netCDF4 library (netCDF-4 files are HDF5 files with dimension scales).
+    """
+    arr = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
+    if dimension_names is None:
+        dimension_names = [f"{variable}_dim{i}" for i in range(arr.ndim)]
+    elif len(dimension_names) != arr.ndim:
+        raise ValueError(
+            f"need {arr.ndim} dimension names, got {len(dimension_names)}"
+        )
+    try:
+        import netCDF4
+    except ImportError:
+        import h5py
+
+        with h5py.File(path, mode if mode in ("w", "a", "r+") else "w") as f:
+            if variable in f:
+                # match the netCDF4 backend: same-shape overwrite in place,
+                # shape change is an error (netCDF cannot delete variables)
+                if tuple(f[variable].shape) != arr.shape:
+                    raise ValueError(
+                        f"variable {variable!r} exists with shape {tuple(f[variable].shape)}, "
+                        f"cannot re-save with shape {arr.shape}"
+                    )
+                f[variable][...] = arr
+                return
+            ds = f.create_dataset(variable, data=arr, **kwargs)
+            for i, dname in enumerate(dimension_names):
+                if dname not in f:
+                    scale = f.create_dataset(dname, data=np.arange(arr.shape[i], dtype=np.float64))
+                    scale.make_scale(dname)
+                ds.dims[i].attach_scale(f[dname])
+        return
+    with netCDF4.Dataset(path, mode) as f:
+        # netCDF cannot delete variables: same-shape re-saves overwrite in
+        # place; a shape/dtype change raises (the h5py path mirrors this)
+        if variable in f.variables:
+            var = f.variables[variable]
+            if tuple(var.shape) != arr.shape:
+                raise ValueError(
+                    f"variable {variable!r} exists with shape {tuple(var.shape)}, "
+                    f"cannot re-save with shape {arr.shape}"
+                )
+        else:
+            for i, dname in enumerate(dimension_names):
+                if dname not in f.dimensions:
+                    f.createDimension(dname, arr.shape[i])
+            var = f.createVariable(variable, arr.dtype, tuple(dimension_names))
+        var[...] = arr
+
+
+# ---------------------------------------------------------------------- #
 # dispatch
 # ---------------------------------------------------------------------- #
 def load(path: str, *args, **kwargs) -> DNDarray:
@@ -187,8 +297,8 @@ def load(path: str, *args, **kwargs) -> DNDarray:
         return load_csv(path, *args, **kwargs)
     if ext == ".npy":
         return load_npy_from_path(path, *args, **kwargs)
-    if ext == ".nc":
-        raise RuntimeError("netCDF4 is not available in this environment")
+    if ext in (".nc", ".nc4", ".netcdf"):
+        return load_netcdf(path, *args, **kwargs)
     raise ValueError(f"Unsupported file extension {ext}")
 
 
@@ -202,6 +312,8 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
     if ext == ".npy":
         np.save(path, data.numpy())
         return
+    if ext in (".nc", ".nc4", ".netcdf"):
+        return save_netcdf(data, path, *args, **kwargs)
     raise ValueError(f"Unsupported file extension {ext}")
 
 

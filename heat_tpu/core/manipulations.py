@@ -45,6 +45,7 @@ __all__ = [
     "fliplr",
     "flipud",
     "hsplit",
+    "dstack",
     "hstack",
     "moveaxis",
     "pad",
@@ -269,6 +270,21 @@ def column_stack(arrays) -> DNDarray:
     return _wrap(res, out_split, proto)
 
 
+def dstack(arrays) -> DNDarray:
+    proto = next(a for a in arrays if isinstance(a, DNDarray))
+    js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    res = jnp.dstack(js)
+    splits = [(a.split, a.ndim) for a in arrays if isinstance(a, DNDarray)]
+    out_split = next((s for s, _ in splits if s is not None), None)
+    # 1-D/2-D inputs are promoted to 3-D with leading axes prepended:
+    # a 1-D data axis lands on axis 1 of the (1, n, k) result
+    if out_split is not None:
+        nd = next(nd for s, nd in splits if s == out_split)
+        if nd == 1:
+            out_split = 1
+    return _wrap(res, out_split, proto)
+
+
 def row_stack(arrays) -> DNDarray:
     return vstack(arrays)
 
@@ -286,8 +302,13 @@ def vstack(arrays) -> DNDarray:
     proto = next(a for a in arrays if isinstance(a, DNDarray))
     js = [a._jarray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     res = jnp.vstack(js)
-    splits = [a.split for a in arrays if isinstance(a, DNDarray)]
-    out_split = next((s for s in splits if s is not None), None)
+    splits = [(a.split, a.ndim) for a in arrays if isinstance(a, DNDarray)]
+    out_split = next((s for s, _ in splits if s is not None), None)
+    # 1-D inputs become rows of the (k, n) result: data axis moves to axis 1
+    if out_split is not None:
+        nd = next(nd for s, nd in splits if s == out_split)
+        if nd == 1:
+            out_split = 1
     return _wrap(res, out_split, proto)
 
 

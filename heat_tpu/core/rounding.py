@@ -100,6 +100,7 @@ DNDarray.__abs__ = lambda self: abs(self)
 DNDarray.ceil = ceil
 DNDarray.clip = clip
 DNDarray.floor = floor
+DNDarray.modf = modf
 DNDarray.round = round
 DNDarray.trunc = trunc
 DNDarray.sign = sign

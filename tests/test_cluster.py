@@ -28,6 +28,28 @@ class TestKMeans(TestCase):
         pred = km.predict(blobs)
         np.testing.assert_array_equal(pred.numpy(), km.labels_.numpy())
 
+    def test_blocked_large_n_path(self):
+        """The memory-bounded E/M path (rows processed in fixed blocks) must
+        match the direct path on divisible row counts."""
+        from heat_tpu.cluster._kcluster import _KCluster
+
+        rng = np.random.default_rng(3)
+        true = rng.normal(size=(4, 6)) * 6
+        X = np.concatenate([true[i] + rng.normal(size=(256, 6)) for i in range(4)])
+        Xh = ht.array(X.astype(np.float32), split=0)
+
+        saved = _KCluster._ASSIGN_BLOCK
+        try:
+            _KCluster._ASSIGN_BLOCK = 128  # force blocking: 1024 rows = 8 blocks
+            km_b = ht.cluster.KMeans(n_clusters=4, random_state=1).fit(Xh)
+        finally:
+            _KCluster._ASSIGN_BLOCK = saved
+        km_d = ht.cluster.KMeans(n_clusters=4, random_state=1).fit(Xh)
+        np.testing.assert_allclose(
+            km_b.cluster_centers_.numpy(), km_d.cluster_centers_.numpy(), rtol=1e-4, atol=1e-4
+        )
+        assert abs(km_b.inertia_ - km_d.inertia_) / km_d.inertia_ < 1e-4
+
     def test_init_variants(self, blobs):
         for init in ["random", "kmeans++"]:
             km = ht.cluster.KMeans(n_clusters=4, init=init, random_state=1).fit(blobs)

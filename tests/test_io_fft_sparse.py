@@ -114,6 +114,23 @@ class TestIO(TestCase):
         c = ht.core.io.load_npy_from_path(str(d), split=0)
         assert c.shape == (10, 4)
 
+    def test_netcdf_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.nc")
+        x = ht.random.randn(12, 5, split=0)
+        ht.save_netcdf(x, p, "temp")
+        y = ht.load_netcdf(p, "temp", split=0)
+        np.testing.assert_allclose(y.numpy(), x.numpy(), rtol=1e-6)
+        # extension dispatch and resplit-on-load
+        z = ht.load(p, "temp", split=1)
+        assert z.split == 1
+        np.testing.assert_allclose(z.numpy(), x.numpy(), rtol=1e-6)
+        assert ht.supports_netcdf()
+        # the h5py-backed writer must attach netCDF-style dimension scales
+        import h5py
+
+        with h5py.File(p, "r") as f:
+            assert "temp_dim0" in f and "temp_dim1" in f
+
     def test_unsupported_ext(self, tmp_path):
         with pytest.raises(ValueError):
             ht.load(str(tmp_path / "x.xyz"))
