@@ -383,6 +383,9 @@ class DNDarray:
         def conv(k):
             if isinstance(k, DNDarray):
                 return k._jarray
+            if isinstance(k, (list, np.ndarray)):
+                # numpy-style list/ndarray fancy index → jnp array
+                return jnp.asarray(k)
             return k
 
         if isinstance(key, tuple):

@@ -62,13 +62,34 @@ def supports_netcdf() -> bool:
 # ---------------------------------------------------------------------- #
 # HDF5
 # ---------------------------------------------------------------------- #
+def _read_hyperslab(reader, gshape, dtype, split, device, comm) -> DNDarray:
+    """Assemble a split DNDarray where each PROCESS reads only its own
+    hyperslab via ``reader(slices) -> ndarray`` (the reference's parallel
+    read; shared by the HDF5 and netCDF loaders)."""
+    import jax
+
+    if split is None or comm.n_processes == 1:
+        data = np.asarray(reader(tuple(slice(0, s) for s in gshape)))
+        return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+    nproc, rank = comm.n_processes, comm.rank
+    n = gshape[split]
+    c = -(-n // nproc)
+    lo, hi = min(rank * c, n), min(rank * c + c, n)
+    slices = tuple(
+        slice(lo, hi) if i == split else slice(0, s) for i, s in enumerate(gshape)
+    )
+    data = np.asarray(reader(slices)).astype(types.canonical_heat_type(dtype).np_dtype())
+    sharding = comm.sharding(len(gshape), split)
+    jarr = jax.make_array_from_process_local_data(sharding, data, gshape)
+    dev = devices.sanitize_device(device)
+    return DNDarray(jarr, gshape, types.canonical_heat_type(dtype), split, dev, comm, True)
+
+
 def load_hdf5(path: str, dataset: str, dtype=types.float32, load_fraction: float = 1.0,
               split: Optional[int] = None, device=None, comm=None) -> DNDarray:
     """Load an HDF5 dataset; with ``split``, each process reads only its
     hyperslab (the reference's parallel read)."""
     import h5py
-
-    import jax
 
     comm = sanitize_comm(comm)
     with h5py.File(path, "r") as f:
@@ -77,23 +98,7 @@ def load_hdf5(path: str, dataset: str, dtype=types.float32, load_fraction: float
         if load_fraction < 1.0 and split == 0:
             n = int(gshape[0] * load_fraction)
             gshape = (n,) + gshape[1:]
-        if split is None or comm.n_processes == 1:
-            data = np.asarray(ds[tuple(slice(0, s) for s in gshape)])
-            return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
-        # multi-host: each PROCESS reads its row-range of the hyperslab and
-        # the global array is assembled from the process-local blocks
-        nproc, rank = comm.n_processes, comm.rank
-        n = gshape[split]
-        c = -(-n // nproc)
-        lo, hi = min(rank * c, n), min(rank * c + c, n)
-        slices = tuple(
-            slice(lo, hi) if i == split else slice(0, s) for i, s in enumerate(gshape)
-        )
-        data = np.asarray(ds[slices]).astype(types.canonical_heat_type(dtype).np_dtype())
-    sharding = comm.sharding(len(gshape), split)
-    jarr = jax.make_array_from_process_local_data(sharding, data, gshape)
-    dev = devices.sanitize_device(device)
-    return DNDarray(jarr, gshape, types.canonical_heat_type(dtype), split, dev, comm, True)
+        return _read_hyperslab(lambda s: ds[s], gshape, dtype, split, device, comm)
 
 
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
@@ -204,29 +209,13 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split: Optional[i
                 "which is not available; re-save as netCDF-4/HDF5"
             )
         return load_hdf5(path, variable, dtype=dtype, split=split, device=device, comm=comm)
-    import jax
     import netCDF4
 
     comm = sanitize_comm(comm)
     with netCDF4.Dataset(path, "r") as f:
         var = f.variables[variable]
         gshape = tuple(var.shape)
-        if split is None or comm.n_processes == 1:
-            data = np.asarray(var[...])
-            return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
-        # multi-host: each process reads only its hyperslab (like load_hdf5)
-        nproc, rank = comm.n_processes, comm.rank
-        n = gshape[split]
-        c = -(-n // nproc)
-        lo, hi = min(rank * c, n), min(rank * c + c, n)
-        slices = tuple(
-            slice(lo, hi) if i == split else slice(0, s) for i, s in enumerate(gshape)
-        )
-        data = np.asarray(var[slices]).astype(types.canonical_heat_type(dtype).np_dtype())
-    sharding = comm.sharding(len(gshape), split)
-    jarr = jax.make_array_from_process_local_data(sharding, data, gshape)
-    dev = devices.sanitize_device(device)
-    return DNDarray(jarr, gshape, types.canonical_heat_type(dtype), split, dev, comm, True)
+        return _read_hyperslab(lambda s: var[s], gshape, dtype, split, device, comm)
 
 
 def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w",
@@ -244,20 +233,30 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w",
         raise ValueError(
             f"need {arr.ndim} dimension names, got {len(dimension_names)}"
         )
+    if mode not in ("w", "a", "r+"):
+        raise ValueError(f"invalid save mode {mode!r}; use 'w', 'a' or 'r+'")
+    # 'a' on a nonexistent file creates it on both backends (h5py would,
+    # netCDF4 would not — normalize so code works regardless of backend)
+    if mode in ("a", "r+") and not os.path.exists(path):
+        mode = "w"
+
+    def _check_existing(shape, dt):
+        # netCDF cannot delete variables: same-shape/dtype re-saves overwrite
+        # in place; any shape or dtype change raises (both backends)
+        if tuple(shape) != arr.shape or np.dtype(dt) != arr.dtype:
+            raise ValueError(
+                f"variable {variable!r} exists with shape {tuple(shape)} dtype {dt}, "
+                f"cannot re-save with shape {arr.shape} dtype {arr.dtype}"
+            )
+
     try:
         import netCDF4
     except ImportError:
         import h5py
 
-        with h5py.File(path, mode if mode in ("w", "a", "r+") else "w") as f:
+        with h5py.File(path, mode) as f:
             if variable in f:
-                # match the netCDF4 backend: same-shape overwrite in place,
-                # shape change is an error (netCDF cannot delete variables)
-                if tuple(f[variable].shape) != arr.shape:
-                    raise ValueError(
-                        f"variable {variable!r} exists with shape {tuple(f[variable].shape)}, "
-                        f"cannot re-save with shape {arr.shape}"
-                    )
+                _check_existing(f[variable].shape, f[variable].dtype)
                 f[variable][...] = arr
                 return
             ds = f.create_dataset(variable, data=arr, **kwargs)
@@ -268,20 +267,14 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w",
                 ds.dims[i].attach_scale(f[dname])
         return
     with netCDF4.Dataset(path, mode) as f:
-        # netCDF cannot delete variables: same-shape re-saves overwrite in
-        # place; a shape/dtype change raises (the h5py path mirrors this)
         if variable in f.variables:
             var = f.variables[variable]
-            if tuple(var.shape) != arr.shape:
-                raise ValueError(
-                    f"variable {variable!r} exists with shape {tuple(var.shape)}, "
-                    f"cannot re-save with shape {arr.shape}"
-                )
+            _check_existing(var.shape, var.dtype)
         else:
             for i, dname in enumerate(dimension_names):
                 if dname not in f.dimensions:
                     f.createDimension(dname, arr.shape[i])
-            var = f.createVariable(variable, arr.dtype, tuple(dimension_names))
+            var = f.createVariable(variable, arr.dtype, tuple(dimension_names), **kwargs)
         var[...] = arr
 
 

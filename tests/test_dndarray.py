@@ -92,6 +92,40 @@ class TestDNDarray(TestCase):
             expected[2:4, 1] = -1.0
             self.assert_array_equal(a, expected)
 
+    def test_indexing_oracle_sweep(self):
+        """Full numpy-oracle sweep of get/set item forms across every split
+        (the reference's split-sweep coverage trick, SURVEY §4)."""
+        N = np.arange(60, dtype=np.float32).reshape(5, 4, 3)
+        for split in [None, 0, 1, 2]:
+            x = ht.array(N, split=split)
+            cases = {
+                "slice": (x[1:4, ::2], N[1:4, ::2]),
+                "neg_step": (x[::-1], N[::-1]),
+                "int_slice": (x[2, 1:], N[2, 1:]),
+                "ellipsis": (x[..., 1], N[..., 1]),
+                "newaxis": (x[None, 2], N[None, 2]),
+                "bool_axis0": (x[N[:, 0, 0] > 20], N[N[:, 0, 0] > 20]),
+                "fancy_2axis": (x[[0, 2], [1, 3]], N[[0, 2], [1, 3]]),
+                "bool_full": (x[N > 30], N[N > 30]),
+                "scalar": (x[2, 1, 0], N[2, 1, 0]),
+            }
+            for name, (got, want) in cases.items():
+                g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+                np.testing.assert_allclose(g, want, rtol=1e-6, err_msg=f"{name} split={split}")
+
+            sets = [
+                (lambda y, Y: (y.__setitem__(slice(1, 3), 0), Y.__setitem__(slice(1, 3), 0))),
+                (lambda y, Y: (y.__setitem__((slice(None), 1, slice(None)), ht.array(np.ones(3, np.float32))),
+                               Y.__setitem__((slice(None), 1, slice(None)), 1))),
+                (lambda y, Y: (y.__setitem__([0, 4], Y[[1, 2]]), Y.__setitem__([0, 4], Y[[1, 2]]))),
+                (lambda y, Y: (y.__setitem__(N > 30, -1.0), Y.__setitem__(N > 30, -1.0))),
+            ]
+            for i, mut in enumerate(sets):
+                y, Y = ht.array(N.copy(), split=split), N.copy()
+                mut(y, Y)
+                np.testing.assert_allclose(y.numpy(), Y, rtol=1e-6, err_msg=f"set case {i} split={split}")
+                assert y.split == split
+
     def test_iter_len(self):
         a = ht.arange(6, split=0)
         assert len(a) == 6

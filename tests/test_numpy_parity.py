@@ -1,0 +1,194 @@
+"""Table-driven numpy-oracle parity bank (reference: ``assert_func_equal``
+sweeps in ``heat/core/tests/test_suites/basic_test.py``).
+
+Every op is evaluated against its numpy counterpart for each split of a small
+float and int input.  This is the broad-coverage net: ops with dedicated
+tests elsewhere are still swept here for split-metadata and value parity.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+F = (np.arange(24, dtype=np.float32).reshape(4, 6) - 11.5) / 3.0
+P = np.abs(F) + 0.5  # strictly positive
+I = np.arange(24, dtype=np.int32).reshape(4, 6) % 7
+B = (np.arange(24).reshape(4, 6) % 3 == 0)
+
+SPLITS = [None, 0, 1]
+
+# (name, ht_fn, np_fn, base input)
+UNARY = [
+    ("abs", ht.abs, np.abs, F),
+    ("ceil", ht.ceil, np.ceil, F),
+    ("floor", ht.floor, np.floor, F),
+    ("trunc", ht.trunc, np.trunc, F),
+    ("round", ht.round, np.round, F),
+    ("sign", ht.sign, np.sign, F),
+    ("exp", ht.exp, np.exp, F),
+    ("expm1", ht.expm1, np.expm1, F),
+    ("exp2", ht.exp2, np.exp2, F),
+    ("log", ht.log, np.log, P),
+    ("log2", ht.log2, np.log2, P),
+    ("log10", ht.log10, np.log10, P),
+    ("log1p", ht.log1p, np.log1p, P),
+    ("sqrt", ht.sqrt, np.sqrt, P),
+    ("square", ht.square, np.square, F),
+    ("cbrt", ht.cbrt, np.cbrt, P),
+    ("rsqrt", ht.rsqrt, lambda a: 1 / np.sqrt(a), P),
+    ("sin", ht.sin, np.sin, F),
+    ("cos", ht.cos, np.cos, F),
+    ("tan", ht.tan, np.tan, F / 4),
+    ("arcsin", ht.arcsin, np.arcsin, F / 12),
+    ("arccos", ht.arccos, np.arccos, F / 12),
+    ("arctan", ht.arctan, np.arctan, F),
+    ("sinh", ht.sinh, np.sinh, F / 4),
+    ("cosh", ht.cosh, np.cosh, F / 4),
+    ("tanh", ht.tanh, np.tanh, F),
+    ("arcsinh", ht.arcsinh, np.arcsinh, F),
+    ("arccosh", ht.arccosh, np.arccosh, P + 1.0),
+    ("arctanh", ht.arctanh, np.arctanh, F / 12),
+    ("deg2rad", ht.deg2rad, np.deg2rad, F * 30),
+    ("rad2deg", ht.rad2deg, np.rad2deg, F),
+    ("sinc", ht.sinc, np.sinc, F),
+    ("neg", ht.neg, np.negative, F),
+    ("reciprocal-ish fabs", ht.fabs, np.fabs, F),
+    ("isnan", ht.isnan, np.isnan, F),
+    ("isinf", ht.isinf, np.isinf, F),
+    ("isfinite", ht.isfinite, np.isfinite, F),
+    ("logical_not", ht.logical_not, np.logical_not, B),
+    ("invert", ht.invert, np.invert, I),
+    ("signbit", ht.signbit, np.signbit, F),
+]
+
+BINARY = [
+    ("add", ht.add, np.add, F, P),
+    ("sub", ht.sub, np.subtract, F, P),
+    ("mul", ht.mul, np.multiply, F, P),
+    ("div", ht.div, np.divide, F, P),
+    ("floordiv", ht.floordiv, np.floor_divide, F, P),
+    ("mod", ht.mod, np.mod, F, P),
+    ("fmod", ht.fmod, np.fmod, F, P),
+    ("pow", ht.pow, np.power, P, F),
+    ("maximum", ht.maximum, np.maximum, F, -F),
+    ("minimum", ht.minimum, np.minimum, F, -F),
+    ("arctan2", ht.arctan2, np.arctan2, F, P),
+    ("hypot", ht.hypot, np.hypot, F, P),
+    ("copysign", ht.copysign, np.copysign, P, F),
+    ("logaddexp", ht.logaddexp, np.logaddexp, F, -F),
+    ("logaddexp2", ht.logaddexp2, np.logaddexp2, F, -F),
+    ("gcd", ht.gcd, np.gcd, I, I + 1),
+    ("lcm", ht.lcm, np.lcm, I % 4 + 1, I % 3 + 1),
+    ("bitwise_and", ht.bitwise_and, np.bitwise_and, I, I + 3),
+    ("bitwise_or", ht.bitwise_or, np.bitwise_or, I, I + 3),
+    ("bitwise_xor", ht.bitwise_xor, np.bitwise_xor, I, I + 3),
+    ("left_shift", ht.left_shift, np.left_shift, I, I % 3),
+    ("right_shift", ht.right_shift, np.right_shift, I, I % 3),
+    ("eq", ht.eq, np.equal, I, I.T.reshape(4, 6)),
+    ("ne", ht.ne, np.not_equal, I, I.T.reshape(4, 6)),
+    ("lt", ht.lt, np.less, F, -F),
+    ("le", ht.le, np.less_equal, F, -F),
+    ("gt", ht.gt, np.greater, F, -F),
+    ("ge", ht.ge, np.greater_equal, F, -F),
+    ("logical_and", ht.logical_and, np.logical_and, B, ~B),
+    ("logical_or", ht.logical_or, np.logical_or, B, ~B),
+    ("logical_xor", ht.logical_xor, np.logical_xor, B, ~B),
+]
+
+REDUCTIONS = [
+    ("sum", ht.sum, np.sum, F),
+    ("prod", ht.prod, np.prod, (P / 2)),
+    ("mean", ht.mean, np.mean, F),
+    ("var", ht.var, np.var, F),
+    ("std", ht.std, np.std, F),
+    ("min", ht.min, np.min, F),
+    ("max", ht.max, np.max, F),
+    ("argmin", ht.argmin, np.argmin, F),
+    ("argmax", ht.argmax, np.argmax, F),
+    ("all", ht.all, np.all, B),
+    ("any", ht.any, np.any, B),
+    ("count_nonzero", ht.count_nonzero, np.count_nonzero, I),
+    ("nansum", ht.nansum, np.nansum, F),
+    ("nanmean", ht.nanmean, np.nanmean, F),
+    ("nanmax", ht.nanmax, np.nanmax, F),
+    ("nanmin", ht.nanmin, np.nanmin, F),
+    ("median", ht.median, np.median, F),
+    ("cumsum", lambda a, axis=None: ht.cumsum(a, axis if axis is not None else 0),
+     lambda a, axis=None: np.cumsum(a, axis if axis is not None else 0), F),
+    ("cumprod", lambda a, axis=None: ht.cumprod(a, axis if axis is not None else 0),
+     lambda a, axis=None: np.cumprod(a, axis if axis is not None else 0), (P / 2)),
+]
+
+MANIP = [
+    ("flip0", lambda a: ht.flip(a, 0), lambda a: np.flip(a, 0)),
+    ("fliplr", ht.fliplr, np.fliplr),
+    ("flipud", ht.flipud, np.flipud),
+    ("roll", lambda a: ht.roll(a, 2), lambda a: np.roll(a, 2)),
+    ("rot90", ht.rot90, np.rot90),
+    ("transpose", ht.transpose, np.transpose),
+    ("ravel", ht.ravel, np.ravel),
+    ("squeeze", lambda a: ht.squeeze(ht.expand_dims(a, 0)), lambda a: a),
+    ("swapaxes", lambda a: ht.swapaxes(a, 0, 1), lambda a: np.swapaxes(a, 0, 1)),
+    ("moveaxis", lambda a: ht.moveaxis(a, 0, 1), lambda a: np.moveaxis(a, 0, 1)),
+    ("tile", lambda a: ht.tile(a, (2, 1)), lambda a: np.tile(a, (2, 1))),
+    ("repeat", lambda a: ht.repeat(a, 2), lambda a: np.repeat(a, 2)),
+    ("pad", lambda a: ht.pad(a, ((1, 1), (0, 2))), lambda a: np.pad(a, ((1, 1), (0, 2)))),
+    ("diff", lambda a: ht.diff(a, axis=0), lambda a: np.diff(a, axis=0)),
+    ("sort", lambda a: ht.sort(a, axis=0)[0], lambda a: np.sort(a, axis=0)),
+    ("flatten", ht.flatten, np.ravel),
+    ("broadcast_to", lambda a: ht.broadcast_to(a, (2, 4, 6)), lambda a: np.broadcast_to(a, (2, 4, 6))),
+]
+
+
+def _run(ht_out, np_out, msg):
+    got = ht_out.numpy() if hasattr(ht_out, "numpy") else np.asarray(ht_out)
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float64),
+        np.asarray(np_out, dtype=np.float64),
+        rtol=2e-5,
+        atol=2e-5,
+        err_msg=msg,
+    )
+
+
+class TestUnaryParity(TestCase):
+    @pytest.mark.parametrize("name,hfn,nfn,data", UNARY, ids=[u[0] for u in UNARY])
+    def test_unary(self, name, hfn, nfn, data):
+        for split in SPLITS:
+            x = ht.array(data, split=split)
+            _run(hfn(x), nfn(data), f"{name} split={split}")
+
+
+class TestBinaryParity(TestCase):
+    @pytest.mark.parametrize("name,hfn,nfn,a,b", BINARY, ids=[b[0] for b in BINARY])
+    def test_binary(self, name, hfn, nfn, a, b):
+        for split in SPLITS:
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            _run(hfn(x, y), nfn(a, b), f"{name} split={split}")
+        # scalar second operand
+        _run(hfn(ht.array(a, split=0), 2), nfn(a, np.asarray(2, a.dtype)), f"{name} scalar")
+
+
+class TestReductionParity(TestCase):
+    @pytest.mark.parametrize("name,hfn,nfn,data", REDUCTIONS, ids=[r[0] for r in REDUCTIONS])
+    def test_reduction(self, name, hfn, nfn, data):
+        for split in SPLITS:
+            x = ht.array(data, split=split)
+            _run(hfn(x), nfn(data), f"{name} full split={split}")
+            for axis in (0, 1):
+                try:
+                    want = nfn(data, axis=axis)
+                except TypeError:
+                    continue
+                _run(hfn(x, axis=axis), want, f"{name} axis={axis} split={split}")
+
+
+class TestManipParity(TestCase):
+    @pytest.mark.parametrize("name,hfn,nfn", MANIP, ids=[m[0] for m in MANIP])
+    def test_manip(self, name, hfn, nfn):
+        for split in SPLITS:
+            x = ht.array(F, split=split)
+            _run(hfn(x), nfn(F), f"{name} split={split}")
