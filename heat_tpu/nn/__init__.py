@@ -4,3 +4,4 @@ from .modules import *
 from . import modules
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from . import functional
+from . import models

@@ -127,18 +127,12 @@ class DataParallel:
         apply = self.module.apply
         opt = self.optimizer
 
-        # decide the calling convention ONCE from the signature — catching
-        # TypeError around the call would swallow genuine train-path errors
-        # and silently fall back to eval mode
-        import inspect
+        # decide the calling convention ONCE — heat modules get train/key;
+        # anything else (e.g. flax, whose apply has **kwargs it would forward
+        # to __call__ and crash on an unexpected 'train') is called plain
+        from .modules import Module as _HeatModule
 
-        try:
-            sig = inspect.signature(apply)
-            accepts_train = "train" in sig.parameters or any(
-                p.kind is inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
-            )
-        except (TypeError, ValueError):
-            accepts_train = False
+        accepts_train = isinstance(self.module, _HeatModule)
 
         if accepts_train:
 

@@ -1,0 +1,78 @@
+"""Reference-workload model builders.
+
+The reference framework ships no model zoo; its DASO baseline trains
+torchvision's ResNet-50 on ImageNet (reference: ``heat/optim/dp_optimizer.py``
+docstrings, SURVEY §2.5/§6).  These builders provide the equivalent
+residual-CNN family natively so the DASO/DataParallel baselines are
+reproducible without torchvision.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from . import modules as nn
+
+__all__ = ["resnet", "resnet18", "resnet50_ish", "mlp"]
+
+
+def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
+    body = nn.Sequential(
+        nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False),
+        nn.BatchNorm2d(cout),
+        nn.ReLU(),
+        nn.Conv2d(cout, cout, 3, stride=1, padding=1, bias=False),
+        nn.BatchNorm2d(cout),
+    )
+    if stride != 1 or cin != cout:
+        shortcut = nn.Sequential(
+            nn.Conv2d(cin, cout, 1, stride=stride, bias=False), nn.BatchNorm2d(cout)
+        )
+    else:
+        shortcut = None
+    return nn.Sequential(nn.Residual(body, shortcut), nn.ReLU())
+
+
+def resnet(
+    stage_sizes: Sequence[int] = (2, 2, 2, 2),
+    width: int = 64,
+    num_classes: int = 10,
+    in_channels: int = 3,
+    stem_pool: bool = False,
+) -> nn.Module:
+    """A ResNet-v1 with BasicBlocks (stage_sizes=(2,2,2,2) ≈ ResNet-18)."""
+    layers = [
+        nn.Conv2d(in_channels, width, 3, stride=1, padding=1, bias=False),
+        nn.BatchNorm2d(width),
+        nn.ReLU(),
+    ]
+    if stem_pool:
+        layers.append(nn.MaxPool2d(2))
+    cin = width
+    for stage, n_blocks in enumerate(stage_sizes):
+        cout = width * (2**stage)
+        for b in range(n_blocks):
+            layers.append(_basic_block(cin, cout, stride=2 if (b == 0 and stage > 0) else 1))
+            cin = cout
+    layers += [nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(cin, num_classes)]
+    return nn.Sequential(*layers)
+
+
+def resnet18(num_classes: int = 10, in_channels: int = 3) -> nn.Module:
+    return resnet((2, 2, 2, 2), 64, num_classes, in_channels)
+
+
+def resnet50_ish(num_classes: int = 1000, in_channels: int = 3) -> nn.Module:
+    """Depth-matched stand-in for the DASO baseline's ResNet-50 (BasicBlocks,
+    (3,4,6,3) stages — same stage layout; bottlenecks omitted)."""
+    return resnet((3, 4, 6, 3), 64, num_classes, in_channels, stem_pool=True)
+
+
+def mlp(sizes: Sequence[int] = (784, 256, 128, 10)) -> nn.Module:
+    """The DataParallel baseline's 3-layer MLP (BASELINE config[3])."""
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        layers.append(nn.Linear(a, b))
+        if i < len(sizes) - 2:
+            layers.append(nn.ReLU())
+    return nn.Sequential(*layers)

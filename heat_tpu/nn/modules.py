@@ -18,6 +18,7 @@ import jax.numpy as jnp
 __all__ = [
     "Module",
     "Linear",
+    "Identity",
     "ReLU",
     "Tanh",
     "Sigmoid",
@@ -29,6 +30,14 @@ __all__ = [
     "Sequential",
     "Conv2d",
     "MaxPool2d",
+    "AvgPool2d",
+    "AdaptiveAvgPool2d",
+    "BatchNorm1d",
+    "BatchNorm2d",
+    "LayerNorm",
+    "GroupNorm",
+    "Embedding",
+    "Residual",
 ]
 
 
@@ -176,6 +185,194 @@ class MaxPool2d(Module):
         )
 
 
+class AvgPool2d(Module):
+    def __init__(self, kernel_size: int, stride: Optional[int] = None):
+        self.kernel_size = kernel_size if isinstance(kernel_size, tuple) else (kernel_size, kernel_size)
+        s = stride if stride is not None else kernel_size
+        self.stride = s if isinstance(s, tuple) else (s, s)
+
+    def apply(self, params, x, **kw):
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1, 1) + self.kernel_size,
+            window_strides=(1, 1) + self.stride,
+            padding="VALID",
+        )
+        return summed / (self.kernel_size[0] * self.kernel_size[1])
+
+
+class AdaptiveAvgPool2d(Module):
+    """Average-pool NCHW input to a fixed (H, W) output (torch semantics for
+    the common case where the input size is a multiple of the output size)."""
+
+    def __init__(self, output_size=1):
+        self.output_size = (
+            output_size if isinstance(output_size, tuple) else (output_size, output_size)
+        )
+
+    def apply(self, params, x, **kw):
+        oh, ow = self.output_size
+        n, c, h, w = x.shape
+        if h % oh or w % ow:
+            raise ValueError(
+                f"AdaptiveAvgPool2d: input {h}x{w} not divisible by output {oh}x{ow}"
+            )
+        return x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+
+
+class Identity(Module):
+    def apply(self, params, x, **kw):
+        return x
+
+
+class _BatchNorm(Module):
+    """Batch normalization with torch parameter names.
+
+    Functional-JAX contract: training normalizes with batch statistics;
+    evaluation uses the stored running stats.  Because ``apply`` is pure, the
+    running-stat EMA is exposed as :meth:`update_stats` (returns new params)
+    for callers that track it; train steps that never call it still match the
+    reference's training-mode math exactly.
+    """
+
+    axes: Tuple[int, ...] = ()
+
+    def __init__(self, num_features: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True):
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+
+    def init(self, key):
+        c = self.num_features
+        p = {"running_mean": jnp.zeros(c), "running_var": jnp.ones(c)}
+        if self.affine:
+            p["weight"] = jnp.ones(c)
+            p["bias"] = jnp.zeros(c)
+        return p
+
+    def _bcast(self, v, ndim):
+        shape = [1] * ndim
+        shape[1] = self.num_features
+        return v.reshape(shape)
+
+    def apply(self, params, x, *, train: bool = False, **kw):
+        if train:
+            mean = jnp.mean(x, axis=self.axes)
+            var = jnp.var(x, axis=self.axes)
+        else:
+            mean, var = params["running_mean"], params["running_var"]
+        y = (x - self._bcast(mean, x.ndim)) / jnp.sqrt(self._bcast(var, x.ndim) + self.eps)
+        if self.affine:
+            y = y * self._bcast(params["weight"], x.ndim) + self._bcast(params["bias"], x.ndim)
+        return y
+
+    def update_stats(self, params, x):
+        """EMA update of running stats from a batch (returns new params)."""
+        m = self.momentum
+        mean = jnp.mean(x, axis=self.axes)
+        var = jnp.var(x, axis=self.axes)
+        new = dict(params)
+        new["running_mean"] = (1 - m) * params["running_mean"] + m * mean
+        new["running_var"] = (1 - m) * params["running_var"] + m * var
+        return new
+
+
+class BatchNorm1d(_BatchNorm):
+    axes = (0,)
+
+
+class BatchNorm2d(_BatchNorm):
+    axes = (0, 2, 3)
+
+
+class LayerNorm(Module):
+    """Layer normalization over the trailing ``normalized_shape`` dims."""
+
+    def __init__(self, normalized_shape, eps: float = 1e-5, elementwise_affine: bool = True):
+        self.normalized_shape = (
+            (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+        )
+        self.eps = eps
+        self.affine = elementwise_affine
+
+    def init(self, key):
+        if self.affine:
+            return {"weight": jnp.ones(self.normalized_shape), "bias": jnp.zeros(self.normalized_shape)}
+        return {}
+
+    def apply(self, params, x, **kw):
+        axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.eps)
+        if self.affine:
+            y = y * params["weight"] + params["bias"]
+        return y
+
+
+class GroupNorm(Module):
+    def __init__(self, num_groups: int, num_channels: int, eps: float = 1e-5, affine: bool = True):
+        if num_channels % num_groups:
+            raise ValueError("num_channels must be divisible by num_groups")
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.eps = eps
+        self.affine = affine
+
+    def init(self, key):
+        if self.affine:
+            return {"weight": jnp.ones(self.num_channels), "bias": jnp.zeros(self.num_channels)}
+        return {}
+
+    def apply(self, params, x, **kw):
+        n, c = x.shape[:2]
+        g = self.num_groups
+        xg = x.reshape((n, g, c // g) + x.shape[2:])
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) / jnp.sqrt(var + self.eps)).reshape(x.shape)
+        if self.affine:
+            shape = [1] * x.ndim
+            shape[1] = c
+            y = y * params["weight"].reshape(shape) + params["bias"].reshape(shape)
+        return y
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def init(self, key):
+        return {"weight": jax.random.normal(key, (self.num_embeddings, self.embedding_dim))}
+
+    def apply(self, params, x, **kw):
+        return params["weight"][x]
+
+
+class Residual(Module):
+    """y = body(x) + shortcut(x) — the ResNet block skeleton."""
+
+    def __init__(self, body: Module, shortcut: Optional[Module] = None):
+        self.body = body
+        self.shortcut = shortcut if shortcut is not None else Identity()
+
+    def init(self, key):
+        bk, sk = jax.random.split(key)
+        return {"body": self.body.init(bk), "shortcut": self.shortcut.init(sk)}
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        bk = sk = None
+        if key is not None:
+            bk, sk = jax.random.split(key)
+        return self.body.apply(params["body"], x, train=train, key=bk) + self.shortcut.apply(
+            params["shortcut"], x, train=train, key=sk
+        )
+
+
 class Sequential(Module):
     """Chain of modules; params is a list of per-layer pytrees."""
 
@@ -188,12 +385,12 @@ class Sequential(Module):
 
     def apply(self, params, x, *, train: bool = False, key=None):
         for i, (l, p) in enumerate(zip(self.layers, params)):
-            if isinstance(l, Dropout) and train and l.p > 0.0:
-                if key is None:
-                    raise ValueError(
-                        "Sequential contains Dropout: apply(train=True) requires a "
-                        "PRNG key (use make_train_step(..., with_rng=True))"
-                    )
+            if isinstance(l, Dropout) and train and l.p > 0.0 and key is None:
+                raise ValueError(
+                    "Sequential contains Dropout: apply(train=True) requires a "
+                    "PRNG key (use make_train_step(..., with_rng=True))"
+                )
+            if key is not None:
                 key, sub = jax.random.split(key)
                 x = l.apply(p, x, train=train, key=sub)
             else:

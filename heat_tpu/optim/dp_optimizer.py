@@ -187,14 +187,28 @@ class DASO:
         return self._params
 
     def _build_steps(self, loss_fn):
+        from ..nn.modules import Module as _HeatModule
+
         apply = self.module.apply
         opt = self.local_optimizer.optax_optimizer
         mesh = self.mesh
 
-        def group_step(params, opt_state, x, y):
+        # training-mode forward for heat modules (BatchNorm batch statistics,
+        # keyed Dropout); anything else — e.g. flax modules, whose apply
+        # accepts **kwargs it would forward to __call__ — is called plain
+        accepts_train = isinstance(self.module, _HeatModule)
+
+        def fwd(p, x, key):
+            if not accepts_train:
+                return apply(p, x)
+            if key is not None:
+                return apply(p, x, train=True, key=key)
+            return apply(p, x, train=True)
+
+        def group_step(params, opt_state, x, y, key):
             # params: one group's replica (no leading axis inside shard_map/vmap)
             def loss(p):
-                return loss_fn(apply(p, x), y)
+                return loss_fn(fwd(p, x, key), y)
 
             lval, grads = jax.value_and_grad(loss)(params)
             # the reference's per-step NCCL allreduce == psum over 'ici';
@@ -207,7 +221,13 @@ class DASO:
         @jax.jit
         def train_step(params, opt_state, xs, ys):
             # vmap over the dcn groups: each group trains on its own batch slice
-            return jax.vmap(group_step)(params, opt_state, xs, ys)
+            return jax.vmap(lambda p, s, x, y: group_step(p, s, x, y, None))(
+                params, opt_state, xs, ys
+            )
+
+        @jax.jit
+        def train_step_rng(params, opt_state, xs, ys, keys):
+            return jax.vmap(group_step)(params, opt_state, xs, ys, keys)
 
         @jax.jit
         def global_average(params):
@@ -220,16 +240,18 @@ class DASO:
             )
 
         self._train_step = train_step
+        self._train_step_rng = train_step_rng
         self._global_average = global_average
         self._blend = blend
 
-    def step(self, loss_fn, x, y):
+    def step(self, loss_fn, x, y, key=None):
         """One DASO step on a global batch (leading axis divisible by n_groups).
 
         Every step: per-group sync training (the 'ici' tier).  Every
         ``global_skip`` steps: dispatch the cross-group parameter average (the
         'dcn' tier); consume it ``stale_steps`` later with the staleness blend.
-        During warmup, sync fully every step.
+        During warmup, sync fully every step.  Pass ``key`` when the model
+        contains stochastic layers (Dropout): each group receives a split.
         """
         if self._train_step is None:
             self._build_steps(loss_fn)
@@ -239,7 +261,13 @@ class DASO:
         xs = jx.reshape((g, jx.shape[0] // g) + jx.shape[1:])
         ys = jy.reshape((g, jy.shape[0] // g) + jy.shape[1:])
 
-        self._params, self._opt_state, losses = self._train_step(self._params, self._opt_state, xs, ys)
+        if key is not None:
+            keys = jax.random.split(key, g)
+            self._params, self._opt_state, losses = self._train_step_rng(
+                self._params, self._opt_state, xs, ys, keys
+            )
+        else:
+            self._params, self._opt_state, losses = self._train_step(self._params, self._opt_state, xs, ys)
         self._step_count += 1
         t = self._step_count
 

@@ -40,6 +40,76 @@ class TestModules:
         assert 0 < np.count_nonzero(np.asarray(out_train)) < 100
 
 
+class TestNormPoolModules:
+    def test_batchnorm2d_train_matches_batch_stats(self):
+        import jax
+        import jax.numpy as jnp
+
+        bn = ht.nn.BatchNorm2d(3)
+        p = bn.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (4, 3, 5, 5)) * 2 + 1
+        y = bn.apply(p, x, train=True)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=(0, 2, 3))), 0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.var(y, axis=(0, 2, 3))), 1, atol=1e-3)
+        # eval mode uses (initial) running stats: identity normalization
+        y_eval = bn.apply(p, x, train=False)
+        np.testing.assert_allclose(np.asarray(y_eval), np.asarray(x), atol=1e-4)
+        # EMA update moves the stats toward the batch
+        p2 = bn.update_stats(p, x)
+        assert float(jnp.abs(p2["running_mean"]).sum()) > 0
+
+    def test_layernorm_groupnorm(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.random.normal(jax.random.key(0), (4, 8, 3))
+        ln = ht.nn.LayerNorm(3)
+        y = ln.apply(ln.init(jax.random.key(1)), x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=-1)), 0, atol=1e-5)
+        gn = ht.nn.GroupNorm(2, 8)
+        xg = jax.random.normal(jax.random.key(2), (4, 8, 5, 5))
+        yg = gn.apply(gn.init(jax.random.key(3)), xg)
+        assert yg.shape == xg.shape
+        with pytest.raises(ValueError):
+            ht.nn.GroupNorm(3, 8)
+
+    def test_pools(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        avg = ht.nn.AvgPool2d(2).apply((), x)
+        np.testing.assert_allclose(np.asarray(avg)[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        ada = ht.nn.AdaptiveAvgPool2d(1).apply((), x)
+        np.testing.assert_allclose(np.asarray(ada)[0, 0], [[7.5]])
+
+    def test_embedding_residual_identity(self):
+        import jax
+        import jax.numpy as jnp
+
+        emb = ht.nn.Embedding(10, 4)
+        p = emb.init(jax.random.key(0))
+        out = emb.apply(p, jnp.array([1, 5, 1]))
+        assert out.shape == (3, 4)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out[2]))
+
+        res = ht.nn.Residual(ht.nn.Identity())
+        rp = res.init(jax.random.key(1))
+        x = jnp.ones((2, 3))
+        np.testing.assert_allclose(np.asarray(res.apply(rp, x)), 2 * np.ones((2, 3)))
+
+    def test_resnet_builder_shapes(self):
+        import jax
+
+        model = ht.nn.models.resnet(stage_sizes=(1, 1), width=8, num_classes=5, in_channels=3)
+        p = model.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2, 3, 8, 8))
+        out = model.apply(p, x, train=True)
+        assert out.shape == (2, 5)
+        mlp = ht.nn.models.mlp((12, 8, 4))
+        assert mlp.apply(mlp.init(jax.random.key(2)), jax.random.normal(jax.random.key(3), (7, 12))).shape == (7, 4)
+
+
 class TestDataParallel(TestModules):
     def _setup(self):
         import jax
