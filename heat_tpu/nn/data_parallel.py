@@ -127,12 +127,9 @@ class DataParallel:
         apply = self.module.apply
         opt = self.optimizer
 
-        # decide the calling convention ONCE — heat modules get train/key;
-        # anything else (e.g. flax, whose apply has **kwargs it would forward
-        # to __call__ and crash on an unexpected 'train') is called plain
-        from .modules import Module as _HeatModule
+        from .modules import _module_accepts_train
 
-        accepts_train = isinstance(self.module, _HeatModule)
+        accepts_train = _module_accepts_train(self.module)
 
         if accepts_train:
 

@@ -13,7 +13,7 @@ from typing import Sequence
 
 from . import modules as nn
 
-__all__ = ["resnet", "resnet18", "resnet50_ish", "mlp"]
+__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp"]
 
 
 def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
@@ -22,6 +22,28 @@ def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
         nn.BatchNorm2d(cout),
         nn.ReLU(),
         nn.Conv2d(cout, cout, 3, stride=1, padding=1, bias=False),
+        nn.BatchNorm2d(cout),
+    )
+    if stride != 1 or cin != cout:
+        shortcut = nn.Sequential(
+            nn.Conv2d(cin, cout, 1, stride=stride, bias=False), nn.BatchNorm2d(cout)
+        )
+    else:
+        shortcut = None
+    return nn.Sequential(nn.Residual(body, shortcut), nn.ReLU())
+
+
+def _bottleneck_block(cin: int, cmid: int, stride: int = 1, expansion: int = 4) -> nn.Module:
+    """ResNet-v1 bottleneck: 1x1 reduce -> 3x3 -> 1x1 expand (x4)."""
+    cout = cmid * expansion
+    body = nn.Sequential(
+        nn.Conv2d(cin, cmid, 1, bias=False),
+        nn.BatchNorm2d(cmid),
+        nn.ReLU(),
+        nn.Conv2d(cmid, cmid, 3, stride=stride, padding=1, bias=False),
+        nn.BatchNorm2d(cmid),
+        nn.ReLU(),
+        nn.Conv2d(cmid, cout, 1, bias=False),
         nn.BatchNorm2d(cout),
     )
     if stride != 1 or cin != cout:
@@ -62,10 +84,33 @@ def resnet18(num_classes: int = 10, in_channels: int = 3) -> nn.Module:
     return resnet((2, 2, 2, 2), 64, num_classes, in_channels)
 
 
-def resnet50_ish(num_classes: int = 1000, in_channels: int = 3) -> nn.Module:
-    """Depth-matched stand-in for the DASO baseline's ResNet-50 (BasicBlocks,
-    (3,4,6,3) stages — same stage layout; bottlenecks omitted)."""
+def resnet34(num_classes: int = 1000, in_channels: int = 3) -> nn.Module:
     return resnet((3, 4, 6, 3), 64, num_classes, in_channels, stem_pool=True)
+
+
+def resnet50(num_classes: int = 1000, in_channels: int = 3, width: int = 64) -> nn.Module:
+    """ResNet-50 (bottleneck blocks, (3,4,6,3) stages) — the DASO baseline's
+    model (reference trains torchvision resnet50 on ImageNet)."""
+    layers = [
+        nn.Conv2d(in_channels, width, 7, stride=2, padding=3, bias=False),
+        nn.BatchNorm2d(width),
+        nn.ReLU(),
+        nn.MaxPool2d(3, stride=2),
+    ]
+    cin = width
+    for stage, n_blocks in enumerate((3, 4, 6, 3)):
+        cmid = width * (2**stage)
+        for b in range(n_blocks):
+            layers.append(
+                _bottleneck_block(cin, cmid, stride=2 if (b == 0 and stage > 0) else 1)
+            )
+            cin = cmid * 4
+    layers += [nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(cin, num_classes)]
+    return nn.Sequential(*layers)
+
+
+# kept for backward compatibility; the honest name is resnet34 (BasicBlocks)
+resnet50_ish = resnet34
 
 
 def mlp(sizes: Sequence[int] = (784, 256, 128, 10)) -> nn.Module:

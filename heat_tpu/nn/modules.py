@@ -41,6 +41,29 @@ __all__ = [
 ]
 
 
+def _pair(v) -> Tuple[int, int]:
+    """torch-style int-or-tuple normalization for 2-D spatial args."""
+    return v if isinstance(v, tuple) else (v, v)
+
+
+def _module_accepts_train(module) -> bool:
+    """Whether ``module.apply`` should be called with ``train=``/``key=``.
+
+    heat modules always do.  Duck-typed modules qualify only via an EXPLICIT
+    ``train`` parameter in their apply signature — a bare ``**kwargs`` does
+    not (flax's apply has ``**kwargs`` it would forward to ``__call__``,
+    crashing models whose ``__call__`` lacks ``train``)."""
+    import inspect
+
+    if isinstance(module, Module):
+        return True
+    try:
+        sig = inspect.signature(module.apply)
+        return "train" in sig.parameters
+    except (TypeError, ValueError, AttributeError):
+        return False
+
+
 class Module:
     """Base: stateless apply + parameter init."""
 
@@ -143,9 +166,9 @@ class Conv2d(Module):
                  stride: int = 1, padding: int = 0, bias: bool = True):
         self.in_channels = in_channels
         self.out_channels = out_channels
-        self.kernel_size = kernel_size if isinstance(kernel_size, tuple) else (kernel_size, kernel_size)
-        self.stride = stride if isinstance(stride, tuple) else (stride, stride)
-        self.padding = padding if isinstance(padding, tuple) else (padding, padding)
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
         self.bias = bias
 
     def init(self, key):
@@ -170,12 +193,13 @@ class Conv2d(Module):
         return y
 
 
-class MaxPool2d(Module):
+class _Pool2d(Module):
     def __init__(self, kernel_size: int, stride: Optional[int] = None):
-        self.kernel_size = kernel_size if isinstance(kernel_size, tuple) else (kernel_size, kernel_size)
-        s = stride if stride is not None else kernel_size
-        self.stride = s if isinstance(s, tuple) else (s, s)
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride if stride is not None else kernel_size)
 
+
+class MaxPool2d(_Pool2d):
     def apply(self, params, x, **kw):
         return jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max,
@@ -185,12 +209,7 @@ class MaxPool2d(Module):
         )
 
 
-class AvgPool2d(Module):
-    def __init__(self, kernel_size: int, stride: Optional[int] = None):
-        self.kernel_size = kernel_size if isinstance(kernel_size, tuple) else (kernel_size, kernel_size)
-        s = stride if stride is not None else kernel_size
-        self.stride = s if isinstance(s, tuple) else (s, s)
-
+class AvgPool2d(_Pool2d):
     def apply(self, params, x, **kw):
         summed = jax.lax.reduce_window(
             x, 0.0, jax.lax.add,
@@ -233,9 +252,11 @@ class _BatchNorm(Module):
     running-stat EMA is exposed as :meth:`update_stats` (returns new params)
     for callers that track it; train steps that never call it still match the
     reference's training-mode math exactly.
-    """
 
-    axes: Tuple[int, ...] = ()
+    ``running_mean``/``running_var`` are buffers, not parameters: the
+    framework's optimizers mask every ``running_*`` leaf from updates and
+    weight decay (see ``optim.dp_optimizer._nontrainable_mask``).
+    """
 
     def __init__(self, num_features: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True):
@@ -243,6 +264,10 @@ class _BatchNorm(Module):
         self.eps = eps
         self.momentum = momentum
         self.affine = affine
+
+    def _axes(self, ndim: int) -> Tuple[int, ...]:
+        # all dims except channel (dim 1): (N,C)->(0,), (N,C,L)->(0,2), (N,C,H,W)->(0,2,3)
+        return (0,) + tuple(range(2, ndim))
 
     def init(self, key):
         c = self.num_features
@@ -259,8 +284,8 @@ class _BatchNorm(Module):
 
     def apply(self, params, x, *, train: bool = False, **kw):
         if train:
-            mean = jnp.mean(x, axis=self.axes)
-            var = jnp.var(x, axis=self.axes)
+            mean = jnp.mean(x, axis=self._axes(x.ndim))
+            var = jnp.var(x, axis=self._axes(x.ndim))
         else:
             mean, var = params["running_mean"], params["running_var"]
         y = (x - self._bcast(mean, x.ndim)) / jnp.sqrt(self._bcast(var, x.ndim) + self.eps)
@@ -269,10 +294,15 @@ class _BatchNorm(Module):
         return y
 
     def update_stats(self, params, x):
-        """EMA update of running stats from a batch (returns new params)."""
+        """EMA update of running stats from a batch (returns new params).
+
+        Uses the unbiased (ddof=1) variance, matching torch's running-stat
+        convention (train-mode normalization stays biased, also like torch).
+        """
         m = self.momentum
-        mean = jnp.mean(x, axis=self.axes)
-        var = jnp.var(x, axis=self.axes)
+        axes = self._axes(x.ndim)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes, ddof=1)
         new = dict(params)
         new["running_mean"] = (1 - m) * params["running_mean"] + m * mean
         new["running_var"] = (1 - m) * params["running_var"] + m * var
@@ -280,11 +310,21 @@ class _BatchNorm(Module):
 
 
 class BatchNorm1d(_BatchNorm):
-    axes = (0,)
+    """BatchNorm over (N, C) or (N, C, L) input."""
+
+    def _axes(self, ndim: int) -> Tuple[int, ...]:
+        if ndim not in (2, 3):
+            raise ValueError(f"BatchNorm1d expects 2-D or 3-D input, got {ndim}-D")
+        return super()._axes(ndim)
 
 
 class BatchNorm2d(_BatchNorm):
-    axes = (0, 2, 3)
+    """BatchNorm over (N, C, H, W) input."""
+
+    def _axes(self, ndim: int) -> Tuple[int, ...]:
+        if ndim != 4:
+            raise ValueError(f"BatchNorm2d expects 4-D input, got {ndim}-D")
+        return super()._axes(ndim)
 
 
 class LayerNorm(Module):

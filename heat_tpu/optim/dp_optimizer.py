@@ -29,6 +29,27 @@ from ..core.communication import Communication
 __all__ = ["DataParallelOptimizer", "DASO", "SGD", "Adam", "AdamW"]
 
 
+def _nontrainable_mask(params):
+    """True for trainable leaves, False for buffers (``running_*`` stats of
+    BatchNorm live in the params pytree but must receive no updates and no
+    weight decay)."""
+    import jax
+
+    def is_trainable(path):
+        return not any(
+            getattr(k, "key", None) is not None and str(getattr(k, "key", "")).startswith("running_")
+            for k in path
+        )
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(treedef, [is_trainable(p) for p, _ in flat])
+
+
+def _mask_buffers(opt: "optax.GradientTransformation") -> "optax.GradientTransformation":
+    """Mask any ``running_*`` buffer leaves out of an optax transformation."""
+    return optax.masked(opt, _nontrainable_mask)
+
+
 def _named_optimizer(name: str, **kw):
     table = {
         "sgd": lambda lr=0.01, momentum=0.0, weight_decay=0.0, nesterov=False: optax.chain(
@@ -70,7 +91,8 @@ class DataParallelOptimizer:
     def __init__(self, optimizer, blocking: bool = False, **kwargs):
         if isinstance(optimizer, str):
             optimizer = _named_optimizer(optimizer, **kwargs)
-        self.optax_optimizer = optimizer
+        # buffers (BatchNorm running stats) get neither updates nor decay
+        self.optax_optimizer = _mask_buffers(optimizer)
         self.blocking = blocking
         self._dp = None
         self._opt_state = None
@@ -187,16 +209,16 @@ class DASO:
         return self._params
 
     def _build_steps(self, loss_fn):
-        from ..nn.modules import Module as _HeatModule
+        from ..nn.modules import _module_accepts_train
 
         apply = self.module.apply
         opt = self.local_optimizer.optax_optimizer
         mesh = self.mesh
 
-        # training-mode forward for heat modules (BatchNorm batch statistics,
-        # keyed Dropout); anything else — e.g. flax modules, whose apply
-        # accepts **kwargs it would forward to __call__ — is called plain
-        accepts_train = isinstance(self.module, _HeatModule)
+        # training-mode forward for heat modules and duck-typed modules with
+        # an explicit train parameter (BatchNorm batch statistics, keyed
+        # Dropout); flax-style **kwargs applies are called plain
+        accepts_train = _module_accepts_train(self.module)
 
         def fwd(p, x, key):
             if not accepts_train:

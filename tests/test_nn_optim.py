@@ -58,6 +58,33 @@ class TestNormPoolModules:
         p2 = bn.update_stats(p, x)
         assert float(jnp.abs(p2["running_mean"]).sum()) > 0
 
+    def test_batchnorm1d_3d_input(self):
+        import jax
+        import jax.numpy as jnp
+
+        bn = ht.nn.BatchNorm1d(4)
+        x = jax.random.normal(jax.random.key(1), (2, 4, 8))
+        y = bn.apply(bn.init(jax.random.key(0)), x, train=True)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=(0, 2))), 0, atol=1e-5)
+        with pytest.raises(ValueError):
+            bn.apply(bn.init(jax.random.key(0)), jnp.zeros((2, 4, 3, 3)), train=True)
+
+    def test_running_stats_masked_from_optimizer(self):
+        """BatchNorm buffers must receive no updates and no weight decay."""
+        import jax
+        import jax.numpy as jnp
+
+        m = ht.nn.Sequential(ht.nn.Linear(4, 4), ht.nn.BatchNorm1d(4))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1, weight_decay=0.1)
+        p = m.init(jax.random.key(0))
+        s = opt.init_state(p)
+        zero_g = jax.tree.map(jnp.zeros_like, p)
+        p2, _ = opt._update(p, zero_g, s)
+        np.testing.assert_allclose(np.asarray(p2[1]["running_var"]), 1.0)
+        np.testing.assert_allclose(np.asarray(p2[1]["running_mean"]), 0.0)
+        # weights DO decay
+        assert float(jnp.abs(p2[0]["weight"]).sum()) < float(jnp.abs(p[0]["weight"]).sum())
+
     def test_layernorm_groupnorm(self):
         import jax
         import jax.numpy as jnp
