@@ -105,14 +105,86 @@ def main() -> None:
     except Exception as e:
         extra["kmeans_error"] = str(e)[:80]
 
-    print(json.dumps({
+    return {
         "metric": "dist_matmul_16384_f32_tflops_per_chip",
         "value": round(tflops_big, 3),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(vs_baseline, 3),
         "extra": extra,
-    }))
+    }
+
+
+def _cpu_fallback_payload() -> dict:
+    """Small CPU-mesh measurement used only when the accelerator transport is
+    unreachable.  Reported with value 0.0 under the standard metric name so
+    degraded runs never masquerade as real 16384 datapoints; the host number
+    rides in extra."""
+    import subprocess
+    import sys
+
+    payload = {
+        "metric": "dist_matmul_16384_f32_tflops_per_chip",
+        "value": 0.0,
+        "unit": "TFLOPS/chip",
+        "vs_baseline": 0.0,
+        "extra": {"platform": "cpu-fallback",
+                  "note": "accelerator transport unreachable; 2048 GEMM on host mesh"},
+    }
+    script = (
+        "import jax, json, time\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        "import heat_tpu as ht\n"
+        "n=2048\n"
+        "a=ht.random.randn(n,n,split=0); b=ht.random.randn(n,n,split=1)\n"
+        "c=(a@b); float(c._jarray[0,0])\n"
+        "t0=time.perf_counter(); c=(a@b); float(c._jarray[0,0]); dt=time.perf_counter()-t0\n"
+        "print(json.dumps({'cpu_2048_tflops': round(2.0*n**3/dt/1e12, 3)}))\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True, timeout=600
+        )
+        line = next((l for l in out.stdout.splitlines() if l.startswith("{")), None)
+        if line:
+            payload["extra"].update(json.loads(line))
+        else:
+            payload["extra"]["error"] = (out.stderr or "no output")[-300:]
+    except Exception as e:  # TimeoutExpired and anything else: still one line
+        payload["extra"]["error"] = f"cpu fallback failed: {e}"[:300]
+    return payload
 
 
 if __name__ == "__main__":
-    main()
+    import os
+    import sys
+    import threading
+    import traceback
+
+    # the tunneled platform can wedge hard (device init or the first compile
+    # never returns); a watchdog guarantees the driver always gets exactly
+    # ONE JSON line on stdout.  The worker never prints — the main thread
+    # does, so a late-finishing worker cannot race a second line out.
+    state = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            state["payload"] = main()
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    try:
+        budget = float(os.environ.get("HEAT_BENCH_TIMEOUT_S", "1500"))
+    except ValueError:
+        budget = 1500.0
+    done.wait(budget)
+    payload = state.get("payload")
+    if payload is None:
+        payload = _cpu_fallback_payload()
+    print(json.dumps(payload))
+    sys.stdout.flush()
+    os._exit(0)

@@ -1,0 +1,83 @@
+"""Strong-scaling sweep over device counts (reference: ``benchmarks/cb`` run
+at several node counts on Jülich HPC; here the mesh width is the axis).
+
+Each workload runs at 1, 2, 4, ... devices of the host platform and prints
+one JSON line per (workload, n_devices) with wall-clock seconds, so scaling
+regressions are visible in CI exactly like the reference's perun dashboards.
+
+Run: python benchmarks/scaling.py [max_devices]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+WORKER = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else ".")
+import numpy as np
+import heat_tpu as ht
+
+n_dev = int(sys.argv[1])
+import numpy as _np
+from jax.sharding import Mesh
+mesh = Mesh(_np.asarray(jax.devices()[:n_dev]), ("x",))
+ht.use_mesh(mesh)
+
+def timed(fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        ht.utils.profiler.sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+results = {}
+X = ht.random.randn(2**17, 32, split=0)
+results["kmeans_131k_k16_5it"] = timed(
+    lambda: ht.cluster.KMeans(n_clusters=16, max_iter=5, tol=0.0, init="random", random_state=0).fit(X).inertia_
+)
+a = ht.random.randn(1024, 1024, split=0)
+b = ht.random.randn(1024, 1024, split=1)
+results["matmul_1024_s0xs1"] = timed(lambda: a @ b)
+m = ht.random.randn(1024, 1024, split=0)
+results["resplit_1024_0to1"] = timed(lambda: m.resplit(1))
+v = ht.random.randn(2**20, split=0)
+results["sort_1M"] = timed(lambda: ht.sort(v)[0])
+
+for k, v_ in results.items():
+    print(json.dumps({"benchmark": k, "n_devices": n_dev, "seconds": round(v_, 5)}))
+"""
+
+
+def main() -> None:
+    max_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    counts = [c for c in (1, 2, 4, 8, 16) if c <= max_dev]
+    here = os.path.dirname(os.path.abspath(__file__))
+    for n in counts:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        out = subprocess.run(
+            [sys.executable, "-c", WORKER, str(n)],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(here),
+            timeout=1200,
+        )
+        if out.returncode != 0:
+            print(json.dumps({"n_devices": n, "error": out.stderr.strip()[-400:]}))
+            continue
+        for line in out.stdout.strip().splitlines():
+            if line.startswith("{"):
+                print(line)
+
+
+if __name__ == "__main__":
+    main()
