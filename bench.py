@@ -46,7 +46,7 @@ def _gemm_seconds(ht, jax, n: int, dtype, iters: int) -> float:
     return (time.perf_counter() - t0) / iters
 
 
-def main() -> None:
+def main() -> dict:
     import jax
 
     import heat_tpu as ht
@@ -114,11 +114,13 @@ def main() -> None:
     }
 
 
-def _cpu_fallback_payload() -> dict:
-    """Small CPU-mesh measurement used only when the accelerator transport is
-    unreachable.  Reported with value 0.0 under the standard metric name so
-    degraded runs never masquerade as real 16384 datapoints; the host number
-    rides in extra."""
+def _cpu_fallback_payload(worker_error: str = "") -> dict:
+    """Small CPU-mesh measurement used when the accelerator bench could not
+    produce a result (transport wedged OR the worker raised).  Reported with
+    value 0.0 under the standard metric name so degraded runs never
+    masquerade as real 16384 datapoints; the host number and the worker's
+    failure reason ride in extra."""
+    import os
     import subprocess
     import sys
 
@@ -128,16 +130,21 @@ def _cpu_fallback_payload() -> dict:
         "unit": "TFLOPS/chip",
         "vs_baseline": 0.0,
         "extra": {"platform": "cpu-fallback",
-                  "note": "accelerator transport unreachable; 2048 GEMM on host mesh"},
+                  "note": ("accelerator worker raised" if worker_error
+                           else "accelerator transport unreachable (timeout)")
+                  + "; 2048 GEMM on host mesh"},
     }
+    if worker_error:
+        payload["extra"]["worker_error"] = worker_error[:300]
+    repo_root = os.path.dirname(os.path.abspath(__file__))
     script = (
-        "import jax, json, time\n"
+        "import sys, jax, json, time\n"
+        f"sys.path.insert(0, {repo_root!r})\n"
         "jax.config.update('jax_platforms','cpu')\n"
         "import heat_tpu as ht\n"
         "n=2048\n"
         "a=ht.random.randn(n,n,split=0); b=ht.random.randn(n,n,split=1)\n"
-        "c=(a@b); float(c._jarray[0,0])\n"
-        "t0=time.perf_counter(); c=(a@b); float(c._jarray[0,0]); dt=time.perf_counter()-t0\n"
+        "dt=ht.utils.profiler.timeit_min(lambda: a@b, reps=2)\n"
         "print(json.dumps({'cpu_2048_tflops': round(2.0*n**3/dt/1e12, 3)}))\n"
     )
     try:
@@ -170,7 +177,8 @@ if __name__ == "__main__":
     def _run():
         try:
             state["payload"] = main()
-        except Exception:
+        except Exception as e:
+            state["error"] = f"{type(e).__name__}: {e}"
             traceback.print_exc(file=sys.stderr)
         finally:
             done.set()
@@ -184,7 +192,7 @@ if __name__ == "__main__":
     done.wait(budget)
     payload = state.get("payload")
     if payload is None:
-        payload = _cpu_fallback_payload()
+        payload = _cpu_fallback_payload(state.get("error", ""))
     print(json.dumps(payload))
     sys.stdout.flush()
     os._exit(0)

@@ -11,20 +11,24 @@ Run: ``python benchmarks/main.py [linalg|cluster|manipulations|preprocessing|nn|
 from __future__ import annotations
 
 import json
+import os
 import sys
-import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# run on the default accelerator; HEAT_BENCH_PLATFORM=cpu forces the host
+# mesh (useful when the accelerator transport is unavailable)
+if os.environ.get("HEAT_BENCH_PLATFORM") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _run(name: str, fn, reps: int = 3) -> None:
     import heat_tpu as ht
 
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn()
-        ht.utils.profiler.sync(out)
-        times.append(time.perf_counter() - t0)
-    print(json.dumps({"benchmark": name, "seconds": round(min(times), 5), "reps": reps}))
+    best = ht.utils.profiler.timeit_min(fn, reps=reps)
+    print(json.dumps({"benchmark": name, "seconds": round(best, 5), "reps": reps}))
 
 
 def bench_linalg() -> None:

@@ -16,27 +16,19 @@ import subprocess
 import sys
 
 WORKER = r"""
-import json, os, sys, time
+import json, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else ".")
-import numpy as np
+sys.path.insert(0, ".")  # launched with cwd = repo root
+import numpy as _np
 import heat_tpu as ht
 
 n_dev = int(sys.argv[1])
-import numpy as _np
 from jax.sharding import Mesh
 mesh = Mesh(_np.asarray(jax.devices()[:n_dev]), ("x",))
 ht.use_mesh(mesh)
 
-def timed(fn, reps=3):
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn()
-        ht.utils.profiler.sync(out)
-        best = min(best, time.perf_counter() - t0)
-    return best
+timed = ht.utils.profiler.timeit_min
 
 results = {}
 X = ht.random.randn(2**17, 32, split=0)
@@ -62,15 +54,20 @@ def main() -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     for n in counts:
         env = dict(os.environ)
-        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
-        out = subprocess.run(
-            [sys.executable, "-c", WORKER, str(n)],
-            capture_output=True,
-            text=True,
-            env=env,
-            cwd=os.path.dirname(here),
-            timeout=1200,
-        )
+        base_flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = f"{base_flags} --xla_force_host_platform_device_count={n}".strip()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", WORKER, str(n)],
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=os.path.dirname(here),
+                timeout=1200,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"n_devices": n, "error": "worker timed out after 1200s"}))
+            continue
         if out.returncode != 0:
             print(json.dumps({"n_devices": n, "error": out.stderr.strip()[-400:]}))
             continue

@@ -15,7 +15,19 @@ from typing import Optional
 import jax
 import numpy as np
 
-__all__ = ["trace", "timer", "sync", "annotate"]
+__all__ = ["trace", "timer", "sync", "annotate", "timeit_min"]
+
+
+def timeit_min(fn, reps: int = 3) -> float:
+    """Best-of-``reps`` wall-clock seconds of ``fn()``, forcing completion of
+    its result (the benchmark harness's shared timing methodology)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def sync(x=None) -> None:
