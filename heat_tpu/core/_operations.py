@@ -25,9 +25,68 @@ from .stride_tricks import broadcast_shape, sanitize_axis
 __all__ = ["_local_op", "_binary_op", "_reduce_op", "_cum_op"]
 
 
+def _reduce_kinds():
+    # nan* ops: NaN is the exact masking identity on floats (ignored by the
+    # op, and an all-NaN slice still yields NaN as numpy does); on integer
+    # dtypes nan-ops degenerate to the plain op, so the base kind applies
+    kinds = {}
+    for name, kind in (
+        ("sum", "zero"), ("nansum", ("nan", "zero")), ("count_nonzero", "zero"),
+        ("any", "zero"), ("prod", "one"), ("nanprod", ("nan", "one")), ("all", "one"),
+        ("max", "neg"), ("amax", "neg"), ("nanmax", ("nan", "neg")), ("argmax", "neg"),
+        ("min", "pos"), ("amin", "pos"), ("nanmin", ("nan", "pos")), ("argmin", "pos"),
+    ):
+        fn = getattr(jnp, name, None)
+        if fn is not None:
+            kinds[fn] = kind
+    return kinds
+
+
+_REDUCE_KIND = _reduce_kinds()
+
+
+def _reduce_identity(op, dtype):
+    """Identity fill value for masking the pad region of a ragged array under
+    reduction ``op`` (pad-and-mask boundary masking); None = op not maskable."""
+    kind = _REDUCE_KIND.get(op)
+    if kind is None:
+        return None
+    dt = jnp.dtype(dtype)
+    is_float = jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+    if isinstance(kind, tuple):
+        if is_float:
+            return jnp.nan
+        kind = kind[1]
+    if kind == "zero":
+        return False if dt == jnp.bool_ else 0
+    if kind == "one":
+        return True if dt == jnp.bool_ else 1
+    if dt == jnp.bool_:
+        return False if kind == "neg" else True
+    if is_float:
+        return -jnp.inf if kind == "neg" else jnp.inf
+    info = jnp.iinfo(dt)
+    return info.min if kind == "neg" else info.max
+
+
 def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwargs) -> DNDarray:
     """Elementwise op with no communication; split is preserved."""
     sanitation.sanitize_in(x)
+    if x._pad and out is None:
+        # ragged fast path: compute on the padded physical array — the pad
+        # region produces dead values (masked at reduction boundaries), and
+        # the result stays fully sharded with no unpad gather
+        phys = op(x._parray, **kwargs)
+        if phys.shape == x._parray.shape:
+            return DNDarray(
+                phys,
+                x.shape,
+                types.canonical_heat_type(phys.dtype),
+                x.split,
+                x.device,
+                x.comm,
+                x.balanced,
+            )
     result = op(x._jarray, **kwargs)
     result = x.comm.shard(result, x.split if x.split is not None and x.split < result.ndim else None)
     if out is not None:
@@ -116,6 +175,31 @@ def _binary_op(
         out_ndim,
     )
 
+    # ragged fast path: same shape + same split + same pad → operate on the
+    # padded physical arrays directly (pad regions stay dead, no unpad gather)
+    if out is None and where is None:
+        d1, d2 = isinstance(a1, DNDarray), isinstance(a2, DNDarray)
+        p1 = a1._pad if d1 else 0
+        p2 = a2._pad if d2 else 0
+        if (p1 or p2) and (
+            (d1 and d2 and sh1 == sh2 and s1 == s2 and p1 == p2)
+            or (d1 and p1 and not d2 and np.isscalar(a2))
+            or (d2 and p2 and not d1 and np.isscalar(a1))
+        ):
+            pj1 = a1._parray if d1 else a1
+            pj2 = a2._parray if d2 else a2
+            pj1, pj2 = _complexsafe.colocate(pj1, pj2) if (d1 and d2) else (pj1, pj2)
+            phys = op(pj1, pj2, **fn_kwargs)
+            return DNDarray(
+                phys,
+                out_shape,
+                types.canonical_heat_type(phys.dtype),
+                res_split,
+                device,
+                comm,
+                True,
+            )
+
     j1 = a1._jarray if isinstance(a1, DNDarray) else a1
     j2 = a2._jarray if isinstance(a2, DNDarray) else a2
     j1, j2 = _complexsafe.colocate(j1, j2)
@@ -165,23 +249,52 @@ def _reduce_op(
     """
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
-    result = op(x._jarray, axis=axis, keepdims=keepdims, **kwargs)
-    if dtype is not None:
-        result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
 
     split = x.split
-    if split is None:
+    if split is None or axis is None:
         new_split = None
-    elif axis is None:
-        new_split = None
+        reduces_split = axis is None and split is not None
     else:
         axes = (axis,) if isinstance(axis, int) else tuple(axis)
-        if split in axes:
+        reduces_split = split in axes
+        if reduces_split:
             new_split = None
         elif keepdims:
             new_split = split
         else:
             new_split = split - sum(1 for a in axes if a < split)
+
+    # ragged fast path: reduce the padded physical array with the pad region
+    # replaced by the op's identity element (pad-and-mask boundary masking)
+    fill = _reduce_identity(op, x._parray.dtype) if x._pad else None
+    if fill is not None and axis is None and op in (jnp.argmax, jnp.argmin):
+        # flat arg-reductions index PHYSICAL coordinates when an interior axis
+        # is padded — the flat index would be wrong; take the logical path
+        fill = None
+    if x._pad and out is None and fill is not None:
+        ok_split = reduces_split or (new_split is not None)
+        phys = op(x._masked(fill), axis=axis, keepdims=keepdims, **kwargs) if ok_split else None
+        if phys is not None and (new_split is None or new_split < phys.ndim):
+            if dtype is not None:
+                phys = phys.astype(types.canonical_heat_type(dtype).jax_dtype())
+            if reduces_split:
+                # pad axis reduced away under identity masking: result logical
+                phys = x.comm.shard(phys, None)
+                return DNDarray(
+                    phys, tuple(phys.shape), types.canonical_heat_type(phys.dtype),
+                    None, x.device, x.comm, True,
+                )
+            # split axis survives (still padded in phys): logical gshape shrinks
+            gshape = list(phys.shape)
+            gshape[new_split] -= x._pad
+            return DNDarray(
+                phys, tuple(gshape), types.canonical_heat_type(phys.dtype),
+                new_split, x.device, x.comm, True,
+            )
+
+    result = op(x._jarray, axis=axis, keepdims=keepdims, **kwargs)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
     if new_split is not None and new_split >= result.ndim:
         new_split = None
     result = x.comm.shard(result, new_split)
@@ -210,6 +323,19 @@ def _cum_op(
     """Cumulative op along ``axis`` (reference __cum_op via Exscan; here XLA scan)."""
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
+    if axis is not None and x._pad and out is None:
+        # ragged fast path: identity-masked physical cumulation — the valid
+        # prefix is exact (pad contributes the identity); pad region is dead
+        fill = {getattr(jnp, "cumsum", None): 0, getattr(jnp, "cumprod", None): 1}.get(op)
+        if fill is not None:
+            src = x._masked(fill) if axis == x.split else x._parray
+            phys = op(src, axis=axis)
+            if dtype is not None:
+                phys = phys.astype(types.canonical_heat_type(dtype).jax_dtype())
+            return DNDarray(
+                phys, x.shape, types.canonical_heat_type(phys.dtype),
+                x.split, x.device, x.comm, True,
+            )
     if axis is None:
         # numpy semantics: flatten
         flat = x._jarray.reshape(-1)

@@ -140,6 +140,13 @@ class Communication:
         )
         return start, lshape, slices
 
+    def padded_extent(self, n: int) -> int:
+        """Smallest multiple of ``size`` ≥ ``ceil(n/size)*size`` — the physical
+        extent of a ragged axis under pad-and-mask sharding (SURVEY §7 hard
+        part #1)."""
+        c = -(-int(n) // self.size)
+        return c * self.size
+
     def counts_displs_shape(self, shape, split: int):
         """Per-shard counts and displacements along ``split`` (I/O hyperslabs)."""
         counts, displs = [], []
@@ -195,6 +202,45 @@ class Communication:
         sh = self.sharding(array.ndim, split)
         if isinstance(array, jax.core.Tracer):
             return lax.with_sharding_constraint(array, sh)
+        if getattr(array, "sharding", None) == sh:
+            return array
+        return jax.device_put(array, sh)
+
+    def pad_shard(self, array: jax.Array, split: int) -> jax.Array:
+        """Zero-pad ``array`` along ``split`` to a mesh-divisible extent and
+        physically place it on this communicator's sharding.
+
+        This is the ragged-shape ingest path (pad-and-mask, SURVEY §7 hard
+        part #1): JAX's ``NamedSharding`` requires the sharded dimension to be
+        divisible by the mesh axis size, so non-divisible ("ragged") axes are
+        padded to ``ceil(n/p)*p`` with zeros.  The logical extent is carried by
+        ``DNDarray.gshape``; the pad region is dead data masked at reduction
+        boundaries.  Returns the padded, sharded physical array.
+        """
+        from ._complexsafe import guard
+
+        hosted = guard(array)
+        if hosted is not None:
+            # complex on a transport without native complex: stays host-side,
+            # pad for shape consistency but skip device placement
+            n = hosted.shape[split]
+            pad = self.padded_extent(n) - n
+            if pad:
+                widths = [(0, pad if i == split else 0) for i in range(hosted.ndim)]
+                hosted = jnp.pad(hosted, widths)
+            return hosted
+        split = split % array.ndim
+        n = array.shape[split]
+        pad = self.padded_extent(n) - n
+        if pad:
+            widths = [(0, pad if i == split else 0) for i in range(array.ndim)]
+            array = jnp.pad(array, widths)
+        sh = self.sharding(array.ndim, split)
+        if isinstance(array, jax.core.Tracer):
+            try:
+                return lax.with_sharding_constraint(array, sh)
+            except Exception:
+                return array  # inside a transform where constraints don't apply
         if getattr(array, "sharding", None) == sh:
             return array
         return jax.device_put(array, sh)

@@ -66,25 +66,110 @@ class DNDarray:
         comm: Communication,
         balanced: Optional[bool] = True,
     ):
-        self.__array = array
-        self.__gshape = tuple(int(s) for s in gshape)
+        gshape = tuple(int(s) for s in gshape)
+        if split is not None and len(gshape):
+            split = split % len(gshape)
+        elif split is not None:
+            split = None
+        self.__gshape = gshape
         self.__dtype = types.canonical_heat_type(dtype)
         self.__split = split
         self.__device = device
         self.__comm = comm
         self.__balanced = balanced
+        self.__pad = 0
+        self.__unpadded = None
+        # --- physical normalization (pad-and-mask, SURVEY §7 hard part #1) ---
+        # NamedSharding requires the sharded axis to be divisible by the mesh
+        # axis size.  Ragged axes are physically stored zero-padded to
+        # ceil(n/p)*p; `gshape` carries the logical (true) extent and `_pad`
+        # the trailing dead region.  This constructor is the single choke
+        # point: any DNDarray with a split axis is guaranteed physically
+        # sharded over the full mesh, so `split` metadata never lies
+        # (cf. reference `heat/core/dndarray.py` chunk-map invariant).
+        if split is not None and comm.size > 1 and hasattr(array, "shape"):
+            n = gshape[split]
+            target = comm.padded_extent(n)
+            pad = target - n
+            ashape = tuple(array.shape)
+            expect_logical = gshape
+            expect_physical = gshape[:split] + (target,) + gshape[split + 1 :]
+            if ashape == expect_physical and pad:
+                self.__pad = pad  # caller already provides the padded physical
+            elif ashape == expect_logical:
+                if pad:
+                    array = comm.pad_shard(array, split)
+                    self.__pad = pad
+            else:
+                raise ValueError(
+                    f"array shape {ashape} matches neither the logical gshape "
+                    f"{expect_logical} nor the padded physical shape {expect_physical}"
+                )
+        self.__array = array
 
     # ------------------------------------------------------------------ #
     # internal access
     # ------------------------------------------------------------------ #
     @property
     def _jarray(self) -> jax.Array:
-        """The underlying global jax.Array (framework-internal)."""
-        return self.__array
+        """The LOGICAL global jax.Array — true ``gshape``, pad sliced off.
+
+        For the (common) divisible case this is the stored array itself; for
+        ragged splits it is a cached slice of the padded physical array. Ops
+        that consume `_jarray` are correct by construction; pad-aware fast
+        paths use `_parray`/`_masked` instead.
+        """
+        if self.__pad == 0:
+            return self.__array
+        if self.__unpadded is None:
+            sl = tuple(
+                slice(0, self.__gshape[i]) if i == self.__split else slice(None)
+                for i in range(len(self.__gshape))
+            )
+            self.__unpadded = self.__array[sl]
+        return self.__unpadded
 
     @_jarray.setter
     def _jarray(self, arr) -> None:
-        self.__array = arr
+        """Replace contents with a LOGICAL (true-shape) array; re-pads/places."""
+        self._renormalize(arr)
+
+    @property
+    def _parray(self) -> jax.Array:
+        """The PHYSICAL stored array (padded along split when `_pad` > 0)."""
+        return self.__array
+
+    @property
+    def _pad(self) -> int:
+        """Trailing zero-pad extent along the split axis (0 when divisible)."""
+        return self.__pad
+
+    def _masked(self, fill) -> jax.Array:
+        """Physical array with the pad region replaced by ``fill`` — the
+        reduction-identity masking of pad-and-mask (e.g. 0 for sum, -inf for
+        max).  No-op when the array is not padded."""
+        if self.__pad == 0:
+            return self.__array
+        from jax import lax as _lax
+
+        iota = _lax.broadcasted_iota(jnp.int32, self.__array.shape, self.__split)
+        fillv = jnp.asarray(fill, dtype=self.__array.dtype)
+        return jnp.where(iota < self.__gshape[self.__split], self.__array, fillv)
+
+    def _renormalize(self, logical: jax.Array) -> None:
+        """Install ``logical`` (true-shape) as the new contents: recompute the
+        global shape, pad and physically place as needed."""
+        self.__gshape = tuple(int(s) for s in logical.shape)
+        self.__unpadded = None
+        self.__pad = 0
+        split = self.__split
+        if split is not None and split < len(self.__gshape) and self.__comm.size > 1:
+            n = self.__gshape[split]
+            target = self.__comm.padded_extent(n)
+            if target != n:
+                logical = self.__comm.pad_shard(logical, split)
+                self.__pad = target - n
+        self.__array = logical
 
     # ------------------------------------------------------------------ #
     # reference-parity attributes
@@ -94,14 +179,14 @@ class DNDarray:
         """The process-local data.
 
         Single-controller JAX addresses all chips, so the 'local' view is the
-        global array itself.  (Reference users index shards via
+        global (logical) array itself.  (Reference users index shards via
         ``lshape_map``/``chunk``.)
         """
-        return self.__array
+        return self._jarray
 
     @larray.setter
     def larray(self, array: jax.Array) -> None:
-        self.__array = array
+        self._renormalize(array)
 
     @property
     def shape(self) -> Tuple[int, ...]:
@@ -139,7 +224,7 @@ class DNDarray:
 
     @property
     def balanced(self) -> bool:
-        return bool(self.__balanced)
+        return self.is_balanced()
 
     @property
     def ndim(self) -> int:
@@ -246,21 +331,31 @@ class DNDarray:
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, self.__balanced
             )
         self.__array = casted
+        self.__unpadded = None
         self.__dtype = dtype
         return self
 
     def numpy(self) -> np.ndarray:
-        """Gather the global array to host memory as a numpy array."""
+        """Gather the global (logical) array to host memory as a numpy array."""
+        src = self.__array
         try:
-            return np.asarray(jax.device_get(self.__array))
+            out = np.asarray(jax.device_get(src))
         except jax.errors.JaxRuntimeError:
-            if jnp.issubdtype(self.__array.dtype, jnp.complexfloating):
+            if jnp.issubdtype(src.dtype, jnp.complexfloating):
                 # some TPU transports cannot ship complex buffers to host;
                 # move the real/imag planes separately and recombine
-                re = np.asarray(jax.device_get(jnp.real(self.__array)))
-                im = np.asarray(jax.device_get(jnp.imag(self.__array)))
-                return (re + 1j * im).astype(self.__dtype.np_dtype())
-            raise
+                re = np.asarray(jax.device_get(jnp.real(src)))
+                im = np.asarray(jax.device_get(jnp.imag(src)))
+                out = (re + 1j * im).astype(self.__dtype.np_dtype())
+            else:
+                raise
+        if self.__pad:
+            sl = tuple(
+                slice(0, self.__gshape[i]) if i == self.__split else slice(None)
+                for i in range(len(self.__gshape))
+            )
+            out = out[sl]
+        return out
 
     def __array__(self, dtype=None) -> np.ndarray:
         a = self.numpy()
@@ -272,7 +367,7 @@ class DNDarray:
     def item(self):
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to scalars")
-        return self.__array.reshape(()).item()
+        return self._jarray.reshape(()).item()
 
     def __bool__(self) -> bool:
         return bool(self.item())
@@ -307,32 +402,81 @@ class DNDarray:
         return self.__split is not None and self.__comm.is_distributed()
 
     def is_balanced(self, force_check: bool = False) -> bool:
-        return True  # ceil-div sharding is the only layout; always balanced
+        """True iff every shard's valid extent differs by at most one row —
+        the reference's balancedness criterion, computed from the REAL
+        ceil-division chunk map (truthful for ragged shapes: e.g. 100 rows on
+        8 devices gives chunks 13×7+9, which is NOT balanced).  Closed form:
+        chunks are ``c = ceil(n/p)`` except the tail, so balanced ⇔
+        ``c - clamp(n - (p-1)c, 0, c) <= 1``."""
+        if self.__split is None or not self.__comm.is_distributed():
+            return True
+        n, p = self.__gshape[self.__split], self.__comm.size
+        c = -(-n // p)
+        tail = max(0, min(c, n - (p - 1) * c))
+        return c - tail <= 1
 
     def balance_(self) -> None:
-        self.__balanced = True
+        """Reference parity stub: under GSPMD the ceil-division grid is the
+        ONLY physical layout — there is no unbalanced state to repair (ragged
+        shapes are padded, not unevenly chunked), so this is a no-op.
+        ``is_balanced()`` may legitimately stay False for ragged shapes; that
+        reports the ceil-div chunk asymmetry, not a repairable state."""
+        self.__balanced = self.is_balanced()
 
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution to a new split axis (reference SURVEY §3.3).
 
-        Lowered by XLA to an all-to-all (split↔split) or allgather (→None).
+        Lowered by XLA to an all-to-all (split↔split) or allgather (→None);
+        ragged axes are re-padded along the new split axis.
         """
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = self.__comm.resplit(self.__array, axis)
+        logical = self._jarray
         self.__split = axis
-        self.__balanced = True
+        self.__pad = 0
+        self.__unpadded = None
+        if axis is None:
+            self.__array = self.__comm.resplit(logical, None)
+        else:
+            self._renormalize(logical)
+            if self.__pad == 0:
+                self.__array = self.__comm.resplit(self.__array, axis)
+        self.__balanced = self.is_balanced()
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
-        """Reference parity: arbitrary re-chunking.
+        """Redistribute to a target chunk map (reference
+        ``DNDarray.redistribute_``).
 
-        The ceil-div grid is the only physical layout under NamedSharding, so
-        redistribution to arbitrary chunk maps is a no-op on the contents; the
-        request is honored by rebalancing.
+        Under GSPMD the per-shard placement is canonically determined by the
+        ``NamedSharding`` (ceil-division chunks): the canonical map is
+        enforced physically (a ``device_put``, lowered to all-to-all if data
+        is elsewhere); any OTHER chunk map is not representable — JAX offers
+        no per-device uneven placement — so a non-canonical ``target_map``
+        raises ``NotImplementedError`` instead of silently lying about the
+        layout (SURVEY §7 hard part #1).
         """
-        self.balance_()
+        if self.__split is None:
+            return
+        if target_map is not None:
+            tm = np.asarray(target_map)
+            canonical = self.__comm.lshape_map(self.__gshape, self.__split)
+            if tm.shape != canonical.shape or not (tm == canonical).all():
+                raise NotImplementedError(
+                    "arbitrary chunk maps are not representable under GSPMD "
+                    "even-sharding; only the canonical ceil-division map is "
+                    f"supported (requested {tm.tolist()}, canonical "
+                    f"{canonical.tolist()}). Use resplit_() to change the "
+                    "split axis instead."
+                )
+        # enforce canonical physical placement
+        if self.__pad == 0:
+            self.__array = self.__comm.shard(self.__array, self.__split)
+        else:
+            self.__array = self.__comm.pad_shard(self._jarray, self.__split)
+            self.__unpadded = None
+        self.__balanced = self.is_balanced()
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
         from . import manipulations
@@ -352,8 +496,12 @@ class DNDarray:
         if device == self.__device:
             return self
         comm = Communication(device.mesh)
-        arr = jax.device_put(self.numpy(), comm.sharding(self.ndim, self.__split))
-        return DNDarray(arr, self.__gshape, self.__dtype, self.__split, device, comm, True)
+        host = jnp.asarray(self.numpy())
+        split = self.__split
+        if split is None or self.__gshape[split] % comm.size == 0:
+            host = jax.device_put(host, comm.sharding(self.ndim, split))
+        # ragged: the constructor pad-shards onto the target mesh
+        return DNDarray(host, self.__gshape, self.__dtype, split, device, comm, True)
 
     # ------------------------------------------------------------------ #
     # halo support (reference: get_halo / array_with_halos, used by convolve)
@@ -373,8 +521,8 @@ class DNDarray:
 
         hs = getattr(self, "_DNDarray__halo_size", 0)
         if self.__split is None or hs == 0:
-            return self.__array
-        return with_halos(self.__array, hs, self.__split, self.__comm)
+            return self._jarray
+        return with_halos(self._jarray, hs, self.__split, self.__comm)
 
     # ------------------------------------------------------------------ #
     # indexing
@@ -448,7 +596,7 @@ class DNDarray:
 
     def __getitem__(self, key) -> "DNDarray":
         nkey = self._normalized_key(key)
-        result = self.__array[nkey]
+        result = self._jarray[nkey]
         new_split = self._result_split_of_key(nkey)
         if new_split is not None and new_split >= result.ndim:
             new_split = None
@@ -467,14 +615,20 @@ class DNDarray:
         nkey = self._normalized_key(key)
         if isinstance(value, DNDarray):
             value = value._jarray
-        updated = self.__array.at[nkey].set(value)
-        self.__array = self.__comm.shard(updated, self.__split)
+        if self.__pad:
+            self._renormalize(self._jarray.at[nkey].set(value))
+        else:
+            updated = self.__array.at[nkey].set(value)
+            self.__array = self.__comm.shard(updated, self.__split)
 
     def fill_diagonal(self, value) -> "DNDarray":
         n = min(self.__gshape[-2], self.__gshape[-1]) if self.ndim >= 2 else 0
         idx = jnp.arange(n)
-        updated = self.__array.at[..., idx, idx].set(value)
-        self.__array = self.__comm.shard(updated, self.__split)
+        if self.__pad:
+            self._renormalize(self._jarray.at[..., idx, idx].set(value))
+        else:
+            updated = self.__array.at[..., idx, idx].set(value)
+            self.__array = self.__comm.shard(updated, self.__split)
         return self
 
     # ------------------------------------------------------------------ #
@@ -508,18 +662,44 @@ class DNDarray:
 # pytree registration: DNDarray-valued functions are jit/grad/vmap-able
 # ---------------------------------------------------------------------- #
 def _dnd_flatten(x: DNDarray):
-    return (x._jarray,), (x.split, x.device, x.comm)
+    # the PHYSICAL (padded) array is the leaf so transforms never see a
+    # distribution-destroying unpad slice; pad travels in the static aux,
+    # together with ndim so batching transforms (vmap/scan prepend a leading
+    # axis) can re-anchor the split/pad axis instead of corrupting the shape
+    return (x._parray,), (x.split, x.device, x.comm, x._pad, x.ndim)
 
 
 def _dnd_unflatten(aux, children):
     (arr,) = children
-    split, device, comm = aux
-    shape = tuple(arr.shape) if hasattr(arr, "shape") else ()
+    split, device, comm, pad, ndim0 = aux
+    shape = list(arr.shape) if hasattr(arr, "shape") else []
+    nd = len(shape)
+    if split is not None:
+        delta = nd - ndim0
+        adj = split + delta if delta > 0 else split  # leading batch dims added
+        if 0 <= adj < nd:
+            split = adj
+        else:
+            split, pad = None, 0
+    if (
+        pad
+        and split is not None
+        and shape[split] >= pad
+    ):
+        shape[split] -= pad  # physical → logical extent
+    elif pad:
+        pad = 0
+    shape = tuple(shape)
     try:
         dtype = types.canonical_heat_type(arr.dtype)
     except (TypeError, AttributeError):
         dtype = types.float32
-    return DNDarray(arr, shape, dtype, split, device, comm, True)
+    try:
+        return DNDarray(arr, shape, dtype, split, device, comm, True)
+    except ValueError:
+        # a transform (vmap batching, scan carry) reshaped the leaf so the
+        # pad bookkeeping no longer lines up; treat the leaf as logical
+        return DNDarray(arr, tuple(arr.shape), dtype, None, device, comm, True)
 
 
 jax.tree_util.register_pytree_node(DNDarray, _dnd_flatten, _dnd_unflatten)

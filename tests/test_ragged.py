@@ -1,0 +1,275 @@
+"""Ragged (non-divisible) shard support — pad-and-mask (SURVEY §7 hard part #1).
+
+The reference (`heat/core/dndarray.py`) treats arbitrary chunk maps as a core
+invariant: any `shape[split] % nprocs != 0` array is still distributed.  Here
+that is realized by zero-padding the split axis to `ceil(n/p)*p` (the physical
+NamedSharding layout) while `gshape` carries the logical extent; this file is
+the adversarial matrix for that machinery at mesh sizes 1, 3, 4 and 8 —
+VERDICT r2 item 1's acceptance criteria.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import heat_tpu as ht
+from test_suites.basic_test import TestCase
+
+MESH_SIZES = [1, 3, 4, 8]
+
+
+def sub_comm(p):
+    devs = jax.devices()[:p]
+    return ht.communication.Communication(Mesh(np.asarray(devs), ("x",)), "x")
+
+
+def make(data, split, comm):
+    return ht.array(data, split=split, comm=comm)
+
+
+@pytest.mark.parametrize("p", MESH_SIZES)
+class TestRaggedPhysical(TestCase):
+    def test_prime_rows_fully_sharded(self, p):
+        comm = sub_comm(p)
+        x = make(np.arange(97 * 4, dtype=np.float32).reshape(97, 4), 0, comm)
+        assert x.split == 0
+        assert len(x._parray.sharding.device_set) == p
+        expect_pad = (-97) % p
+        assert x._pad == expect_pad
+        assert x._parray.shape == (97 + expect_pad, 4)
+        self.assert_array_equal(x, np.arange(97 * 4, dtype=np.float32).reshape(97, 4))
+
+    def test_n_smaller_than_p(self, p):
+        comm = sub_comm(p)
+        data = np.arange(2 * 3, dtype=np.float32).reshape(2, 3)
+        x = make(data, 0, comm)
+        assert len(x._parray.sharding.device_set) == p
+        self.assert_array_equal(x, data)
+        # shards beyond row 2 are pad-only; lshape_map must say so
+        counts = x.lshape_map()[:, 0]
+        assert counts.sum() == 2
+        assert (counts <= 1).all() or p == 1
+
+    def test_lshape_map_matches_physical_shards(self, p):
+        comm = sub_comm(p)
+        n = 13
+        data = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        x = make(data, 0, comm)
+        lmap = x.lshape_map()
+        # reconstruct each shard's valid extent from the padded physical array
+        got = np.full(p, -1)
+        for s in x._parray.addressable_shards:
+            r = s.index[0].start // max(1, x._parray.shape[0] // p) if p > 1 else 0
+            start = s.index[0].start or 0
+            valid = int(np.clip(n - start, 0, s.data.shape[0]))
+            got[r] = valid
+        assert (lmap[:, 0] == got).all(), f"lshape_map {lmap[:,0]} vs physical {got}"
+
+    def test_is_balanced_truthful(self, p):
+        comm = sub_comm(p)
+        x = make(np.zeros((100, 4), np.float32), 0, comm)
+        counts = x.lshape_map()[:, 0]
+        assert x.is_balanced() == (counts.max() - counts.min() <= 1)
+        y = make(np.zeros((8 * max(p, 1), 4), np.float32), 0, comm)
+        assert y.is_balanced()
+
+    def test_redistribute_canonical_and_rejects_arbitrary(self, p):
+        comm = sub_comm(p)
+        x = make(np.arange(10, dtype=np.float32), 0, comm)
+        x.redistribute_(target_map=x.lshape_map())  # canonical map: fine
+        self.assert_array_equal(x, np.arange(10, dtype=np.float32))
+        if p > 1:
+            bad = x.lshape_map().copy()
+            if bad[0, 0] >= 1:
+                bad[0, 0] -= 1
+                bad[-1, 0] += 1
+                with pytest.raises(NotImplementedError):
+                    x.redistribute_(target_map=bad)
+
+
+@pytest.mark.parametrize("p", MESH_SIZES)
+class TestRaggedOps(TestCase):
+    """Value oracle over the op surface for ragged shapes (prime sizes, n<p)."""
+
+    def data(self, shape):
+        rng = np.random.default_rng(7)
+        return rng.uniform(-3, 3, size=shape).astype(np.float32)
+
+    def test_elementwise_and_binary(self, p):
+        comm = sub_comm(p)
+        d = self.data((29, 5))
+        for split in (None, 0, 1):
+            x = make(d, split, comm)
+            self.assert_array_equal(ht.exp(x), np.exp(d), rtol=1e-4)
+            self.assert_array_equal(x + x, d + d)
+            self.assert_array_equal(x * 2.5, d * 2.5)
+            self.assert_array_equal(x - make(d, split, comm), np.zeros_like(d))
+
+    def test_reductions_masked(self, p):
+        comm = sub_comm(p)
+        d = self.data((31, 3))
+        for split in (None, 0, 1):
+            x = make(d, split, comm)
+            self.assert_array_equal(ht.sum(x), d.sum(), rtol=1e-4)
+            self.assert_array_equal(ht.sum(x, axis=0), d.sum(0), rtol=1e-4)
+            self.assert_array_equal(ht.sum(x, axis=1), d.sum(1), rtol=1e-4)
+            self.assert_array_equal(ht.max(x, axis=0), d.max(0))
+            self.assert_array_equal(ht.min(x, axis=1), d.min(1))
+            self.assert_array_equal(ht.argmax(x, axis=0), d.argmax(0))
+            self.assert_array_equal(ht.argmin(x, axis=1), d.argmin(1))
+            self.assert_array_equal(ht.argmax(x), d.argmax())
+            self.assert_array_equal(ht.mean(x, axis=0), d.mean(0), rtol=1e-4)
+            self.assert_array_equal(ht.prod(x / 2, axis=0), (d / 2).prod(0), rtol=1e-3)
+
+    def test_bool_reductions(self, p):
+        comm = sub_comm(p)
+        d = self.data((17, 2)) > 0
+        for split in (None, 0, 1):
+            x = make(d, split, comm)
+            self.assert_array_equal(ht.any(x, axis=0), d.any(0))
+            self.assert_array_equal(ht.all(x, axis=0), d.all(0))
+            assert bool(ht.any(x)) == bool(d.any())
+            assert bool(ht.all(x)) == bool(d.all())
+
+    def test_cumsum_cumprod(self, p):
+        comm = sub_comm(p)
+        d = self.data((23, 4))
+        for split in (None, 0, 1):
+            x = make(d, split, comm)
+            self.assert_array_equal(ht.cumsum(x, axis=0), d.cumsum(0), rtol=1e-3, atol=1e-3)
+            self.assert_array_equal(
+                ht.cumprod(x / 4, axis=1), (d / 4).cumprod(1), rtol=1e-3, atol=1e-4
+            )
+
+    def test_matmul_ragged(self, p):
+        comm = sub_comm(p)
+        a = self.data((19, 7))
+        b = self.data((7, 11))
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                x = make(a, sa, comm)
+                y = make(b, sb, comm)
+                self.assert_array_equal(x @ y, a @ b, rtol=1e-3, atol=1e-3)
+
+    def test_getitem_setitem(self, p):
+        comm = sub_comm(p)
+        d = self.data((26, 6))
+        x = make(d, 0, comm)
+        self.assert_array_equal(x[3:17], d[3:17])
+        self.assert_array_equal(x[::2], d[::2])
+        self.assert_array_equal(x[5], d[5])
+        self.assert_array_equal(x[:, 2], d[:, 2])
+        y = make(d.copy(), 0, comm)
+        y[4:9] = 1.5
+        e = d.copy()
+        e[4:9] = 1.5
+        self.assert_array_equal(y, e)
+
+    def test_sort_unique_concat(self, p):
+        comm = sub_comm(p)
+        d = self.data((21,))
+        x = make(d, 0, comm)
+        self.assert_array_equal(ht.sort(x)[0], np.sort(d), rtol=1e-5)
+        di = np.array([3, 1, 3, 2, 1, 9, 3], np.int32)
+        xi = make(di, 0, comm)
+        self.assert_array_equal(ht.unique(xi, sorted=True), np.unique(di))
+        a = self.data((9, 2))
+        b = self.data((4, 2))
+        self.assert_array_equal(
+            ht.concatenate([make(a, 0, comm), make(b, 0, comm)], axis=0),
+            np.concatenate([a, b], 0),
+        )
+
+    def test_resplit_roundtrip(self, p):
+        comm = sub_comm(p)
+        d = self.data((15, 9))
+        x = make(d, 0, comm)
+        y = x.resplit(1)
+        assert y.split == 1
+        self.assert_array_equal(y, d)
+        y.resplit_(None)
+        assert y.split is None
+        self.assert_array_equal(y, d)
+        x.resplit_(1)
+        self.assert_array_equal(x, d)
+
+    def test_statistics_ragged(self, p):
+        comm = sub_comm(p)
+        d = self.data((27, 4))
+        x = make(d, 0, comm)
+        self.assert_array_equal(ht.mean(x, axis=0), d.mean(0), rtol=1e-4)
+        self.assert_array_equal(ht.var(x, axis=0), d.var(0), rtol=1e-3, atol=1e-4)
+        self.assert_array_equal(ht.median(x, axis=0), np.median(d, 0), rtol=1e-4)
+
+    def test_kmeans_ragged(self, p):
+        comm = sub_comm(p)
+        rng = np.random.default_rng(3)
+        blobs = np.concatenate(
+            [rng.normal(c, 0.1, size=(33, 2)) for c in (-3.0, 0.0, 3.0)]
+        ).astype(np.float32)  # 99 rows: ragged on 2/4/8
+        x = make(blobs, 0, comm)
+        km = ht.cluster.KMeans(n_clusters=3, max_iter=20, random_state=0)
+        labels = km.fit_predict(x)
+        assert labels.shape == (99,)
+        centers = np.sort(km.cluster_centers_.numpy()[:, 0])
+        assert np.allclose(centers, [-3, 0, 3], atol=0.3)
+
+
+class TestRaggedJit(TestCase):
+    """Padded DNDarrays must survive jit round-trips (pytree aux carries pad)."""
+
+    def test_jit_over_padded(self):
+        comm = sub_comm(8)
+        d = np.arange(20, dtype=np.float32).reshape(10, 2)
+        x = make(d, 0, comm)
+
+        @jax.jit
+        def f(a):
+            return a * 2.0
+
+        y = f(x)
+        assert isinstance(y, ht.DNDarray)
+        assert y.shape == (10, 2)
+        self.assert_array_equal(y, d * 2)
+
+    def test_vmap_over_padded_output(self):
+        # regression: unflatten must re-anchor split/pad when vmap prepends a
+        # batch dim, not subtract pad from the batch axis
+        comm = sub_comm(8)
+        d = np.arange(26, dtype=np.float32).reshape(13, 2)
+        x = make(d, 0, comm)
+
+        def f(s):
+            return x * s
+
+        y = jax.vmap(f)(np.arange(1.0, 4.0, dtype=np.float32))
+        assert isinstance(y, ht.DNDarray)
+        assert y.shape == (3, 13, 2)
+        np.testing.assert_allclose(y.numpy(), d[None] * np.arange(1.0, 4.0)[:, None, None])
+
+    def test_nan_reductions_all_nan_ragged(self):
+        # regression: nanmax/nanmin on an all-NaN ragged column must return
+        # NaN (numpy semantics), not the masking fill
+        comm = sub_comm(8)
+        d = np.full((13, 3), np.nan, dtype=np.float32)
+        x = make(d, 0, comm)
+        assert np.isnan(ht.nanmax(x, axis=0).numpy()).all()
+        assert np.isnan(ht.nanmin(x, axis=0).numpy()).all()
+        d2 = np.arange(39, dtype=np.float32).reshape(13, 3)
+        d2[4, 1] = np.nan
+        x2 = make(d2, 0, comm)
+        np.testing.assert_allclose(ht.nansum(x2, axis=0).numpy(), np.nansum(d2, 0), rtol=1e-5)
+        np.testing.assert_allclose(ht.nanmax(x2, axis=0).numpy(), np.nanmax(d2, 0))
+
+    def test_grad_through_padded(self):
+        comm = sub_comm(8)
+        d = np.arange(6, dtype=np.float32).reshape(3, 2)
+        x = make(d, 0, comm)
+
+        def loss(a):
+            return (a._jarray ** 2).sum()
+
+        g = jax.grad(loss)(x)
+        assert isinstance(g, ht.DNDarray)
+        np.testing.assert_allclose(g.numpy(), 2 * d, rtol=1e-5)
