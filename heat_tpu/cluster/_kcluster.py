@@ -116,9 +116,12 @@ class _KCluster(ClusteringMixin, BaseEstimator):
     def _assign(jx, centers):
         """E-step: squared distances + argmin, fused on the MXU.
 
-        For large n the rows are processed in fixed-size blocks via lax.map —
-        XLA keeps each (block, k) distance tile on-chip and the result is just
-        the (n,) labels/min-distances.
+        For large n the rows are processed in fixed-size blocks read with
+        ``dynamic_slice`` inside a ``fori_loop`` — X stays in its at-rest
+        layout and only one (block, k) distance tile plus one (block, d) row
+        tile exist at a time.  (A reshape/``lax.map`` formulation materializes
+        a full lane-padded copy of X as an HLO temp — a 4× blowup for d=32
+        that OOMs HBM at 2²⁵ rows; measured on v5e.)
         """
         cc = jnp.sum(centers * centers, axis=1)[None, :]
 
@@ -131,22 +134,39 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         blk = _KCluster._ASSIGN_BLOCK
         if n <= blk:
             return block_assign(jx)
-        # body processed in fixed blocks, remainder rows as one tail block —
-        # the full n×k tile never materializes for ANY n > blk
-        body = (n // blk) * blk
-        labels, d2min = jax.lax.map(
-            block_assign, jx[:body].reshape(n // blk, blk, jx.shape[1])
-        )
-        labels, d2min = labels.reshape(body), d2min.reshape(body)
-        if body < n:
-            tl, td = block_assign(jx[body:])
-            labels = jnp.concatenate([labels, tl])
-            d2min = jnp.concatenate([d2min, td])
-        return labels, d2min
+        # TRANSPOSED block loop: X at rest is {0,1}-laid-out (n, d), which IS
+        # (d, n) row-major — jx.T is a free bitcast, and (d, blk) tiles have
+        # their minor dim = blk, so nothing ever lane-pads (a (blk, d) slice
+        # layout pads d→128 lanes: 4× HBM for d=32, measured OOM on v5e)
+        xt = jx.T
+        nblocks = -(-n // blk)
+
+        def body(i, carry):
+            labels, d2min = carry
+            start = jnp.minimum(i * blk, n - blk)  # tail block overlaps; writes agree
+            xb = jax.lax.dynamic_slice_in_dim(xt, start, blk, axis=1)  # (d, blk)
+            xx = jnp.sum(xb * xb, axis=0)[None, :]
+            d2 = cc.T + xx - 2.0 * (centers @ xb)  # (k, blk)
+            lb = jnp.argmin(d2, axis=0)
+            db = jnp.min(jnp.maximum(d2, 0.0), axis=0)
+            labels = jax.lax.dynamic_update_slice(labels, lb, (start,))
+            d2min = jax.lax.dynamic_update_slice(d2min, db, (start,))
+            return labels, d2min
+
+        labels0 = jnp.zeros((n,), dtype=jnp.int32)
+        d2min0 = jnp.zeros((n,), dtype=jx.dtype)
+        return jax.lax.fori_loop(0, nblocks, body, (labels0, d2min0))
 
     @staticmethod
     def _update(jx, labels, centers):
         raise NotImplementedError()
+
+    @classmethod
+    def _em_step(cls, jx, centers):
+        """One Lloyd iteration: new centers from current ones.  Default =
+        assign then update (two passes over X); subclasses may fuse."""
+        labels, _ = cls._assign(jx, centers)
+        return cls._update(jx, labels, centers)
 
     @classmethod
     def _fit_program(cls):
@@ -170,8 +190,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
                 def body(state):
                     centers, it, _ = state
-                    labels, _ = cls._assign(jx, centers)
-                    new = cls._update(jx, labels, centers)
+                    new = cls._em_step(jx, centers)
                     return new, it + 1, jnp.max(jnp.abs(new - centers))
 
                 centers, n_iter, _ = jax.lax.while_loop(

@@ -76,7 +76,9 @@ class DNDarray:
         self.__split = split
         self.__device = device
         self.__comm = comm
-        self.__balanced = balanced
+        # `balanced` is accepted for reference API parity but not stored:
+        # balancedness is a pure function of (gshape, split, comm) under the
+        # canonical ceil-div layout — see is_balanced()
         self.__pad = 0
         self.__unpadded = None
         # --- physical normalization (pad-and-mask, SURVEY §7 hard part #1) ---
@@ -328,7 +330,7 @@ class DNDarray:
         dtype = types.canonical_heat_type(casted.dtype)
         if copy:
             return DNDarray(
-                casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, self.__balanced
+                casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, True
             )
         self.__array = casted
         self.__unpadded = None
@@ -421,7 +423,6 @@ class DNDarray:
         shapes are padded, not unevenly chunked), so this is a no-op.
         ``is_balanced()`` may legitimately stay False for ragged shapes; that
         reports the ceil-div chunk asymmetry, not a repairable state."""
-        self.__balanced = self.is_balanced()
 
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution to a new split axis (reference SURVEY §3.3).
@@ -442,7 +443,6 @@ class DNDarray:
             self._renormalize(logical)
             if self.__pad == 0:
                 self.__array = self.__comm.resplit(self.__array, axis)
-        self.__balanced = self.is_balanced()
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
@@ -476,7 +476,6 @@ class DNDarray:
         else:
             self.__array = self.__comm.pad_shard(self._jarray, self.__split)
             self.__unpadded = None
-        self.__balanced = self.is_balanced()
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
         from . import manipulations
@@ -662,11 +661,13 @@ class DNDarray:
 # pytree registration: DNDarray-valued functions are jit/grad/vmap-able
 # ---------------------------------------------------------------------- #
 def _dnd_flatten(x: DNDarray):
-    # the PHYSICAL (padded) array is the leaf so transforms never see a
-    # distribution-destroying unpad slice; pad travels in the static aux,
-    # together with ndim so batching transforms (vmap/scan prepend a leading
-    # axis) can re-anchor the split/pad axis instead of corrupting the shape
-    return (x._parray,), (x.split, x.device, x.comm, x._pad, x.ndim)
+    # the LOGICAL array is the leaf: transforms must see the true gshape or
+    # vmap(in_axes=0) over a ragged array maps over pad rows.  The unpad
+    # slice this costs at a trace boundary is re-padded by the constructor on
+    # the way out (concrete leaves), so distribution is restored at every
+    # concrete boundary; pad in the aux is always 0 here, kept (with ndim)
+    # so unflatten can re-anchor split when batching transforms add axes
+    return (x._jarray,), (x.split, x.device, x.comm, 0, x.ndim)
 
 
 def _dnd_unflatten(aux, children):

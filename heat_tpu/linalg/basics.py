@@ -30,6 +30,7 @@ __all__ = [
     "dot",
     "inv",
     "matmul",
+    "matmul_summa",
     "matrix_norm",
     "norm",
     "outer",

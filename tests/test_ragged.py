@@ -248,6 +248,16 @@ class TestRaggedJit(TestCase):
         assert y.shape == (3, 13, 2)
         np.testing.assert_allclose(y.numpy(), d[None] * np.arange(1.0, 4.0)[:, None, None])
 
+    def test_vmap_in_axes0_over_ragged(self):
+        # regression: the pytree leaf must be the LOGICAL array, else vmap
+        # maps over the pad rows and shapes mismatch
+        comm = sub_comm(8)
+        d = np.arange(26, dtype=np.float32).reshape(13, 2)
+        x = make(d, 0, comm)
+        y = jax.vmap(lambda r: r * 2.0, in_axes=0)(x)
+        assert y.shape == (13, 2)
+        np.testing.assert_allclose(y.numpy(), d * 2)
+
     def test_nan_reductions_all_nan_ragged(self):
         # regression: nanmax/nanmin on an all-NaN ragged column must return
         # NaN (numpy semantics), not the masking fill
