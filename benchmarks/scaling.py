@@ -43,6 +43,48 @@ results["resplit_1024_0to1"] = timed(lambda: m.resplit(1))
 v = ht.random.randn(2**20, split=0)
 results["sort_1M"] = timed(lambda: ht.sort(v)[0])
 
+# DASO vs sync DataParallel (reference's flagship comparison, SURVEY §2.5):
+# identical MLP + batch; DASO pays a per-step ici-subgroup allreduce + every-k
+# dcn parameter average, DataParallel a full-mesh gradient allreduce
+if n_dev >= 2:
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    def _mlp():
+        return ht.nn.Sequential(ht.nn.Linear(64, 128), ht.nn.ReLU(), ht.nn.Linear(128, 8))
+
+    def _loss(pred, y):
+        return _jnp.mean((pred - y) ** 2)
+
+    xb = _np.random.default_rng(0).normal(size=(256, 64)).astype("float32")
+    yb = _np.random.default_rng(1).normal(size=(256, 8)).astype("float32")
+
+    dp = ht.nn.DataParallel(_mlp(), optimizer=ht.optim.DataParallelOptimizer("sgd", lr=0.01))
+    dp.init(key=_jax.random.key(0))
+    opt_state = dp.optimizer.init_state(dp.parameters)
+    dp_step = dp.make_train_step(_loss)
+    jxb = dp.comm.shard(_jnp.asarray(xb), 0)
+    jyb = dp.comm.shard(_jnp.asarray(yb), 0)
+    dp_step(dp.parameters, opt_state, jxb, jyb)  # compile
+
+    def _dp_once():
+        p, s, l = dp_step(dp.parameters, opt_state, jxb, jyb)
+        return l
+
+    results["dp_mlp_step_256"] = timed(_dp_once)
+
+    from jax.sharding import Mesh as _Mesh
+
+    ici = 2
+    daso_mesh = _Mesh(_np.asarray(_jax.devices()[:n_dev]).reshape(n_dev // ici, ici), ("dcn", "ici"))
+    daso = ht.optim.DASO(
+        ht.optim.DataParallelOptimizer("sgd", lr=0.01), mesh=daso_mesh,
+        global_skip=4, warmup_steps=0,
+    )
+    daso.init(_mlp(), key=_jax.random.key(0))
+    daso.step(_loss, _jnp.asarray(xb), _jnp.asarray(yb))  # compile
+    results["daso_mlp_step_256"] = timed(lambda: daso.step(_loss, _jnp.asarray(xb), _jnp.asarray(yb)))
+
 for k, v_ in results.items():
     print(json.dumps({"benchmark": k, "n_devices": n_dev, "seconds": round(v_, 5)}))
 """

@@ -672,24 +672,13 @@ def _dnd_flatten(x: DNDarray):
 
 def _dnd_unflatten(aux, children):
     (arr,) = children
-    split, device, comm, pad, ndim0 = aux
+    split, device, comm, _pad_unused, ndim0 = aux  # flatten always emits pad=0
     shape = list(arr.shape) if hasattr(arr, "shape") else []
     nd = len(shape)
     if split is not None:
         delta = nd - ndim0
         adj = split + delta if delta > 0 else split  # leading batch dims added
-        if 0 <= adj < nd:
-            split = adj
-        else:
-            split, pad = None, 0
-    if (
-        pad
-        and split is not None
-        and shape[split] >= pad
-    ):
-        shape[split] -= pad  # physical → logical extent
-    elif pad:
-        pad = 0
+        split = adj if 0 <= adj < nd else None
     shape = tuple(shape)
     try:
         dtype = types.canonical_heat_type(arr.dtype)

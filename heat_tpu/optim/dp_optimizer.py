@@ -227,29 +227,60 @@ class DASO:
                 return apply(p, x, train=True, key=key)
             return apply(p, x, train=True)
 
-        def group_step(params, opt_state, x, y, key):
-            # params: one group's replica (no leading axis inside shard_map/vmap)
+        from jax.sharding import PartitionSpec as P
+
+        def shard_step(params, opt_state, x, y, key):
+            """Per-(dcn, ici) mesh cell: params/opt_state are ONE group's
+            replica (leading axis 1, replicated over 'ici'); x/y are this
+            cell's slice of the group's batch (sharded over 'ici').
+
+            The reference's two tiers map exactly (SURVEY §2.8):
+            - per-step node-local NCCL allreduce  →  the EXPLICIT
+              ``lax.pmean(grads, 'ici')`` below, a per-step collective over
+              the fast axis only;
+            - every-k async MPI parameter averaging  →  the dcn-tier
+              ``_global_average``/``_blend`` schedule in :meth:`step`.
+            """
+            p0 = jax.tree.map(lambda q: q[0], params)
+            s0 = jax.tree.map(lambda q: q[0], opt_state)
+            x, y = x[0], y[0]  # drop the per-cell group axis (size 1)
+
             def loss(p):
                 return loss_fn(fwd(p, x, key), y)
 
-            lval, grads = jax.value_and_grad(loss)(params)
-            # the reference's per-step NCCL allreduce == psum over 'ici';
-            # here the batch of the group is already whole per call (vmap over
-            # groups); gradient averaging inside the group is implicit in the
-            # mean loss over the group's batch shard
-            updates, new_state = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), new_state, lval
+            lval, grads = jax.value_and_grad(loss)(p0)
+            grads = jax.lax.pmean(grads, "ici")  # in-group gradient allreduce
+            lval = jax.lax.pmean(lval, "ici")
+            updates, new_state = opt.update(grads, s0, p0)
+            new_p = optax.apply_updates(p0, updates)
+            lift = lambda t: jax.tree.map(lambda q: jnp.asarray(q)[None], t)
+            return lift(new_p), lift(new_state), lval[None]
 
-        @jax.jit
-        def train_step(params, opt_state, xs, ys):
-            # vmap over the dcn groups: each group trains on its own batch slice
-            return jax.vmap(lambda p, s, x, y: group_step(p, s, x, y, None))(
-                params, opt_state, xs, ys
+        def _smap(fn, with_keys: bool):
+            in_specs = [P("dcn"), P("dcn"), P("dcn", "ici"), P("dcn", "ici")]
+            if with_keys:
+                in_specs.append(P("dcn", "ici"))
+            return jax.shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=tuple(in_specs),
+                out_specs=(P("dcn"), P("dcn"), P("dcn")),
+                check_vma=False,
             )
 
         @jax.jit
+        def train_step(params, opt_state, xs, ys):
+            return _smap(
+                lambda p, s, x, y: shard_step(p, s, x, y, None), with_keys=False
+            )(params, opt_state, xs, ys)
+
+        @jax.jit
         def train_step_rng(params, opt_state, xs, ys, keys):
-            return jax.vmap(group_step)(params, opt_state, xs, ys, keys)
+            # keys: (n_groups, ici) key array; each mesh cell gets its (1,1) block
+            def fn(p, s, x, y, k):
+                return shard_step(p, s, x, y, k[0, 0])
+
+            return _smap(fn, with_keys=True)(params, opt_state, xs, ys, keys)
 
         @jax.jit
         def global_average(params):
@@ -280,11 +311,16 @@ class DASO:
         jx = x._jarray if hasattr(x, "_jarray") else jnp.asarray(x)
         jy = y._jarray if hasattr(y, "_jarray") else jnp.asarray(y)
         g = self.n_groups
+        if jx.shape[0] % (g * self.ici_size):
+            raise ValueError(
+                f"global batch {jx.shape[0]} must be divisible by n_groups*ici "
+                f"= {g}*{self.ici_size} (each ici shard computes a batch slice)"
+            )
         xs = jx.reshape((g, jx.shape[0] // g) + jx.shape[1:])
         ys = jy.reshape((g, jy.shape[0] // g) + jy.shape[1:])
 
         if key is not None:
-            keys = jax.random.split(key, g)
+            keys = jax.random.split(key, g * self.ici_size).reshape(g, self.ici_size)
             self._params, self._opt_state, losses = self._train_step_rng(
                 self._params, self._opt_state, xs, ys, keys
             )

@@ -1,0 +1,140 @@
+"""DASO two-tier mechanism proof (VERDICT r2 item 4; reference
+``heat/optim/dp_optimizer.py::DASO``, SURVEY §2.8).
+
+The reference's hierarchy is NCCL-allreduce-every-step (intra-node) + async
+MPI parameter averaging every k steps (inter-node).  The TPU mapping is a
+('dcn', 'ici') mesh: these tests compile the actual train step on a 4×2
+8-device mesh and assert, on the HLO itself, that
+
+- the per-step program contains an all-reduce whose replica_groups are the
+  ici SUBGROUPS (pairs within each dcn group) — the fast tier is a real
+  collective, not metadata;
+- the global-average program contains a cross-group collective over the dcn
+  axis — the slow tier moves parameters between groups.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.optim.dp_optimizer import DASO, DataParallelOptimizer
+
+
+def _mesh_4x2():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devs[:8]).reshape(4, 2), ("dcn", "ici"))
+
+
+def _groups_of(hlo: str):
+    """All replica_groups={{...}} occurrences as lists of lists of ints."""
+    out = []
+    for m in re.finditer(r"replica_groups=\{(\{[^=]*?\})\}", hlo):
+        groups = [
+            [int(v) for v in g.split(",") if v.strip()]
+            for g in re.findall(r"\{([\d,]*)\}", m.group(1))
+        ]
+        out.append(groups)
+    # iota-form v2 syntax: replica_groups=[4,2]<=[8] etc.
+    for m in re.finditer(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]", hlo):
+        rows, cols, tot = int(m.group(1)), int(m.group(2)), int(m.group(3))
+        flat = list(range(tot))
+        out.append([flat[i * cols : (i + 1) * cols] for i in range(rows)])
+    for m in re.finditer(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+),(\d+)\]T\(1,0\)", hlo
+    ):
+        rows, cols = int(m.group(1)), int(m.group(2))
+        a, b = int(m.group(3)), int(m.group(4))
+        grid = np.arange(a * b).reshape(a, b).T.reshape(rows, cols)
+        out.append(grid.tolist())
+    return out
+
+
+class TestDASOHLO:
+    def _build(self):
+        mesh = _mesh_4x2()
+        opt = DataParallelOptimizer("sgd", lr=0.1)
+        daso = DASO(opt, mesh=mesh, global_skip=2, warmup_steps=0)
+        model = ht.nn.Sequential(ht.nn.Linear(8, 16), ht.nn.ReLU(), ht.nn.Linear(16, 4))
+        daso.init(model, key=jax.random.key(0))
+
+        def loss_fn(pred, y):
+            return jnp.mean((pred - y) ** 2)
+
+        daso._build_steps(loss_fn)
+        g, ici = daso.n_groups, daso.ici_size
+        xs = jnp.zeros((g, 4 * ici, 8), jnp.float32)
+        ys = jnp.zeros((g, 4 * ici, 4), jnp.float32)
+        return daso, xs, ys
+
+    def test_per_step_ici_allreduce_in_hlo(self):
+        daso, xs, ys = self._build()
+        hlo = (
+            daso._train_step.lower(daso._params, daso._opt_state, xs, ys)
+            .compile()
+            .as_text()
+        )
+        assert "all-reduce" in hlo, "train step contains no collective at all"
+        ici_pairs = [[0, 1], [2, 3], [4, 5], [6, 7]]
+        found = any(g == ici_pairs for g in _groups_of(hlo))
+        assert found, (
+            "no all-reduce over the ici subgroups {{0,1},{2,3},{4,5},{6,7}} "
+            f"in the compiled train step; groups seen: {_groups_of(hlo)}"
+        )
+
+    def test_dcn_collective_in_global_average(self):
+        daso, xs, ys = self._build()
+        hlo = daso._global_average.lower(daso._params).compile().as_text()
+        has_collective = any(
+            k in hlo for k in ("all-reduce", "all-gather", "reduce-scatter", "collective-permute")
+        )
+        assert has_collective, "global average compiles to no cross-group collective"
+        # the collective must span devices from DIFFERENT dcn groups (on the
+        # 4x2 mesh, dcn peers are stride-2 apart: {0,2,4,6}/{1,3,5,7})
+        cross = any(
+            any(len({d // 2 for d in grp}) > 1 for grp in groups)
+            for groups in _groups_of(hlo)
+        )
+        assert cross, f"collective does not cross dcn groups: {_groups_of(hlo)}"
+
+    def test_training_still_converges(self):
+        daso, _, _ = self._build()
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(8, 4)).astype(np.float32)
+        losses = []
+
+        def loss_fn(pred, y):
+            return jnp.mean((pred - y) ** 2)
+
+        for i in range(30):
+            xb = rng.normal(size=(16, 8)).astype(np.float32)
+            yb = xb @ W
+            losses.append(daso.step(loss_fn, jnp.asarray(xb), jnp.asarray(yb)))
+        assert losses[-1] < losses[0] * 0.5, f"no convergence: {losses[0]} -> {losses[-1]}"
+
+    def test_group_replicas_synced_by_dcn_tier(self):
+        # after warmup full-sync, all dcn group replicas must be identical
+        mesh = _mesh_4x2()
+        daso = DASO(DataParallelOptimizer("sgd", lr=0.05), mesh=mesh, warmup_steps=3)
+        model = ht.nn.Sequential(ht.nn.Linear(8, 4))
+        daso.init(model, key=jax.random.key(1))
+
+        def loss_fn(pred, y):
+            return jnp.mean((pred - y) ** 2)
+
+        rng = np.random.default_rng(1)
+        for _ in range(3):  # within warmup: full sync every step
+            xb = rng.normal(size=(16, 8)).astype(np.float32)
+            daso.step(loss_fn, jnp.asarray(xb), jnp.asarray(xb @ np.ones((8, 4), np.float32)))
+        leaves = jax.tree.leaves(daso.parameters)
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            for gidx in range(1, arr.shape[0]):
+                np.testing.assert_allclose(arr[gidx], arr[0], rtol=1e-5, atol=1e-6)
