@@ -102,6 +102,23 @@ class DNDarray:
                 if pad:
                     array = comm.pad_shard(array, split)
                     self.__pad = pad
+                elif not isinstance(array, jax.core.Tracer):
+                    # divisible: enforce the split's physical placement too —
+                    # no DNDarray may claim a split its sharding doesn't have
+                    sh = comm.sharding(len(gshape), split)
+                    cur = getattr(array, "sharding", None)
+                    if cur != sh:
+                        try:
+                            equivalent = cur is not None and cur.is_equivalent_to(
+                                sh, len(gshape)
+                            )
+                        except Exception:
+                            equivalent = False
+                        if not equivalent:
+                            from ._complexsafe import guard
+
+                            if guard(array) is None:  # complex-hosted: leave
+                                array = jax.device_put(array, sh)
             else:
                 raise ValueError(
                     f"array shape {ashape} matches neither the logical gshape "

@@ -430,6 +430,11 @@ def save_array_checkpoint(x: DNDarray, directory: str) -> None:
     if not isinstance(x, DNDarray):
         x = factories.array(x)
     os.makedirs(directory, exist_ok=True)
+    for stale in os.listdir(directory):
+        # a reused directory may hold chunks from a different mesh size —
+        # meta.json would mask them, but globbing tools would read stale data
+        if stale.startswith("chunk_") and stale.endswith(".npy"):
+            os.remove(os.path.join(directory, stale))
     split = x.split
     starts = []
     for slices, chunk in _iter_hyperslabs(x):
@@ -494,7 +499,7 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
         return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=split)
 
     sharding = comm.sharding(ndim, split)
-    singles, devs = [], []
+    singles = []
     for d, idx in sharding.addressable_devices_indices_map(pshape).items():
         lo = idx[split].start or 0
         hi = idx[split].stop if idx[split].stop is not None else target
@@ -508,7 +513,6 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
             )
             block[sl] = data
         singles.append(jax.device_put(block, d))
-        devs.append(d)
     arr = jax.make_array_from_single_device_arrays(pshape, sharding, singles)
     return DNDarray(arr, gshape, types.canonical_heat_type(np_dtype), split, dev, comm, True)
 

@@ -1,10 +1,13 @@
 """Signal processing (reference: ``heat/core/signal.py``).
 
-1-D ``convolve`` with full/same/valid modes.  The reference exchanges halos
-(Isend/Irecv with neighbors) and runs local ``torch.conv1d``; here the
-default path is one global XLA convolution (the partitioner materializes the
-boundary exchange), and an explicit shard_map halo path
-(``parallel.halo``) demonstrates the manual-control skeleton.
+1-D ``convolve`` with full/same/valid modes.  Distributed signals take the
+reference's halo path (``DNDarray.get_halo`` + local ``torch.conv1d``,
+SURVEY §5.7): each shard exchanges ``m-1`` boundary elements with its ring
+neighbors (``parallel.halo.halo_exchange`` → ``lax.ppermute``) and runs a
+LOCAL valid-mode XLA conv on ``[halo_prev | block | halo_next]`` — no
+global gather.  A distributed kernel is gathered first (kernels are small;
+same as the reference's ``v`` broadcast).  Replicated signals use one
+global XLA convolution.
 """
 
 from __future__ import annotations
@@ -18,6 +21,9 @@ from .sanitation import sanitize_in
 
 __all__ = ["convolve", "convolve2d"]
 
+# diagnostics: tests assert the halo path actually executes
+_HALO_CONV_RUNS = 0
+
 
 def _conv1d_full(a: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     """Full correlation-free convolution via XLA conv (MXU-eligible)."""
@@ -29,6 +35,44 @@ def _conv1d_full(a: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
         lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)]
     )
     return out.reshape(-1)
+
+
+def _conv1d_valid(x: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    lhs = x.reshape(1, 1, -1)
+    rhs = v[::-1].reshape(1, 1, -1)
+    return jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(0, 0)]
+    ).reshape(-1)
+
+
+def _halo_body(a: DNDarray, jv: jnp.ndarray, offset: int) -> jnp.ndarray:
+    """Per-shard rows ``G[lo+offset : lo+offset+c]`` of the signal's FULL
+    convolution, via halo exchange — the reference's convolve mechanism.
+
+    Each shard extends its block with ``m-1`` neighbor elements on both sides
+    (zeros at the global edges = conv zero-padding; the PHYSICAL padded array
+    is used, whose trailing pad zeros are exactly conv semantics) and runs a
+    local valid conv: ``valid(ext)[i] == G[lo + i]``.  Returns the padded
+    physical result aligned with the signal's shards.
+    """
+    global _HALO_CONV_RUNS
+    from ..parallel.halo import halo_exchange
+
+    comm = a.comm
+    m = jv.shape[0]
+    h = m - 1
+    phys = a._parray.astype(jv.dtype)
+
+    def shard_fn(blk):
+        prev, nxt = halo_exchange(blk, h, comm.axis, comm.size, 0)
+        ext = jnp.concatenate([prev, blk, nxt], axis=0)
+        val = _conv1d_valid(ext, jv)  # c + m - 1 rows: G[lo : lo + c + m - 1]
+        c = blk.shape[0]
+        return jax.lax.dynamic_slice_in_dim(val, offset, c)
+
+    body = comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=(1, 0))(phys)
+    _HALO_CONV_RUNS += 1
+    return body
 
 
 def convolve(a: DNDarray, v: DNDarray, mode: str = "full", stride: int = 1) -> DNDarray:
@@ -55,9 +99,56 @@ def convolve(a: DNDarray, v: DNDarray, mode: str = "full", stride: int = 1) -> D
         work_dt = types.float32
     else:
         work_dt = dt
-    ja = a._jarray.astype(work_dt.jax_dtype())
-    jv = v._jarray.astype(work_dt.jax_dtype())
+    # a distributed kernel is gathered — kernels are small and every shard
+    # needs all of it (reference: Bcast of v)
+    jv = (v.resplit(None) if v.split is not None else v)._jarray.astype(work_dt.jax_dtype())
 
+    comm = a.comm
+    c_blk = comm.padded_extent(n) // comm.size if comm.size else n
+    use_halo = (
+        a.split == 0
+        and comm.is_distributed()
+        and m - 1 <= c_blk  # halo must fit in one neighbor block
+        and m >= 1
+    )
+
+    if use_halo:
+        split = signal.split
+        if mode == "same":
+            body = _halo_body(a, jv, (m - 1) // 2)  # G[lo+(m-1)//2 : …+c] per shard
+            res_d = DNDarray(
+                body, (n,), types.canonical_heat_type(body.dtype), 0,
+                signal.device, comm, True,
+            )
+        else:
+            body = _halo_body(a, jv, 0)  # G[lo : lo+c] per shard → G[0:n]
+            body_d = DNDarray(
+                body, (n,), types.canonical_heat_type(body.dtype), 0,
+                signal.device, comm, True,
+            )
+            if mode == "valid":
+                res_d = body_d[m - 1 : n]
+            else:  # full: append the global tail G[n : n+m-1] (last m-1 rows)
+                if m > 1:
+                    t = a[n - (m - 1) :]._jarray.astype(jv.dtype)
+                    tail = _conv1d_full(t, jv)[m - 1 : 2 * (m - 1)]
+                    res = jnp.concatenate([body_d._jarray, tail])
+                else:
+                    res = body_d._jarray
+                res_d = DNDarray(
+                    res, tuple(res.shape), types.canonical_heat_type(res.dtype), 0,
+                    signal.device, comm, True,
+                )
+        if types.heat_type_is_exact(dt):
+            res_d = DNDarray(
+                jnp.round(res_d._parray).astype(dt.jax_dtype()), res_d.shape,
+                dt, res_d.split, res_d.device, res_d.comm, True,
+            )
+        if res_d.split != split:
+            res_d.resplit_(split)  # result split follows the SIGNAL operand
+        return res_d
+
+    ja = a._jarray.astype(work_dt.jax_dtype())
     full = _conv1d_full(ja, jv)
     if mode == "full":
         res = full
