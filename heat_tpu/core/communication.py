@@ -31,6 +31,7 @@ associative_scan over shards``.
 from __future__ import annotations
 
 import math
+import warnings
 from typing import Optional, Tuple, Union
 
 import jax
@@ -86,7 +87,15 @@ class Communication:
 
     @property
     def rank(self) -> int:
-        """Process index (single-controller: 0). Shard identity: :meth:`axis_index`."""
+        """The PROCESS index — NOT a shard index.
+
+        Single-controller JAX addresses all chips from one process, so this
+        is 0 everywhere today; under multi-process JAX it is the host index
+        (0..n_processes-1), NOT 0..size-1.  Code needing per-shard identity
+        must use :meth:`axis_index` inside ``shard_map`` — reference code
+        that branches on ``comm.rank`` for data placement should consult
+        ``chunk()``/``lshape_map`` instead.
+        """
         return jax.process_index()
 
     @property
@@ -273,6 +282,23 @@ class Communication:
     # functional collectives — valid ONLY inside shard_map over this mesh.
     # These carry the MPI names for discoverability by reference users.
     # ------------------------------------------------------------------ #
+    # mesh size above which gather-based collectives warn (module-level so
+    # tests can lower it; 8 ≈ one host's worth of chips)
+    GATHER_WARN_THRESHOLD = 8
+
+    def _warn_gather_based(self, name: str) -> None:
+        """Perf-trap warning (reference: ``warnings.warn`` on implicit-comm
+        traps, SURVEY §5.5): this collective is implemented via all_gather, so
+        every shard materializes p× the buffer — fine at p≤8, a memory trap at
+        pod scale.  Warned at trace time."""
+        if self.size > Communication.GATHER_WARN_THRESHOLD:
+            warnings.warn(
+                f"Communication.{name} is gather-based: each shard holds "
+                f"size×buffer = {self.size}× the payload. At this mesh size "
+                "prefer psum/reduce_scatter formulations.",
+                stacklevel=3,
+            )
+
     def Allreduce(self, x, op: str = "sum"):
         ops = {
             "sum": lax.psum,
@@ -284,6 +310,7 @@ class Communication:
             if op == "prod":
                 # sign-safe product: all_gather then reduce (log-sum only
                 # works for strictly positive inputs)
+                self._warn_gather_based("Allreduce(op='prod')")
                 return jnp.prod(
                     lax.all_gather(x, self.__axis, axis=0, tiled=False), axis=0
                 )
@@ -301,7 +328,10 @@ class Communication:
         )
 
     def Bcast(self, x, root: int = 0):
-        """Every shard receives shard ``root``'s block."""
+        """Every shard receives shard ``root``'s block.
+
+        O(p)-memory: gather-based (see ``_warn_gather_based``)."""
+        self._warn_gather_based("Bcast")
         full = lax.all_gather(x, self.__axis, axis=0, tiled=False)
         return full[root]
 
@@ -315,7 +345,10 @@ class Communication:
         return lax.psum_scatter(x, self.__axis, scatter_dimension=axis, tiled=True)
 
     def Exscan(self, x):
-        """Exclusive prefix sum across shards (reference ``comm.Exscan``)."""
+        """Exclusive prefix sum across shards (reference ``comm.Exscan``).
+
+        O(p)-memory: gather-based (see ``_warn_gather_based``)."""
+        self._warn_gather_based("Exscan")
         idx = lax.axis_index(self.__axis)
         gathered = lax.all_gather(x, self.__axis, axis=0, tiled=False)
         n = self.size
@@ -333,7 +366,9 @@ class Communication:
         return jnp.where(mine, red, jnp.zeros_like(red))
 
     def Scatter(self, x, root: int = 0, axis: int = 0):
-        """Shard ``root``'s block, split along ``axis``, one piece per shard."""
+        """Shard ``root``'s block, split along ``axis``, one piece per shard.
+
+        O(p)-memory: routes through the gather-based ``Bcast``."""
         src = self.Bcast(x, root=root)
         n = self.size
         idx = lax.axis_index(self.__axis)
@@ -342,7 +377,11 @@ class Communication:
 
     def Gather(self, x, root: int = 0, axis: int = 0):
         """All blocks concatenated on shard ``root`` (others receive the same
-        buffer zeroed — SPMD equivalence of the MPI rooted gather)."""
+        buffer zeroed — SPMD equivalence of the MPI rooted gather).
+
+        O(p)-memory by definition (every shard materializes the gathered
+        buffer before root-masking); see ``_warn_gather_based``."""
+        self._warn_gather_based("Gather")
         full = lax.all_gather(x, self.__axis, axis=axis, tiled=True)
         mine = lax.axis_index(self.__axis) == root
         return jnp.where(mine, full, jnp.zeros_like(full))

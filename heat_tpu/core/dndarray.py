@@ -97,34 +97,45 @@ class DNDarray:
             expect_logical = gshape
             expect_physical = gshape[:split] + (target,) + gshape[split + 1 :]
             if ashape == expect_physical and pad:
-                self.__pad = pad  # caller already provides the padded physical
+                # caller provides the padded physical — still enforce placement
+                self.__pad = pad
+                array = self._enforce_placement(array, comm, split)
             elif ashape == expect_logical:
                 if pad:
                     array = comm.pad_shard(array, split)
                     self.__pad = pad
-                elif not isinstance(array, jax.core.Tracer):
-                    # divisible: enforce the split's physical placement too —
-                    # no DNDarray may claim a split its sharding doesn't have
-                    sh = comm.sharding(len(gshape), split)
-                    cur = getattr(array, "sharding", None)
-                    if cur != sh:
-                        try:
-                            equivalent = cur is not None and cur.is_equivalent_to(
-                                sh, len(gshape)
-                            )
-                        except Exception:
-                            equivalent = False
-                        if not equivalent:
-                            from ._complexsafe import guard
-
-                            if guard(array) is None:  # complex-hosted: leave
-                                array = jax.device_put(array, sh)
+                else:
+                    array = self._enforce_placement(array, comm, split)
             else:
                 raise ValueError(
                     f"array shape {ashape} matches neither the logical gshape "
                     f"{expect_logical} nor the padded physical shape {expect_physical}"
                 )
         self.__array = array
+
+    @staticmethod
+    def _enforce_placement(array, comm, split):
+        """No DNDarray may claim a split its sharding doesn't have: place
+        concrete arrays on the canonical sharding unless already equivalent.
+        Hosted-complex arrays (transport without native complex) stay on the
+        host backend; tracers are left to the surrounding jit."""
+        if isinstance(array, jax.core.Tracer):
+            return array
+        sh = comm.sharding(array.ndim, split)
+        cur = getattr(array, "sharding", None)
+        if cur == sh:
+            return array
+        try:
+            if cur is not None and cur.is_equivalent_to(sh, array.ndim):
+                return array
+        except Exception:
+            pass
+        from ._complexsafe import guard
+
+        hosted = guard(array)
+        if hosted is not None:
+            return hosted  # complex on a non-native transport: keep host-side
+        return jax.device_put(array, sh)
 
     # ------------------------------------------------------------------ #
     # internal access
@@ -217,7 +228,15 @@ class DNDarray:
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Shape of this process's first shard (reference: this rank's chunk)."""
+        """Shape of shard 0's chunk (reference: "this rank's chunk").
+
+        Single-controller semantics: there is ONE process addressing all
+        shards, so "local" is a convention — this reports the FIRST shard's
+        valid extent from the canonical ceil-div chunk map.  Per-shard truth
+        for every shard is ``lshape_map()``; for ragged shapes the shards
+        differ (e.g. 100 rows on 8 devices → 13,…,13,9) and ``lshape`` alone
+        cannot describe them all.
+        """
         _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
         return lshape
 

@@ -430,25 +430,41 @@ def save_array_checkpoint(x: DNDarray, directory: str) -> None:
     if not isinstance(x, DNDarray):
         x = factories.array(x)
     os.makedirs(directory, exist_ok=True)
-    for stale in os.listdir(directory):
-        # a reused directory may hold chunks from a different mesh size —
-        # meta.json would mask them, but globbing tools would read stale data
-        if stale.startswith("chunk_") and stale.endswith(".npy"):
-            os.remove(os.path.join(directory, stale))
+    # crash-safe layout: each save goes into a fresh v<k>/ subdirectory and
+    # LATEST is flipped atomically afterwards — an interrupted re-save can
+    # never destroy the previous checkpoint (old version + old LATEST stay
+    # intact until the new version is complete); older versions are pruned
+    # only after the flip
+    existing = [
+        int(d[1:]) for d in os.listdir(directory)
+        if d.startswith("v") and d[1:].isdigit()
+        and os.path.isdir(os.path.join(directory, d))
+    ]
+    version = max(existing, default=-1) + 1
+    vdir = os.path.join(directory, f"v{version}")
+    os.makedirs(vdir, exist_ok=True)
     split = x.split
     starts = []
     for slices, chunk in _iter_hyperslabs(x):
         start = slices[split].start if split is not None else 0
         starts.append(int(start))
-        np.save(os.path.join(directory, f"chunk_{start}.npy"), chunk)
+        np.save(os.path.join(vdir, f"chunk_{start}.npy"), chunk)
     meta = {
         "gshape": list(x.shape),
         "dtype": str(x.dtype.np_dtype().name),
         "split": split,
         "starts": sorted(starts),
     }
-    with open(os.path.join(directory, "meta.json"), "w") as fh:
+    with open(os.path.join(vdir, "meta.json"), "w") as fh:
         json.dump(meta, fh)
+    tmp = os.path.join(directory, ".LATEST.tmp")
+    with open(tmp, "w") as fh:
+        fh.write(f"v{version}")
+    os.replace(tmp, os.path.join(directory, "LATEST"))  # atomic flip
+    for old in existing:
+        import shutil
+
+        shutil.rmtree(os.path.join(directory, f"v{old}"), ignore_errors=True)
 
 
 def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
@@ -463,6 +479,10 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
     """
     import jax
 
+    latest = os.path.join(directory, "LATEST")
+    if os.path.exists(latest):
+        with open(latest) as fh:
+            directory = os.path.join(directory, fh.read().strip())
     with open(os.path.join(directory, "meta.json")) as fh:
         meta = json.load(fh)
     gshape = tuple(meta["gshape"])

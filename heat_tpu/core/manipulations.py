@@ -422,7 +422,17 @@ def repeat(x: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
 
 def reshape(x: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> DNDarray:
     """Reshape; the reference redistributes via Alltoallv on flattened index
-    math — XLA derives the equivalent collective from the sharding change."""
+    math — XLA derives the equivalent collective from the sharding change.
+
+    OUTPUT-SPLIT RULE (documented, deliberate): unless ``new_split`` is
+    given, a previously-split input comes back split along the SAME axis
+    index if it still exists in the new shape, else along axis 0 — NOT along
+    "whichever output axis inherited the data".  Deriving the inherited axis
+    is ill-defined for general reshapes (axes merge and split); the fixed
+    rule is predictable but means a reshape can be an implicit all-to-all.
+    Pass ``new_split=`` to choose the output distribution explicitly and
+    avoid a surprise reshard.
+    """
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     shape = tuple(int(s) for s in shape)

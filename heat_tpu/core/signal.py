@@ -61,7 +61,9 @@ def _halo_body(a: DNDarray, jv: jnp.ndarray, offset: int) -> jnp.ndarray:
     comm = a.comm
     m = jv.shape[0]
     h = m - 1
-    phys = a._parray.astype(jv.dtype)
+    # pads are DEAD data, not guaranteed zero (elementwise fast paths leave
+    # f(0) garbage there) — mask to the conv zero-padding this path relies on
+    phys = a._masked(0).astype(jv.dtype)
 
     def shard_fn(blk):
         prev, nxt = halo_exchange(blk, h, comm.axis, comm.size, 0)
@@ -103,13 +105,18 @@ def convolve(a: DNDarray, v: DNDarray, mode: str = "full", stride: int = 1) -> D
     # needs all of it (reference: Bcast of v)
     jv = (v.resplit(None) if v.split is not None else v)._jarray.astype(work_dt.jax_dtype())
 
+    from . import _complexsafe
+
     comm = a.comm
     c_blk = comm.padded_extent(n) // comm.size if comm.size else n
+    is_hosted_complex = jnp.issubdtype(
+        work_dt.jax_dtype(), jnp.complexfloating
+    ) and not _complexsafe.native_complex_supported()
     use_halo = (
         a.split == 0
         and comm.is_distributed()
         and m - 1 <= c_blk  # halo must fit in one neighbor block
-        and m >= 1
+        and not is_hosted_complex  # host-resident complex cannot ride shard_map
     )
 
     if use_halo:

@@ -118,11 +118,43 @@ class TestArrayCheckpoint(TestCase):
         ht.save_array_checkpoint(x, ckpt)
         assert htio._CHUNK_WRITES["count"] == p
         assert htio._CHUNK_WRITES["max_bytes"] <= d.nbytes // p
-        files = [f for f in os.listdir(ckpt) if f.startswith("chunk_")]
+        vdir = os.path.join(ckpt, open(os.path.join(ckpt, "LATEST")).read().strip())
+        files = [f for f in os.listdir(vdir) if f.startswith("chunk_")]
         assert len(files) == p
         back = ht.load_array_checkpoint(ckpt)
         assert back.split == 0
         self.assert_array_equal(back, d)
+
+    def test_resave_crash_safety(self, tmp_path):
+        # a completed re-save prunes old versions; an INTERRUPTED one (dead
+        # v-dir, LATEST still on the old version) must leave loads intact
+        d1 = np.arange(16, dtype=np.float32)
+        d2 = np.arange(16, 32, dtype=np.float32)
+        ckpt = str(tmp_path / "safe")
+        ht.save_array_checkpoint(ht.array(d1, split=0), ckpt)
+        ht.save_array_checkpoint(ht.array(d2, split=0), ckpt)
+        versions = [f for f in os.listdir(ckpt) if f.startswith("v")]
+        assert len(versions) == 1, f"old versions not pruned: {versions}"
+        self.assert_array_equal(ht.load_array_checkpoint(ckpt), d2)
+        # simulate a crashed save: half-written v-dir without LATEST flip
+        os.makedirs(os.path.join(ckpt, "v99"))
+        np.save(os.path.join(ckpt, "v99", "chunk_0.npy"), d1[:2])
+        self.assert_array_equal(ht.load_array_checkpoint(ckpt), d2)
+
+    def test_pad_garbage_does_not_leak_into_convolve(self):
+        # a ragged array whose pad region holds nonzero garbage (elementwise
+        # fast paths leave f(0) there) must still convolve correctly — the
+        # halo path masks pads to the conv zero-padding on entry
+        n, m = 37, 4
+        rng = np.random.default_rng(12)
+        an = rng.uniform(1.0, 2.0, n).astype(np.float32)
+        x = ht.array(an, split=0)
+        y = ht.exp(x)  # pad region now exp(0)=1, not 0
+        r = ht.convolve(y, ht.array(np.ones(m, np.float32)), mode="same")
+        self.assert_array_equal(r, np.convolve(np.exp(an), np.ones(m), mode="same"), rtol=1e-4)
+        r2 = ht.convolve(r, ht.array(np.ones(m, np.float32)), mode="same")
+        want2 = np.convolve(np.convolve(np.exp(an), np.ones(m), "same"), np.ones(m), "same")
+        self.assert_array_equal(r2, want2, rtol=1e-4)
 
     def test_roundtrip_ragged(self, tmp_path):
         rng = np.random.default_rng(3)

@@ -1,0 +1,100 @@
+"""Truthfulness pass (VERDICT r2 item 8): perf-trap warnings on gather-based
+rooted collectives, the documented reshape output-split rule, and the
+single-controller rank/lshape semantics."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.communication import Communication
+from test_suites.basic_test import TestCase
+
+
+class TestGatherTrapWarnings(TestCase):
+    def _run_collective(self, fn_name, comm):
+        fn = getattr(comm, fn_name)
+        mapped = comm.shard_map(
+            lambda b: fn(b) if fn_name != "Allreduce_prod" else None,
+            in_splits=((2, 0),),
+            out_splits=(2, None) if fn_name == "Bcast" else (2, 0),
+        )
+        return mapped
+
+    def test_warns_above_threshold(self):
+        comm = ht.communication.get_comm()
+        old = Communication.GATHER_WARN_THRESHOLD
+        Communication.GATHER_WARN_THRESHOLD = 2  # 8-device mesh now "large"
+        try:
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                x = jnp.ones((8, 4))
+                comm.shard_map(
+                    lambda b: comm.Bcast(b), in_splits=((2, 0),), out_splits=(2, 0)
+                )(x)
+                comm.shard_map(
+                    lambda b: comm.Gather(b), in_splits=((2, 0),), out_splits=(2, 0)
+                )(x)
+                comm.shard_map(
+                    lambda b: comm.Exscan(b), in_splits=((2, 0),), out_splits=(2, 0)
+                )(x)
+                comm.shard_map(
+                    lambda b: comm.Allreduce(b, op="prod"),
+                    in_splits=((2, 0),),
+                    out_splits=(2, 0),
+                )(x)
+            msgs = [str(w.message) for w in rec if "gather-based" in str(w.message)]
+            for name in ("Bcast", "Gather", "Exscan", "Allreduce(op='prod')"):
+                assert any(name in m for m in msgs), f"no perf-trap warning for {name}"
+        finally:
+            Communication.GATHER_WARN_THRESHOLD = old
+
+    def test_silent_at_default_threshold(self):
+        comm = ht.communication.get_comm()  # size 8 == threshold: no warning
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            comm.shard_map(
+                lambda b: comm.Bcast(b), in_splits=((2, 0),), out_splits=(2, 0)
+            )(jnp.ones((8, 4)))
+        assert not [w for w in rec if "gather-based" in str(w.message)]
+
+
+class TestReshapeSplitRule(TestCase):
+    def test_same_axis_index_kept(self):
+        d = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(d, split=1)
+        y = ht.reshape(x, (6, 4))
+        assert y.split == 1  # SAME axis index, per the documented rule
+        self.assert_array_equal(y, d.reshape(6, 4))
+
+    def test_vanished_axis_falls_to_zero(self):
+        d = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(d, split=1)
+        y = ht.reshape(x, (24,))
+        assert y.split == 0
+        self.assert_array_equal(y, d.reshape(24))
+
+    def test_explicit_new_split_honored(self):
+        d = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(d, split=0)
+        y = ht.reshape(x, (2, 12), new_split=1)
+        assert y.split == 1
+        self.assert_array_equal(y, d.reshape(2, 12))
+
+
+class TestSingleControllerSemantics(TestCase):
+    def test_rank_is_process_index(self):
+        comm = ht.communication.get_comm()
+        assert comm.rank == jax.process_index()
+        assert comm.n_processes == jax.process_count()
+        assert comm.size == 8  # shards ≠ processes
+
+    def test_lshape_is_shard0_chunk(self):
+        x = ht.zeros((100, 16), split=0)
+        assert x.lshape == (13, 16)  # ceil-div chunk of shard 0
+        lmap = x.lshape_map()
+        assert lmap[:, 0].sum() == 100  # per-shard truth sums to the extent
+        assert list(lmap[:, 0]) == [13, 13, 13, 13, 13, 13, 13, 9]
