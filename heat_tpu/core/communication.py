@@ -327,11 +327,11 @@ class Communication:
             x, self.__axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
         )
 
-    def Bcast(self, x, root: int = 0):
+    def Bcast(self, x, root: int = 0, *, _warn_as: str = "Bcast"):
         """Every shard receives shard ``root``'s block.
 
         O(p)-memory: gather-based (see ``_warn_gather_based``)."""
-        self._warn_gather_based("Bcast")
+        self._warn_gather_based(_warn_as)
         full = lax.all_gather(x, self.__axis, axis=0, tiled=False)
         return full[root]
 
@@ -369,7 +369,7 @@ class Communication:
         """Shard ``root``'s block, split along ``axis``, one piece per shard.
 
         O(p)-memory: routes through the gather-based ``Bcast``."""
-        src = self.Bcast(x, root=root)
+        src = self.Bcast(x, root=root, _warn_as="Scatter")
         n = self.size
         idx = lax.axis_index(self.__axis)
         piece = src.shape[axis] // n

@@ -461,10 +461,18 @@ def save_array_checkpoint(x: DNDarray, directory: str) -> None:
     with open(tmp, "w") as fh:
         fh.write(f"v{version}")
     os.replace(tmp, os.path.join(directory, "LATEST"))  # atomic flip
-    for old in existing:
-        import shutil
+    import shutil
 
+    for old in existing:
         shutil.rmtree(os.path.join(directory, f"v{old}"), ignore_errors=True)
+    # legacy flat-format files (pre-versioned layout) stay valid until the
+    # flip, then must go: globbing consumers would read stale data
+    for legacy in os.listdir(directory):
+        if (legacy.startswith("chunk_") and legacy.endswith(".npy")) or legacy == "meta.json":
+            try:
+                os.remove(os.path.join(directory, legacy))
+            except OSError:
+                pass
 
 
 def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:

@@ -159,11 +159,24 @@ def matmul_summa(a: DNDarray, b: DNDarray) -> DNDarray:
         (acc, _), _ = lax.scan(step, (acc0, b_blk), jnp.arange(size))
         return acc
 
-    if K % size != 0 or M % size != 0:
-        # fall back to the GSPMD path for ragged shards
-        return matmul(a0, b0)
+    Kp = comm.padded_extent(K)
+    Mp = comm.padded_extent(M)
+    ja, jb = a0._jarray, b0._jarray
+    if Mp != M or Kp != K:
+        # ragged shards: zero-pad to the mesh grid (pad-and-mask) — zero
+        # K-rows contribute nothing to the contraction and the dead M-rows
+        # are sliced off below; the ring algorithm runs unchanged
+        ja = jnp.pad(ja, ((0, Mp - M), (0, Kp - K)))
+        jb = jnp.pad(jb, ((0, Kp - K), (0, 0)))
     mapped = comm.shard_map(shard_fn, in_splits=((2, 0), (2, 0)), out_splits=(2, 0))
-    res = mapped(a0._jarray, b0._jarray)
+    res = mapped(ja, jb)
+    if Mp != M:
+        # keep the padded physical: the constructor records pad=(Mp-M) and
+        # the result stays fully sharded with no unpad round-trip
+        return DNDarray(
+            res, (M, N), types.canonical_heat_type(res.dtype), 0,
+            a.device, comm, True,
+        )
     return _wrap(res, 0, a)
 
 

@@ -52,13 +52,25 @@ def tsqr(a: DNDarray, mode: str = "reduced") -> QR:
         q = q1 @ q2_blk
         return q, r
 
-    if m % size != 0 or (m // size) < n:
-        # ragged or not-tall-enough shards: replicated QR fallback
+    # ragged rows ride the pad-and-mask layout: QR of a zero-padded block is
+    # exact ([X; 0] = [Q; 0]·R — zero rows stay zero under Householder), so
+    # the distributed path serves any m as long as each padded block is tall
+    phys = a0._masked(0)  # pads must BE zero, not dead garbage
+    c = phys.shape[0] // size
+    if c < n:
+        # not-tall-enough shards: replicated QR fallback
         jq, jr = jnp.linalg.qr(a0._jarray, mode="reduced")
         return QR(_wrap(jq, 0, a), _wrap(jr, None, a))
 
     mapped = comm.shard_map(shard_fn, in_splits=((2, 0),), out_splits=((2, 0), (2, None)))
-    jq, jr = mapped(a0._jarray)
+    jq, jr = mapped(phys)
+    if phys.shape[0] != m:
+        # Q's pad rows are exactly zero; keep the padded physical (pad=Mp-m)
+        q_d = DNDarray(
+            jq, (m, jq.shape[1]), types.canonical_heat_type(jq.dtype), 0,
+            a.device, comm, True,
+        )
+        return QR(q_d, _wrap(jr, None, a))
     return QR(_wrap(jq, 0, a), _wrap(jr, None, a))
 
 

@@ -2,14 +2,6 @@
 
 from .dcsr_matrix import DCSR_matrix
 from .factories import sparse_csr_matrix, sparse_csc_matrix
-from ._arithmetics import add, mul
-
-
-def todense(sparse_matrix: DCSR_matrix):
-    """Densify a distributed CSR matrix into a DNDarray (reference parity:
-    ``heat.sparse.todense``)."""
-    return sparse_matrix.todense()
-
-
-def to_dense(sparse_matrix: DCSR_matrix):
-    return sparse_matrix.todense()
+from ._arithmetics import add, mul, sub, negative
+from .manipulations import todense, to_dense, to_sparse, transpose
+from . import manipulations

@@ -8,7 +8,7 @@ from jax.experimental import sparse as jsparse
 from ..core import types
 from .dcsr_matrix import DCSR_matrix
 
-__all__ = ["add", "mul"]
+__all__ = ["add", "mul", "sub", "negative"]
 
 
 def _binary(t1: DCSR_matrix, t2: DCSR_matrix, densify_op=None) -> DCSR_matrix:
@@ -32,10 +32,39 @@ def add(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
     return _binary(t1, t2)
 
 
-def mul(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
-    """Elementwise sparse * sparse (intersection of patterns)."""
+def _scale(t: DCSR_matrix, s) -> DCSR_matrix:
+    """Scalar multiply: scales the stored values, pattern unchanged."""
+    if jnp.ndim(s) != 0:
+        raise TypeError(
+            f"sparse ops accept DCSR_matrix or scalar operands, got array of "
+            f"shape {jnp.shape(s)}"
+        )
+    arr = jsparse.BCOO((t.larray.data * s, t.larray.indices), shape=t.larray.shape)
+    dt = types.canonical_heat_type(arr.data.dtype)
+    return DCSR_matrix(arr, t.gnnz, t.shape, dt, t.split, t.device, t.comm, True)
+
+
+def mul(t1: DCSR_matrix, t2) -> DCSR_matrix:
+    """Elementwise sparse * sparse (pattern intersection) or sparse * scalar."""
+    if not isinstance(t2, DCSR_matrix):
+        return _scale(t1, t2)
     return _binary(t1, t2, jnp.multiply)
+
+
+def negative(t: DCSR_matrix) -> DCSR_matrix:
+    return _scale(t, -1)
+
+
+def sub(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
+    """Elementwise sparse - sparse (union of patterns)."""
+    if not isinstance(t2, DCSR_matrix):
+        raise TypeError("sparse binary ops require DCSR_matrix operands")
+    return _binary(t1, negative(t2))
 
 
 DCSR_matrix.__add__ = add
 DCSR_matrix.__mul__ = mul
+DCSR_matrix.__rmul__ = mul
+DCSR_matrix.__sub__ = sub
+DCSR_matrix.__neg__ = negative
+DCSR_matrix.__truediv__ = lambda t, s: _scale(t, 1.0 / s)

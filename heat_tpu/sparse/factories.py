@@ -20,6 +20,26 @@ def sparse_csr_matrix(obj, dtype=None, split: Optional[int] = None, is_split=Non
                       device=None, comm=None) -> DCSR_matrix:
     """Build a DCSR_matrix from scipy.sparse, dense arrays, or (data, indices,
     indptr) — mirrors the reference factory's accepted inputs."""
+    from ..core.dndarray import DNDarray
+
+    if isinstance(obj, DNDarray):
+        # dense DNDarray in: sparsify on-device, inherit split/comm/device;
+        # conflicting placement kwargs are rejected BEFORE conversion work
+        from .manipulations import to_sparse
+
+        want = split if split is not None else is_split
+        if want is not None and want != obj.split:
+            raise ValueError(
+                "sparse_csr_matrix cannot re-split a DNDarray input "
+                f"(array split={obj.split}, requested {want}); resplit the "
+                "dense array first"
+            )
+        if comm is not None and comm != obj.comm:
+            raise ValueError("sparse_csr_matrix cannot rebind a DNDarray to a different comm")
+        if device is not None and ht_devices.sanitize_device(device) != obj.device:
+            raise ValueError("sparse_csr_matrix cannot move a DNDarray to a different device")
+        return to_sparse(obj if dtype is None else obj.astype(dtype))
+
     comm = sanitize_comm(comm)
     device = ht_devices.sanitize_device(device)
     if split is None and is_split is not None:

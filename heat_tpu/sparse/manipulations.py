@@ -1,0 +1,67 @@
+"""Sparse manipulations (reference: ``heat/sparse/manipulations.py``).
+
+Conversions between dense DNDarrays and distributed CSR, and pattern-level
+transforms.  Sparsification runs on-device (``BCOO.fromdense`` lowers to XLA
+scatter/gather); the split metadata follows the dense operand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["todense", "to_dense", "to_sparse", "transpose"]
+
+
+def todense(sparse_matrix: DCSR_matrix) -> DNDarray:
+    """Densify a distributed CSR matrix into a DNDarray."""
+    return sparse_matrix.todense()
+
+
+def to_dense(sparse_matrix: DCSR_matrix) -> DNDarray:
+    return sparse_matrix.todense()
+
+
+def to_sparse(x: DNDarray) -> DCSR_matrix:
+    """Sparsify a dense 2-D DNDarray into a DCSR_matrix (reference
+    ``heat.sparse.to_sparse``); the row split carries over."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"to_sparse expects a DNDarray, got {type(x)}")
+    if x.ndim != 2:
+        raise ValueError("to_sparse requires a 2-D DNDarray")
+    if x.split not in (None, 0):
+        raise ValueError(
+            "DCSR is row-split only (split ∈ {None, 0}, reference CSR "
+            f"constraint); resplit the dense array first (got split={x.split})"
+        )
+    arr = jsparse.BCOO.fromdense(x._jarray)
+    return DCSR_matrix(
+        arr, int(arr.nse), x.shape, x.dtype, x.split, x.device, x.comm, True
+    )
+
+
+def transpose(sparse_matrix: DCSR_matrix) -> DCSR_matrix:
+    """Transpose a DCSR matrix (COO index swap; a row split becomes
+    unrepresentable after transposition — result is split=None, matching the
+    reference's CSR-rows-only constraint)."""
+    bcoo = sparse_matrix.larray
+    swapped = jsparse.BCOO(
+        (bcoo.data, bcoo.indices[:, ::-1]),
+        shape=(bcoo.shape[1], bcoo.shape[0]),
+    ).sum_duplicates()
+    return DCSR_matrix(
+        swapped,
+        sparse_matrix.gnnz,
+        (sparse_matrix.shape[1], sparse_matrix.shape[0]),
+        sparse_matrix.dtype,
+        None,
+        sparse_matrix.device,
+        sparse_matrix.comm,
+        True,
+    )

@@ -182,6 +182,53 @@ class TestSparse(TestCase):
             (s @ v).numpy(), self.scipy_mat.toarray() @ v.numpy(), atol=1e-4
         )
 
+    def test_sub_neg_scalar_ops(self):
+        d = self.scipy_mat.toarray()
+        s1 = ht.sparse.sparse_csr_matrix(self.scipy_mat)
+        s2 = ht.sparse.sparse_csr_matrix(self.scipy_mat * 0.5)
+        np.testing.assert_allclose((s1 - s2).todense().numpy(), 0.5 * d, atol=1e-5)
+        np.testing.assert_allclose((-s1).todense().numpy(), -d, atol=1e-6)
+        np.testing.assert_allclose((s1 * 3.0).todense().numpy(), 3 * d, atol=1e-5)
+        np.testing.assert_allclose((2.0 * s1).todense().numpy(), 2 * d, atol=1e-5)
+        np.testing.assert_allclose((s1 / 2.0).todense().numpy(), d / 2, atol=1e-5)
+
+    def test_to_sparse_roundtrip(self):
+        d = self.scipy_mat.toarray()
+        x = ht.array(d, split=0)
+        s = ht.sparse.to_sparse(x)
+        assert s.split == 0
+        assert s.nnz == self.scipy_mat.nnz
+        back = s.todense()
+        assert back.split == 0
+        self.assert_array_equal(back, d)
+        # factory accepts a dense DNDarray and inherits its split
+        s2 = ht.sparse.sparse_csr_matrix(x)
+        assert s2.split == 0
+        np.testing.assert_allclose(s2.todense().numpy(), d)
+
+    def test_invalid_operands_raise(self):
+        import pytest as _pytest
+
+        s = ht.sparse.sparse_csr_matrix(self.scipy_mat)
+        with _pytest.raises(TypeError):
+            s * np.full(2, 3.0)  # array is not a scalar
+        with _pytest.raises(TypeError):
+            s - 2.0  # sparse - scalar is not defined
+        with _pytest.raises(ValueError):
+            ht.sparse.to_sparse(ht.array(self.scipy_mat.toarray(), split=1))
+        with _pytest.raises(ValueError):
+            ht.sparse.sparse_csr_matrix(
+                ht.array(self.scipy_mat.toarray(), split=0), split=1
+            )
+
+    def test_transpose(self):
+        d = self.scipy_mat.toarray()
+        s = ht.sparse.sparse_csr_matrix(self.scipy_mat, split=0)
+        st = ht.sparse.transpose(s)
+        assert st.shape == (8, 16)
+        assert st.split is None  # CSR-rows-only: transposed split unrepresentable
+        np.testing.assert_allclose(st.todense().numpy(), d.T, atol=1e-6)
+
 
 class TestTiling(TestCase):
     def test_split_tiles(self):

@@ -152,6 +152,25 @@ class TestRaggedOps(TestCase):
                 y = make(b, sb, comm)
                 self.assert_array_equal(x @ y, a @ b, rtol=1e-3, atol=1e-3)
 
+    def test_tsqr_ragged_distributed(self, p):
+        comm = sub_comm(p)
+        a = self.data((29, 3))  # ragged rows, still tall per padded block
+        q, r = ht.linalg.qr(make(a, 0, comm))
+        assert q.split == 0 and q.shape == (29, 3)
+        np.testing.assert_allclose((q @ r).numpy(), a, atol=1e-3)
+        qn = q.numpy()
+        np.testing.assert_allclose(qn.T @ qn, np.eye(3), atol=1e-3)
+        if p > 1:
+            assert len(q._parray.sharding.device_set) == p
+
+    def test_matmul_summa_ragged(self, p):
+        comm = sub_comm(p)
+        a = self.data((13, 9))
+        b = self.data((9, 5))
+        r = ht.linalg.matmul_summa(make(a, 0, comm), make(b, 0, comm))
+        assert r.split == 0
+        self.assert_array_equal(r, a @ b, rtol=1e-3, atol=1e-3)
+
     def test_getitem_setitem(self, p):
         comm = sub_comm(p)
         d = self.data((26, 6))

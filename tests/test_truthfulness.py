@@ -15,15 +15,6 @@ from test_suites.basic_test import TestCase
 
 
 class TestGatherTrapWarnings(TestCase):
-    def _run_collective(self, fn_name, comm):
-        fn = getattr(comm, fn_name)
-        mapped = comm.shard_map(
-            lambda b: fn(b) if fn_name != "Allreduce_prod" else None,
-            in_splits=((2, 0),),
-            out_splits=(2, None) if fn_name == "Bcast" else (2, 0),
-        )
-        return mapped
-
     def test_warns_above_threshold(self):
         comm = ht.communication.get_comm()
         old = Communication.GATHER_WARN_THRESHOLD
