@@ -203,13 +203,46 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         return prog
 
     def fit(self, x: DNDarray):
-        """Lloyd iteration — one fused sharded XLA program per fit."""
+        """Lloyd iteration — one fused sharded XLA program per fit.
+
+        Row-split inputs on a multi-device mesh take the shard_map path
+        (per-shard blocked E+M + psum of the (k,d)/(k,) statistics — X never
+        crosses chips); otherwise the global GSPMD program runs.
+        """
         from ..core.sanitation import sanitize_in
 
         sanitize_in(x)
         self._initialize_cluster_centers(x)
-        jx = x._jarray
         centers0 = self._cluster_centers._jarray
+        n = x.shape[0]
+        use_sharded = (
+            getattr(self, "_supports_sharded_fit", False)
+            and x.split == 0
+            and x.comm.is_distributed()
+        )
+        if use_sharded:
+            prog = self._fit_program_sharded(x.comm)
+            centers, labels_phys, inertia, n_iter = prog(
+                x._masked(0),  # pads must be zero, not dead garbage
+                centers0,
+                jnp.asarray(n),
+                jnp.asarray(self.max_iter),
+                jnp.asarray(self.tol, centers0.dtype),
+            )
+            n_iter = int(n_iter)
+            self._cluster_centers = DNDarray(
+                x.comm.shard(centers, None), tuple(centers.shape), x.dtype, None,
+                x.device, x.comm, True,
+            )
+            self._labels = DNDarray(
+                labels_phys, (n,), types.canonical_heat_type(labels_phys.dtype),
+                0, x.device, x.comm, True,
+            )
+            self._inertia = float(inertia)
+            self._n_iter = n_iter
+            return self
+
+        jx = x._jarray
         centers, labels, inertia, n_iter = self._fit_program()(
             jx, centers0, jnp.asarray(self.max_iter), jnp.asarray(self.tol, centers0.dtype)
         )

@@ -221,6 +221,28 @@ class TestRaggedOps(TestCase):
         self.assert_array_equal(ht.var(x, axis=0), d.var(0), rtol=1e-3, atol=1e-4)
         self.assert_array_equal(ht.median(x, axis=0), np.median(d, 0), rtol=1e-4)
 
+    def test_kmeans_sharded_matches_global(self, p):
+        # the shard_map fit (per-shard E+M + psum of stats) must produce the
+        # SAME centers as the single-device global program
+        comm = sub_comm(p)
+        rng = np.random.default_rng(11)
+        d = rng.normal(size=(85, 4)).astype(np.float32)
+        km_d = ht.cluster.KMeans(n_clusters=5, max_iter=15, tol=0.0, random_state=2, init="random")
+        km_d.fit(make(d, 0, comm))
+        comm1 = sub_comm(1)
+        km_s = ht.cluster.KMeans(n_clusters=5, max_iter=15, tol=0.0, random_state=2, init="random")
+        km_s.fit(make(d, 0, comm1))
+        np.testing.assert_allclose(
+            km_d.cluster_centers_.numpy(), km_s.cluster_centers_.numpy(), rtol=1e-4, atol=1e-4
+        )
+        # labels may flip for near-bisector points (two float32 programs);
+        # require near-total agreement rather than bit equality
+        agree = (km_d.labels_.numpy() == km_s.labels_.numpy()).mean()
+        assert agree >= 0.98, f"label agreement {agree}"
+        assert abs(km_d.inertia_ - km_s.inertia_) < 1e-2 * max(1.0, km_s.inertia_)
+        if p > 1:
+            assert len(km_d.labels_._parray.sharding.device_set) == p
+
     def test_kmeans_ragged(self, p):
         comm = sub_comm(p)
         rng = np.random.default_rng(3)
