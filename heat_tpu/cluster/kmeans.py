@@ -154,10 +154,16 @@ class KMeans(_KCluster):
         compile."""
         cache = cls.__dict__.get("_FIT_SHARDED")
         if cache is None:
-            cache = {}
+            # weak keys: a Communication's compiled program (which pins its
+            # mesh + XLA executable) must die with the comm, not with the class
+            import weakref
+
+            cache = weakref.WeakKeyDictionary()
             cls._FIT_SHARDED = cache
-        key = (comm, _KCluster._ASSIGN_BLOCK)
-        prog = cache.get(key)
+        per_comm = cache.get(comm)
+        if per_comm is None:
+            per_comm = cache[comm] = {}
+        prog = per_comm.get(_KCluster._ASSIGN_BLOCK)
         if prog is not None:
             return prog
         axis = comm.axis
@@ -200,5 +206,5 @@ class KMeans(_KCluster):
             out_splits=(P(), (1, 0), P(), P()),
         )
         prog = jax.jit(mapped)
-        cache[key] = prog
+        per_comm[_KCluster._ASSIGN_BLOCK] = prog
         return prog

@@ -29,13 +29,16 @@ __all__ = [
     "div",
     "divide",
     "divmod",
+    "float_power",
     "floordiv",
     "floor_divide",
     "fmod",
+    "heaviside",
     "gcd",
     "hypot",
     "invert",
     "lcm",
+    "ldexp",
     "left_shift",
     "mod",
     "mul",
@@ -54,6 +57,8 @@ __all__ = [
     "sub",
     "subtract",
     "sum",
+    "trapezoid",
+    "trapz",
     "true_divide",
 ]
 
@@ -135,6 +140,49 @@ def gcd(t1, t2) -> DNDarray:
 
 def lcm(t1, t2) -> DNDarray:
     return _binary_op(jnp.lcm, t1, t2)
+
+
+def float_power(t1, t2) -> DNDarray:
+    """``t1 ** t2`` computed in the widest available float type (numpy
+    ``float_power`` semantics; f32 on TPU unless x64 is enabled)."""
+    return _binary_op(jnp.float_power, t1, t2)
+
+
+def ldexp(t1, t2) -> DNDarray:
+    """Elementwise ``t1 * 2**t2`` (numpy ``ldexp``)."""
+    return _binary_op(jnp.ldexp, t1, t2)
+
+
+def heaviside(t1, t2) -> DNDarray:
+    """Heaviside step function with ``t2`` as the value at 0."""
+    return _binary_op(jnp.heaviside, t1, t2)
+
+
+def trapz(y, x=None, dx: float = 1.0, axis: int = -1) -> DNDarray:
+    """Trapezoidal-rule integration along ``axis``.
+
+    Pure array-API composition (diff + sum) so the distributed reduction over
+    a split axis rides the standard ``_reduce_op`` collective path.
+    """
+    from . import manipulations
+
+    sl1 = [slice(None)] * y.ndim
+    sl2 = [slice(None)] * y.ndim
+    sl1[axis] = slice(1, None)
+    sl2[axis] = slice(None, -1)
+    if x is None:
+        avg = (y[tuple(sl1)] + y[tuple(sl2)]) * (0.5 * dx)
+    else:
+        d = diff(x, axis=axis if x.ndim > 1 else 0)
+        if x.ndim == 1 and y.ndim > 1:
+            shape = [1] * y.ndim
+            shape[axis] = d.shape[0]
+            d = manipulations.reshape(d, tuple(shape))
+        avg = (y[tuple(sl1)] + y[tuple(sl2)]) * d * 0.5
+    return sum(avg, axis=axis)
+
+
+trapezoid = trapz
 
 
 def neg(x, out=None) -> DNDarray:

@@ -7,7 +7,37 @@ import jax.numpy as jnp
 from . import types
 from .dndarray import DNDarray
 
-__all__ = ["nonzero", "where"]
+__all__ = ["flatnonzero", "nonzero", "tril_indices", "triu_indices", "where"]
+
+
+def flatnonzero(x: DNDarray) -> DNDarray:
+    """Global flat indices of non-zero elements (``nonzero`` on ``ravel``)."""
+    from .manipulations import ravel
+
+    return nonzero(ravel(x))
+
+
+def _tri_indices(fn, n: int, k: int, m):
+    from . import factories
+
+    rows, cols = fn(n, k=k, m=n if m is None else m)
+    return factories.array(rows, split=None), factories.array(cols, split=None)
+
+
+def triu_indices(n: int, k: int = 0, m=None):
+    """Row/col index arrays of the upper triangle of an (n, m) matrix
+    (numpy keyword parity: the diagonal offset is ``k``, as in ``triu``)."""
+    import numpy as np
+
+    return _tri_indices(np.triu_indices, n, k, m)
+
+
+def tril_indices(n: int, k: int = 0, m=None):
+    """Row/col index arrays of the lower triangle of an (n, m) matrix
+    (numpy keyword parity: the diagonal offset is ``k``, as in ``tril``)."""
+    import numpy as np
+
+    return _tri_indices(np.tril_indices, n, k, m)
 
 
 def nonzero(x: DNDarray) -> DNDarray:

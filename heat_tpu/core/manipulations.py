@@ -39,6 +39,7 @@ __all__ = [
     "flatten",
     "insert",
     "ndim",
+    "shape",
     "size",
     "unfold",
     "flip",
@@ -188,6 +189,13 @@ def size(x) -> int:
     if isinstance(x, DNDarray):
         return x.size
     return np.size(x)
+
+
+def shape(x) -> tuple:
+    """Global shape (numpy free-function parity)."""
+    if isinstance(x, DNDarray):
+        return x.shape
+    return np.shape(x)
 
 
 def unfold(x: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:

@@ -8,7 +8,7 @@ from . import types
 from ._operations import _binary_op, _local_op
 from .dndarray import DNDarray
 
-__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "frexp", "modf", "nan_to_num", "round", "sgn", "sign", "trunc"]
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "frexp", "modf", "nan_to_num", "rint", "round", "sgn", "sign", "trunc"]
 
 
 def nan_to_num(x, nan: float = 0.0, posinf=None, neginf=None, out=None):
@@ -77,6 +77,11 @@ def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
     if dtype is not None:
         res = res.astype(dtype, copy=False)
     return res
+
+
+def rint(x, out=None) -> DNDarray:
+    """Round to nearest integer, half-to-even (numpy ``rint``)."""
+    return _local_op(jnp.rint, x, out=out)
 
 
 def sgn(x, out=None) -> DNDarray:

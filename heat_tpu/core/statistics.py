@@ -25,6 +25,7 @@ __all__ = [
     "average",
     "bincount",
     "bucketize",
+    "corrcoef",
     "cov",
     "digitize",
     "histc",
@@ -37,7 +38,10 @@ __all__ = [
     "min",
     "minimum",
     "percentile",
+    "ptp",
     "quantile",
+    "nanargmax",
+    "nanargmin",
     "nanmax",
     "nanmin",
     "nanmean",
@@ -256,6 +260,45 @@ def nanstd(x, axis=None, ddof: int = 0) -> DNDarray:
 
 def nanvar(x, axis=None, ddof: int = 0) -> DNDarray:
     return _reduce_op(jnp.nanvar, x, axis=axis, ddof=ddof)
+
+
+def nanargmax(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Index of the maximum, ignoring NaNs (global indices)."""
+    return _reduce_op(jnp.nanargmax, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def nanargmin(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Index of the minimum, ignoring NaNs (global indices)."""
+    return _reduce_op(jnp.nanargmin, x, axis=axis, keepdims=keepdims, out=out)
+
+
+def ptp(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Peak-to-peak range ``max - min`` — composed from the distributed
+    reductions so split axes ride the standard collective path."""
+    res = max(x, axis=axis, keepdims=keepdims) - min(x, axis=axis, keepdims=keepdims)
+    if out is not None:
+        from . import sanitation
+
+        sanitation.sanitize_out(out, res.shape, res.split, res.device)
+        out._jarray = res._jarray.astype(out.dtype.jax_dtype())
+        return out
+    return res
+
+
+def corrcoef(m, y=None, rowvar: bool = True) -> DNDarray:
+    """Pearson correlation coefficient matrix, normalized from :func:`cov`."""
+    if isinstance(m, DNDarray) and m.ndim == 1 and y is None:
+        # numpy returns a 0-d 1.0 for a single variable; keep the input's
+        # float-promoted dtype rather than hardcoding f32
+        fdt = jnp.promote_types(m._jarray.dtype, jnp.float32)
+        one = jnp.asarray(1.0, dtype=fdt)
+        return DNDarray(one, (), types.canonical_heat_type(one.dtype), None, m.device, m.comm, True)
+    c = cov(m, y=y, rowvar=rowvar)
+    d = jnp.sqrt(jnp.diag(c._jarray))
+    res = c._jarray / jnp.outer(d, d)
+    res = jnp.clip(res, -1.0, 1.0)
+    res = c.comm.shard(res, c.split)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), c.split, c.device, c.comm, True)
 
 
 def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:

@@ -28,7 +28,9 @@ __all__ = [
     "cross",
     "det",
     "dot",
+    "einsum",
     "inv",
+    "kron",
     "matmul",
     "matmul_summa",
     "matrix_norm",
@@ -199,6 +201,78 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
 def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     res = jnp.vdot(x1._jarray, x2._jarray)
     return _wrap(res, None, x1)
+
+
+def einsum(subscripts: str, *operands, out=None) -> DNDarray:
+    """Einstein summation over DNDarrays.
+
+    The contraction is expressed on the GLOBAL arrays and partitioned by
+    GSPMD: contracted split axes lower to a sharded dot + psum, batch/free
+    split axes stay sharded.  The output split is the position the first
+    operand's split axis maps to in the output subscript (None if it was
+    contracted away) — the same bookkeeping rule the matmul split table uses.
+    """
+    djs = [o._jarray if isinstance(o, DNDarray) else jnp.asarray(o) for o in operands]
+    res = jnp.einsum(subscripts, *djs)
+    proto = next((o for o in operands if isinstance(o, DNDarray)), None)
+    if proto is None:
+        raise TypeError("einsum needs at least one DNDarray operand")
+    if "->" in subscripts:
+        in_specs, out_spec = subscripts.split("->")
+        out_spec = out_spec.replace(" ", "")
+    else:
+        # implicit mode: free labels = those appearing exactly once across all
+        # inputs, in alphabetical order (numpy semantics); an ellipsis prefixes
+        # broadcast dims, which keeps the '.' guard below in force so split
+        # inference safely bails to None
+        in_specs = subscripts
+        flat = in_specs.replace(",", "").replace(" ", "").replace(".", "")
+        out_spec = "".join(sorted(c for c in set(flat) if flat.count(c) == 1))
+        if "." in in_specs:
+            out_spec = "..." + out_spec
+    in_list = [s.replace(" ", "") for s in in_specs.split(",")]
+    split = None
+    if "." not in out_spec:
+        for o, spec in zip(operands, in_list):
+            if isinstance(o, DNDarray) and o.split is not None and "." not in spec:
+                label = spec[o.split] if o.split < len(spec) else None
+                if label and label in out_spec:
+                    split = out_spec.index(label)
+                    break
+    r = _wrap(res, split, proto)
+    if out is not None:
+        from ..core import sanitation
+
+        sanitation.sanitize_out(out, r.shape, split, r.device)
+        out._jarray = r._jarray.astype(out.dtype.jax_dtype())
+        return out
+    return r
+
+
+def kron(a, b) -> DNDarray:
+    """Kronecker product; result split follows ``a``'s split axis (each of
+    ``a``'s rows/cols expands to a contiguous block, preserving the axis
+    order, so the blocked axis remains shardable)."""
+    from ..core import factories
+
+    # coerce array-likes onto the DNDarray operand's comm/device so the
+    # result does not silently migrate to the default communicator
+    if not isinstance(a, DNDarray):
+        proto = b if isinstance(b, DNDarray) else None
+        a = factories.array(a, device=proto.device, comm=proto.comm) if proto is not None else factories.array(a)
+    if not isinstance(b, DNDarray):
+        b = factories.array(b, device=a.device, comm=a.comm)
+    res = jnp.kron(a._jarray, b._jarray)
+    # numpy prepends size-1 axes to the lower-rank operand, so a's split axis
+    # lands at a.split + (res.ndim - a.ndim) in the result
+    split = None
+    if a.split is not None:
+        split = a.split + (res.ndim - a.ndim)
+        if split >= res.ndim:
+            split = None
+    return _wrap(res, split, a)
+
+
 
 
 def vecdot(x1: DNDarray, x2: DNDarray, axis: int = -1, keepdims: bool = False) -> DNDarray:

@@ -144,3 +144,160 @@ class TestTopLevelExports(TestCase):
         d = ht.sparse.sparse_csr_matrix(s, split=0)
         got = ht.sparse.todense(d)
         np.testing.assert_allclose(got.numpy(), s.toarray(), rtol=1e-6)
+
+
+class TestNumpyParityBatch3(TestCase):
+    """Round-3 additions: shape/ptp/rint/float_power/ldexp/heaviside/trapz,
+    nanarg*/corrcoef, flatnonzero/tri*_indices, einsum/kron."""
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_elementwise_and_reductions(self, split):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((24, 6)).astype(np.float32)
+        y = rng.standard_normal((24, 6)).astype(np.float32)
+        a, b = ht.array(x, split=split), ht.array(y, split=split)
+        assert ht.shape(a) == (24, 6)
+        self.assert_array_equal(ht.ptp(a, axis=0), np.ptp(x, axis=0))
+        self.assert_array_equal(ht.float_power(ht.abs(a), 2.0), np.float_power(np.abs(x), 2.0), rtol=1e-4)
+        self.assert_array_equal(ht.heaviside(a, b), np.heaviside(x, y))
+        self.assert_array_equal(ht.rint(a * 3), np.rint(x * 3))
+        np_trapz = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 fallback
+        self.assert_array_equal(ht.trapz(a, axis=0), np_trapz(x, axis=0), rtol=1e-4, atol=1e-4)
+        e = ht.array(np.full((24, 6), 2, np.int32), split=split)
+        self.assert_array_equal(ht.ldexp(a, e), np.ldexp(x, 2))
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_nanarg_reductions(self, split):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((21, 5)).astype(np.float32)  # ragged on 8 dev
+        x[4, 2] = np.nan
+        a = ht.array(x, split=split)
+        self.assert_array_equal(ht.nanargmax(a, axis=0), np.nanargmax(x, axis=0))
+        self.assert_array_equal(ht.nanargmin(a, axis=0), np.nanargmin(x, axis=0))
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_corrcoef(self, split):
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal((8, 40)).astype(np.float32)
+        a = ht.array(x, split=split)
+        got = ht.corrcoef(a)
+        np.testing.assert_allclose(got.numpy(), np.corrcoef(x), rtol=1e-3, atol=1e-4)
+
+    def test_flatnonzero_and_tri_indices(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        m = x > 0.3
+        got = ht.flatnonzero(ht.array(m, split=0))
+        np.testing.assert_array_equal(got.numpy(), np.flatnonzero(m))
+        for fn, nfn in ((ht.triu_indices, np.triu_indices), (ht.tril_indices, np.tril_indices)):
+            r, c = fn(6, 1)
+            er, ec = nfn(6, 1)
+            np.testing.assert_array_equal(r.numpy(), er)
+            np.testing.assert_array_equal(c.numpy(), ec)
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_einsum(self, split):
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((24, 6)).astype(np.float32)
+        y = rng.standard_normal((24, 6)).astype(np.float32)
+        a, b = ht.array(x, split=split), ht.array(y, split=split)
+        # free-axis contraction: split-0 rows stay sharded in the output
+        self.assert_array_equal(ht.einsum("ij,kj->ik", a, b), np.einsum("ij,kj->ik", x, y), rtol=1e-4, atol=1e-3)
+        if split == 0:
+            assert ht.einsum("ij,kj->ik", a, b).split == 0
+        # full contraction → replicated scalar
+        s = ht.einsum("ij,ij->", a, b)
+        assert s.split is None
+        np.testing.assert_allclose(float(s.numpy()), float(np.einsum("ij,ij->", x, y)), rtol=1e-3)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_kron(self, split):
+        rng = np.random.default_rng(17)
+        x = rng.standard_normal((8, 2)).astype(np.float32)
+        y = rng.standard_normal((3, 3)).astype(np.float32)
+        a = ht.array(x, split=split)
+        self.assert_array_equal(ht.kron(a, ht.array(y)), np.kron(x, y), rtol=1e-4)
+
+    def test_einsum_spec_edge_cases(self):
+        """Regression: spaced specs, implicit mode, out= validation."""
+        rng = np.random.default_rng(19)
+        x = rng.standard_normal((24, 6)).astype(np.float32)
+        y = rng.standard_normal((24, 6)).astype(np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        e = ht.einsum("ij, kj -> ik", a, b)  # whitespace is legal numpy syntax
+        assert e.split == 0
+        self.assert_array_equal(e, np.einsum("ij,kj->ik", x, y), rtol=1e-4, atol=1e-3)
+        imp = ht.einsum("ij,jk", a, ht.array(y.T))  # implicit output spec
+        assert imp.split == 0
+        self.assert_array_equal(imp, x @ y.T, rtol=1e-4, atol=1e-3)
+        bad = ht.zeros((5,))
+        with pytest.raises(ValueError):
+            ht.einsum("ij,kj->ik", a, b, out=bad)
+
+    def test_kron_coerces_array_likes(self):
+        rng = np.random.default_rng(23)
+        x = rng.standard_normal((8, 2)).astype(np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(ht.kron(a, 2.0).numpy(), np.kron(x, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(ht.kron(a, np.eye(2, dtype=np.float32)).numpy(), np.kron(x, np.eye(2)), rtol=1e-5)
+
+    def test_ptp_out_validation(self):
+        a = ht.arange(24, dtype=ht.float32, split=0).reshape((6, 4))
+        with pytest.raises(ValueError):
+            ht.ptp(a, axis=0, out=ht.zeros((5,)))
+        o = ht.zeros((4,))
+        r = ht.ptp(a, axis=0, out=o)
+        self.assert_array_equal(r, np.ptp(np.arange(24, dtype=np.float32).reshape(6, 4), axis=0))
+
+    def test_corrcoef_1d_scalar(self):
+        v = ht.arange(10, dtype=ht.float32, split=0)
+        c = ht.corrcoef(v)
+        assert c.shape == () and float(c.numpy()) == 1.0
+
+    def test_einsum_interior_spaces_contracted(self):
+        """Regression: 'i j, j k -> i k' with the split axes all contracted
+        must yield split=None (the space char must not be read as a label)."""
+        rng = np.random.default_rng(29)
+        x = rng.standard_normal((6, 8)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        a = ht.array(x, split=1)
+        b = ht.array(y, split=0)
+        e = ht.einsum("i j, j k -> i k", a, b)
+        assert e.split is None
+        self.assert_array_equal(e, x @ y, rtol=1e-4, atol=1e-3)
+
+    def test_einsum_out_dtype_cast(self):
+        a = ht.array(np.arange(4, dtype=np.int32).reshape(2, 2))
+        o = ht.zeros((2, 2), dtype=ht.float32)
+        r = ht.einsum("ij,kj->ik", a, a, out=o)
+        assert r._jarray.dtype == np.float32  # stored array matches out.dtype
+
+    def test_kron_1d_by_2d_split_mapping(self):
+        """a 1-D split=0, b 2-D: numpy prepends a size-1 axis to a, so a's
+        data axis is result axis 1 — that's the axis that must stay split."""
+        v = np.arange(16, dtype=np.float32)
+        a = ht.array(v, split=0)
+        b = ht.array(np.eye(3, dtype=np.float32))
+        k = ht.kron(a, b)
+        assert k.shape == (3, 48) and k.split == 1
+        self.assert_array_equal(k, np.kron(v, np.eye(3, dtype=np.float32)), rtol=1e-5)
+
+    def test_einsum_ellipsis_implicit_no_false_split(self):
+        rng = np.random.default_rng(31)
+        a = ht.array(rng.standard_normal((5, 3)).astype(np.float32))
+        b = ht.array(rng.standard_normal(4).astype(np.float32), split=0)
+        e = ht.einsum("...i,j", a, b)
+        assert e.split is None and e.shape == (5, 3, 4)
+        np.testing.assert_allclose(e.numpy(), np.einsum("...i,j", a.numpy(), b.numpy()), rtol=1e-4)
+
+    def test_kron_scalar_first_keeps_comm(self):
+        b = ht.array(np.eye(3, dtype=np.float32), split=0)
+        k = ht.kron(2.0, b)
+        assert k.comm is b.comm
+        np.testing.assert_allclose(k.numpy(), 2.0 * np.eye(3), rtol=1e-6)
+
+    def test_tri_indices_k_keyword(self):
+        r, c = ht.triu_indices(6, k=1)
+        er, ec = np.triu_indices(6, k=1)
+        np.testing.assert_array_equal(r.numpy(), er)
+        np.testing.assert_array_equal(c.numpy(), ec)
