@@ -296,7 +296,11 @@ def corrcoef(m, y=None, rowvar: bool = True) -> DNDarray:
     c = cov(m, y=y, rowvar=rowvar)
     d = jnp.sqrt(jnp.diag(c._jarray))
     res = c._jarray / jnp.outer(d, d)
-    res = jnp.clip(res, -1.0, 1.0)
+    if jnp.issubdtype(res.dtype, jnp.complexfloating):
+        # numpy clips real/imag parts independently for complex input
+        res = jnp.clip(res.real, -1.0, 1.0) + 1j * jnp.clip(res.imag, -1.0, 1.0)
+    else:
+        res = jnp.clip(res, -1.0, 1.0)
     res = c.comm.shard(res, c.split)
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), c.split, c.device, c.comm, True)
 

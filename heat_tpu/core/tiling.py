@@ -6,7 +6,9 @@ for its blocked QR/matmul.  Under XLA, cross-shard tile motion is implicit,
 so these classes reduce to *index algebra* over the global array: a tile is
 a slice, reads/writes are sharded gathers/scatters.  The API (tile_locations,
 tile_dimensions, ``__getitem__``/``__setitem__``) is kept for parity and for
-algorithms that want explicit block addressing.
+algorithms that want explicit block addressing; ``SquareDiagTiles`` drives the
+blocked triangular substitution in ``linalg.solve_triangular`` (the same role
+it plays for the reference's blocked solvers).
 """
 
 from __future__ import annotations
