@@ -280,3 +280,46 @@ class TestLRSchedulers:
         c = ht.optim.lr_scheduler.CosineAnnealingLR(1.0, T_max=100)
         assert float(c(0)) == pytest.approx(1.0)
         assert float(c(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+class TestDataParallelDistribution:
+    """VERDICT r2 item 2 for the NN layer: the training batch must be
+    PHYSICALLY sharded over the data-parallel mesh, not just tagged split=0 —
+    otherwise 'data parallel' training is single-device with extra steps."""
+
+    def test_batches_are_physically_sharded(self):
+        import jax
+
+        comm = ht.communication.get_comm()
+        ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=512)
+        loader = ht.utils.data.DataLoader(ds, batch_size=256, shuffle=False)
+        xb, yb = next(iter(loader))
+        for t in (xb, yb):
+            assert t.split == 0
+            assert len(t._parray.sharding.device_set) == comm.size, (
+                f"batch claims split=0 but lives on "
+                f"{len(t._parray.sharding.device_set)} device(s)"
+            )
+
+    def test_grads_replicated_after_step(self):
+        import jax
+
+        model = ht.nn.Sequential(ht.nn.Flatten(), ht.nn.Linear(16, 4))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        params = dp.init(jax.random.key(0))
+        state = opt.init_state(params)
+        step = dp.make_train_step(ht.nn.functional.cross_entropy)
+        x = ht.random.randn(64, 16, split=0)
+        y = ht.array(np.zeros(64, dtype=np.int32), split=0)
+        params, state, _ = step(params, state, x._jarray, y._jarray)
+        # updated params must be replicated (every device holds the same copy)
+        leaves = jax.tree_util.tree_leaves(params)
+        assert leaves, "no parameters"
+        for leaf in leaves:
+            assert not leaf.is_deleted()
+            np.testing.assert_allclose(
+                np.asarray(leaf.addressable_shards[0].data),
+                np.asarray(leaf.addressable_shards[-1].data),
+                rtol=0, atol=0,
+            )

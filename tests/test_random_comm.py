@@ -187,3 +187,22 @@ class TestRootedCollectives:
         assert ht.communication.MPI_WORLD.size == comm.size
         assert ht.communication.MPI_SELF.size == 1
         assert comm.Iallreduce is comm.Allreduce or comm.Iallreduce.__func__ is comm.Allreduce.__func__
+
+
+class TestRandomDistribution:
+    """Random factories must produce PHYSICALLY sharded arrays for any split,
+    including ragged extents (VERDICT r2 item 2 applied to heat_tpu.random)."""
+
+    def test_random_factories_physically_sharded(self):
+        comm = ht.communication.get_comm()
+        for ctor in (
+            lambda: ht.random.randn(96, 8, split=0),
+            lambda: ht.random.randn(97, 8, split=0),   # ragged
+            lambda: ht.random.rand(50, 10, split=1),   # ragged on axis 1
+            lambda: ht.random.randint(0, 9, (40, 6), split=0),
+        ):
+            x = ctor()
+            assert len(x._parray.sharding.device_set) == comm.size, (
+                f"{x.shape} split={x.split}: physical device_set "
+                f"{len(x._parray.sharding.device_set)} != mesh size {comm.size}"
+            )
