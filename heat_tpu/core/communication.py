@@ -308,12 +308,16 @@ class Communication:
         }
         if op in ("prod", "land", "lor"):
             if op == "prod":
-                # sign-safe product: all_gather then reduce (log-sum only
-                # works for strictly positive inputs)
-                self._warn_gather_based("Allreduce(op='prod')")
-                return jnp.prod(
-                    lax.all_gather(x, self.__axis, axis=0, tiled=False), axis=0
+                # sign/zero-safe product in O(1) memory: inclusive-scan
+                # product via log-p recursive doubling, then broadcast the
+                # last shard's total with a masked psum (no all_gather)
+                inc = self._inclusive_scan(x, jnp.multiply, unit=1)
+                last = jnp.where(
+                    lax.axis_index(self.__axis) == self.size - 1,
+                    inc,
+                    jnp.zeros_like(inc),
                 )
+                return lax.psum(last, self.__axis)
             if op == "land":
                 return lax.pmin(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)
             return lax.pmax(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)
@@ -327,13 +331,17 @@ class Communication:
             x, self.__axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
         )
 
-    def Bcast(self, x, root: int = 0, *, _warn_as: str = "Bcast"):
+    def Bcast(self, x, root: int = 0):
         """Every shard receives shard ``root``'s block.
 
-        O(p)-memory: gather-based (see ``_warn_gather_based``)."""
-        self._warn_gather_based(_warn_as)
-        full = lax.all_gather(x, self.__axis, axis=0, tiled=False)
-        return full[root]
+        O(1)-memory: the non-root shards contribute zeros to a ``psum``, so
+        the wire cost is one allreduce of the payload and no shard ever holds
+        a p× buffer (the reference Bcasts a single buffer too — this is the
+        SPMD-collective realization of the same cost)."""
+        mine = lax.axis_index(self.__axis) == root
+        contrib = jnp.where(mine, x, jnp.zeros_like(x))
+        # psum promotes bool to int32 — restore the caller's dtype
+        return lax.psum(contrib, self.__axis).astype(x.dtype)
 
     def Send(self, x, shift: int = 1):
         """Ring shift by ``shift`` (reference Isend/Irecv neighbor exchange)."""
@@ -344,19 +352,39 @@ class Communication:
     def ReduceScatter(self, x, axis: int = 0):
         return lax.psum_scatter(x, self.__axis, scatter_dimension=axis, tiled=True)
 
+    def _inclusive_scan(self, x, combine, unit):
+        """Inclusive prefix combine across shards in O(log p) ``ppermute``
+        steps (Hillis–Steele recursive doubling), O(1) memory per shard.
+        ``unit`` fills the holes of the partial permutation (ranks below the
+        stride receive nothing)."""
+        idx = lax.axis_index(self.__axis)
+        n = self.size
+        acc = x
+        shift = 1
+        while shift < n:
+            perm = [(i, i + shift) for i in range(n - shift)]
+            recvd = lax.ppermute(acc, self.__axis, perm)
+            filled = jnp.where(idx >= shift, recvd, jnp.full_like(recvd, unit))
+            acc = combine(acc, filled)
+            shift *= 2
+        return acc
+
     def Exscan(self, x):
         """Exclusive prefix sum across shards (reference ``comm.Exscan``).
 
-        O(p)-memory: gather-based (see ``_warn_gather_based``)."""
-        self._warn_gather_based("Exscan")
-        idx = lax.axis_index(self.__axis)
-        gathered = lax.all_gather(x, self.__axis, axis=0, tiled=False)
+        O(log p) ``ppermute`` rounds, O(1) memory: the inclusive scan is
+        computed by recursive doubling, then shifted one rank down the ring
+        (rank 0 receives the empty-sum zero) — exact, unlike
+        ``inclusive - x`` which reassociates floats."""
+        inc = self._inclusive_scan(x, jnp.add, unit=0)
         n = self.size
-        mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * x.ndim)
-        return jnp.sum(gathered * mask.astype(gathered.dtype), axis=0)
+        perm = [(i, i + 1) for i in range(n - 1)]
+        shifted = lax.ppermute(inc, self.__axis, perm)
+        idx = lax.axis_index(self.__axis)
+        return jnp.where(idx > 0, shifted, jnp.zeros_like(shifted))
 
     def Scan(self, x):
-        return self.Exscan(x) + x
+        return self._inclusive_scan(x, jnp.add, unit=0)
 
     def Reduce(self, x, root: int = 0, op: str = "sum"):
         """Reduce to shard ``root``; other shards receive zeros (XLA is SPMD —
@@ -368,8 +396,9 @@ class Communication:
     def Scatter(self, x, root: int = 0, axis: int = 0):
         """Shard ``root``'s block, split along ``axis``, one piece per shard.
 
-        O(p)-memory: routes through the gather-based ``Bcast``."""
-        src = self.Bcast(x, root=root, _warn_as="Scatter")
+        Transient memory = ONE copy of root's buffer per shard (the masked-
+        psum Bcast), then the local slice — no p× gather."""
+        src = self.Bcast(x, root=root)
         n = self.size
         idx = lax.axis_index(self.__axis)
         piece = src.shape[axis] // n

@@ -15,7 +15,11 @@ from test_suites.basic_test import TestCase
 
 
 class TestGatherTrapWarnings(TestCase):
-    def test_warns_above_threshold(self):
+    def test_gather_warns_above_threshold(self):
+        """Gather inherently materializes the full buffer on every shard
+        (SPMD) — it stays warned.  Bcast/Exscan/Scan/prod were rewritten to
+        O(1)/O(log p) collective forms and must NOT warn (see
+        test_scalable_collectives_silent)."""
         comm = ht.communication.get_comm()
         old = Communication.GATHER_WARN_THRESHOLD
         Communication.GATHER_WARN_THRESHOLD = 2  # 8-device mesh now "large"
@@ -24,33 +28,42 @@ class TestGatherTrapWarnings(TestCase):
                 warnings.simplefilter("always")
                 x = jnp.ones((8, 4))
                 comm.shard_map(
-                    lambda b: comm.Bcast(b), in_splits=((2, 0),), out_splits=(2, 0)
-                )(x)
-                comm.shard_map(
                     lambda b: comm.Gather(b), in_splits=((2, 0),), out_splits=(2, 0)
                 )(x)
-                comm.shard_map(
+            msgs = [str(w.message) for w in rec if "gather-based" in str(w.message)]
+            assert any("Gather" in m for m in msgs), "no perf-trap warning for Gather"
+        finally:
+            Communication.GATHER_WARN_THRESHOLD = old
+
+    def test_scalable_collectives_silent(self):
+        """Bcast (masked psum), Exscan/Scan (recursive doubling) and
+        Allreduce('prod') (scan + masked psum) are scalable now: no perf-trap
+        warning even above the threshold, and values stay correct."""
+        comm = ht.communication.get_comm()
+        old = Communication.GATHER_WARN_THRESHOLD
+        Communication.GATHER_WARN_THRESHOLD = 2
+        try:
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                x = jnp.ones((8, 4))
+                bc = comm.shard_map(
+                    lambda b: comm.Bcast(b), in_splits=((2, 0),), out_splits=(2, 0)
+                )(x)
+                ex = comm.shard_map(
                     lambda b: comm.Exscan(b), in_splits=((2, 0),), out_splits=(2, 0)
                 )(x)
-                comm.shard_map(
+                pr = comm.shard_map(
                     lambda b: comm.Allreduce(b, op="prod"),
                     in_splits=((2, 0),),
                     out_splits=(2, 0),
                 )(x)
-            msgs = [str(w.message) for w in rec if "gather-based" in str(w.message)]
-            for name in ("Bcast", "Gather", "Exscan", "Allreduce(op='prod')"):
-                assert any(name in m for m in msgs), f"no perf-trap warning for {name}"
+            assert not [w for w in rec if "gather-based" in str(w.message)]
+            np.testing.assert_allclose(np.asarray(bc), np.ones((8, 4)))
+            # per-shard block is one row of ones → exclusive scan = shard idx
+            np.testing.assert_allclose(np.asarray(ex), np.repeat(np.arange(8.0), 1)[:, None] * np.ones(4))
+            np.testing.assert_allclose(np.asarray(pr), np.ones((8, 4)))
         finally:
             Communication.GATHER_WARN_THRESHOLD = old
-
-    def test_silent_at_default_threshold(self):
-        comm = ht.communication.get_comm()  # size 8 == threshold: no warning
-        with warnings.catch_warnings(record=True) as rec:
-            warnings.simplefilter("always")
-            comm.shard_map(
-                lambda b: comm.Bcast(b), in_splits=((2, 0),), out_splits=(2, 0)
-            )(jnp.ones((8, 4)))
-        assert not [w for w in rec if "gather-based" in str(w.message)]
 
 
 class TestReshapeSplitRule(TestCase):
