@@ -317,7 +317,8 @@ class Communication:
                     inc,
                     jnp.zeros_like(inc),
                 )
-                return lax.psum(last, self.__axis)
+                # psum promotes bool/small ints — restore the caller's dtype
+                return lax.psum(last, self.__axis).astype(x.dtype)
             if op == "land":
                 return lax.pmin(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)
             return lax.pmax(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)

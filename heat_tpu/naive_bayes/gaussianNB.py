@@ -34,32 +34,27 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         self.classes_ = None
         self.epsilon_ = None
 
-    def fit(self, x: DNDarray, y: DNDarray, sample_weight=None) -> "GaussianNB":
-        if x.ndim != 2:
-            raise ValueError("x must be 2-D (n_samples, n_features)")
-        jX = x._jarray
-        jy = y._jarray.reshape(-1)
-        classes = jnp.unique(jy)  # eager: concrete sizes
-        n_classes = int(classes.shape[0])
-        n, d = jX.shape
+    @staticmethod
+    def _batch_stats(jX, jy, classes):
+        """Per-class (counts, means, variances) of ONE batch — no smoothing.
 
-        self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0)))
-
-        onehot = (jy[:, None] == classes[None, :]).astype(jX.dtype)  # (n, c)
-        counts = jnp.sum(onehot, axis=0)  # (c,)
-        safe = jnp.maximum(counts, 1.0)[:, None]
-        # shift by the global feature mean before the moment GEMMs so that
-        # E[x²]−E[x]² cancellation is relative to the data spread, not its
-        # offset (float32-safe)
+        The (c, d) moments come from two one-hot GEMMs (MXU + implicit
+        Allreduce over the split axis); features are shifted by the batch
+        mean first so the E[x²]−E[x]² cancellation is relative to the data
+        spread, not its offset (float32-safe)."""
+        mask = jy[:, None] == classes[None, :]  # (n, c)
+        onehot = mask.astype(jX.dtype)
+        # counts accumulate in int32 (exact to 2^31), NOT the data dtype —
+        # float32 counts freeze past 2^24 samples, bf16 past 256
+        counts = jnp.sum(mask, axis=0, dtype=jnp.int32)  # (c,)
+        safe = jnp.maximum(counts, 1).astype(jX.dtype)[:, None]
         gmean = jnp.mean(jX, axis=0)
         xs = jX - gmean[None, :]
-        sums_s = onehot.T @ xs  # (c, d) MXU GEMM + implicit Allreduce
-        means_s = sums_s / safe
-        sq_s = onehot.T @ (xs * xs)
-        var = sq_s / safe - means_s**2
-        var = jnp.maximum(var, 0.0) + self.epsilon_
-        means = means_s + gmean[None, :]
+        means_s = (onehot.T @ xs) / safe
+        var = (onehot.T @ (xs * xs)) / safe - means_s**2
+        return counts, means_s + gmean[None, :], jnp.maximum(var, 0.0)
 
+    def _finalize(self, x, classes, counts, means, var):
         comm, device = x.comm, x.device
 
         def wrap(j):
@@ -69,17 +64,82 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         self.classes_ = wrap(classes)
         self.class_count_ = wrap(counts)
         if self.priors is not None:
-            pr = jnp.asarray(self.priors, dtype=jX.dtype)
-            if pr.shape[0] != n_classes:
+            pr = jnp.asarray(self.priors, dtype=means.dtype)
+            if pr.shape[0] != int(classes.shape[0]):
                 raise ValueError("Number of priors must match number of classes")
             if not np.isclose(float(jnp.sum(pr)), 1.0):
                 raise ValueError("The sum of the priors should be 1")
             self.class_prior_ = wrap(pr)
         else:
-            self.class_prior_ = wrap(counts / jnp.sum(counts))
+            fcounts = counts.astype(means.dtype)
+            total = jnp.maximum(jnp.sum(fcounts), 1.0)
+            self.class_prior_ = wrap(fcounts / total)
         self.theta_ = wrap(means)
-        self.var_ = wrap(var)
+        self.var_ = wrap(var + self.epsilon_)
         return self
+
+    def fit(self, x: DNDarray, y: DNDarray, sample_weight=None) -> "GaussianNB":
+        if x.ndim != 2:
+            raise ValueError("x must be 2-D (n_samples, n_features)")
+        jX = x._jarray
+        jy = y._jarray.reshape(-1)
+        classes = jnp.unique(jy)  # eager: concrete sizes
+        self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0)))
+        counts, means, var = self._batch_stats(jX, jy, classes)
+        return self._finalize(x, classes, counts, means, var)
+
+    def partial_fit(self, x: DNDarray, y: DNDarray, classes=None, sample_weight=None) -> "GaussianNB":
+        """Incremental fit on a batch (reference
+        ``heat/naive_bayes/gaussianNB.py::partial_fit``): per-class moments of
+        the batch are merged with the fitted state by Chan's pooled
+        mean/variance update, so streaming over batches is exact (up to float
+        rounding) against a single ``fit`` on the concatenation.
+
+        ``classes`` must be given on the first call (sklearn semantics); later
+        batches may contain any subset of them.
+        """
+        if x.ndim != 2:
+            raise ValueError("x must be 2-D (n_samples, n_features)")
+        jX = x._jarray
+        jy = y._jarray.reshape(-1)
+
+        if self.classes_ is None:
+            if classes is None:
+                raise ValueError("classes must be passed on the first call to partial_fit")
+            cls = classes._jarray if isinstance(classes, DNDarray) else jnp.asarray(np.asarray(classes))
+            if bool(jnp.any(~jnp.isin(jy, cls))):
+                raise ValueError("y contains labels not in the declared classes")
+            self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0)))
+            counts, means, var = self._batch_stats(jX, jy, cls)
+            return self._finalize(x, cls, counts, means, var)
+
+        cls = self.classes_._jarray
+        unseen = ~jnp.isin(jy, cls)
+        if bool(jnp.any(unseen)):
+            raise ValueError("y contains labels not in the classes seen at first partial_fit")
+        n_new, means_new, var_new = self._batch_stats(jX, jy, cls)
+        n_old = self.class_count_._jarray
+        means_old = self.theta_._jarray
+        var_old = jnp.maximum(self.var_._jarray - self.epsilon_, 0.0)  # strip smoothing
+
+        fdt = means_old.dtype
+        n_tot = n_old + n_new  # int32: exact
+        f_old, f_new = n_old.astype(fdt), n_new.astype(fdt)
+        safe = jnp.maximum(n_tot.astype(fdt), 1.0)
+        w_new = (f_new / safe)[:, None]
+        delta = means_new - means_old
+        means = means_old + delta * w_new
+        # pooled M2: nσ² terms plus the between-batch correction (ratios
+        # computed in float — the int product n_old·n_new would overflow)
+        m2 = (
+            var_old * f_old[:, None]
+            + var_new * f_new[:, None]
+            + delta**2 * (f_old * (f_new / safe))[:, None]
+        )
+        var = jnp.maximum(m2 / safe[:, None], 0.0)
+        # widen the smoothing floor if the new batch has larger spread
+        self.epsilon_ = max(self.epsilon_, self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0))))
+        return self._finalize(x, cls, n_tot, means, var)
 
     def _joint_log_likelihood(self, jX):
         means = self.theta_._jarray

@@ -163,3 +163,85 @@ class TestLaplacian(TestCase):
         np.testing.assert_allclose(Ln, Ln.T, atol=1e-5)
         evals = np.linalg.eigvalsh(Ln)
         assert evals.min() > -1e-4  # PSD
+
+
+class TestGaussianNBPartialFit(TestCase):
+    """Streaming moment merge (reference partial_fit; Chan pooled update)."""
+
+    def test_streaming_matches_batch_and_sklearn(self):
+        from sklearn.naive_bayes import GaussianNB as SKNB
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((120, 5)).astype(np.float32) + 2
+        y = rng.integers(0, 3, 120).astype(np.int32)
+        batch = ht.naive_bayes.GaussianNB().fit(ht.array(X, split=0), ht.array(y, split=0))
+        nb = ht.naive_bayes.GaussianNB()
+        nb.partial_fit(ht.array(X[:40], split=0), ht.array(y[:40], split=0), classes=np.array([0, 1, 2]))
+        nb.partial_fit(ht.array(X[40:80], split=0), ht.array(y[40:80], split=0))
+        nb.partial_fit(ht.array(X[80:], split=0), ht.array(y[80:], split=0))
+        np.testing.assert_allclose(nb.theta_.numpy(), batch.theta_.numpy(), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(nb.var_.numpy(), batch.var_.numpy(), rtol=1e-2, atol=1e-3)
+        sk = SKNB()
+        sk.partial_fit(X[:40], y[:40], classes=[0, 1, 2])
+        sk.partial_fit(X[40:80], y[40:80])
+        sk.partial_fit(X[80:], y[80:])
+        np.testing.assert_allclose(nb.theta_.numpy(), sk.theta_, rtol=1e-3, atol=1e-3)
+        pred = nb.predict(ht.array(X, split=0)).numpy()
+        assert (pred == batch.predict(ht.array(X, split=0)).numpy()).all()
+
+    def test_first_call_requires_classes(self):
+        rng = np.random.default_rng(1)
+        X = ht.array(rng.standard_normal((16, 3)).astype(np.float32), split=0)
+        y = ht.array(rng.integers(0, 2, 16).astype(np.int32), split=0)
+        with pytest.raises(ValueError):
+            ht.naive_bayes.GaussianNB().partial_fit(X, y)
+
+    def test_unseen_label_raises(self):
+        rng = np.random.default_rng(2)
+        X = ht.array(rng.standard_normal((16, 3)).astype(np.float32), split=0)
+        y = ht.array(rng.integers(0, 2, 16).astype(np.int32), split=0)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.partial_fit(X, y, classes=np.array([0, 1]))
+        bad = ht.array(np.full(16, 9, np.int32), split=0)
+        with pytest.raises(ValueError):
+            nb.partial_fit(X, bad)
+
+
+class TestDMDPredict(TestCase):
+    def test_trajectory_matches_linear_system(self):
+        rng = np.random.default_rng(3)
+        A = np.diag([0.9, 0.8, 0.7, 0.6, 0.5, 0.4]).astype(np.float32)
+        snaps = np.zeros((6, 30), np.float32)
+        snaps[:, 0] = rng.standard_normal(6)
+        for t in range(1, 30):
+            snaps[:, t] = A @ snaps[:, t - 1]
+        d = ht.decomposition.DMD(svd_rank=6).fit(ht.array(snaps, split=1))
+        x0 = ht.array(snaps[:, 0])
+        traj = d.predict(x0, 3)
+        want = np.stack([np.linalg.matrix_power(A, t) @ snaps[:, 0] for t in (1, 2, 3)])
+        np.testing.assert_allclose(traj.numpy(), want, rtol=1e-2, atol=1e-3)
+        # non-contiguous step list
+        traj2 = d.predict(x0, [2, 5])
+        np.testing.assert_allclose(
+            traj2.numpy()[1], np.linalg.matrix_power(A, 5) @ snaps[:, 0], rtol=1e-2, atol=1e-3
+        )
+
+    def test_predict_before_fit_raises(self):
+        with pytest.raises(RuntimeError):
+            ht.decomposition.DMD().predict(ht.zeros((4,)), 2)
+
+    def test_numpy_int_and_invalid_steps(self):
+        rng = np.random.default_rng(5)
+        A = np.diag([0.9, 0.5]).astype(np.float32)
+        snaps = np.zeros((2, 12), np.float32)
+        snaps[:, 0] = rng.standard_normal(2)
+        for t in range(1, 12):
+            snaps[:, t] = A @ snaps[:, t - 1]
+        d = ht.decomposition.DMD(svd_rank=2).fit(ht.array(snaps, split=1))
+        x0 = ht.array(snaps[:, 0])
+        traj = d.predict(x0, np.int64(2))  # numpy integer scalar accepted
+        assert traj.shape == (2, 2)
+        with pytest.raises(ValueError):
+            d.predict(x0, [])
+        with pytest.raises(ValueError):
+            d.predict(x0, 0)
