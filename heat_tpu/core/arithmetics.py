@@ -345,3 +345,93 @@ DNDarray.cumprod = cumprod
 DNDarray.nansum = nansum
 DNDarray.fmod = fmod
 DNDarray.mod = mod
+
+
+def reciprocal(x, out=None) -> DNDarray:
+    """Elementwise ``1/x``."""
+    return _local_op(jnp.reciprocal, x, out=out)
+
+
+def nextafter(t1, t2) -> DNDarray:
+    """Next representable float after ``t1`` toward ``t2``."""
+    return _binary_op(jnp.nextafter, t1, t2)
+
+
+def spacing(x, out=None) -> DNDarray:
+    """Distance to the next representable float (numpy ``spacing``)."""
+    return _local_op(jnp.spacing, x, out=out)
+
+
+def ediff1d(x, to_end=None, to_begin=None) -> DNDarray:
+    """Differences of consecutive elements of the raveled array."""
+    res = jnp.ediff1d(
+        x._jarray,
+        to_end=None if to_end is None else jnp.asarray(np.asarray(to_end)),
+        to_begin=None if to_begin is None else jnp.asarray(np.asarray(to_begin)),
+    )
+    from .manipulations import _wrap
+
+    return _wrap(res, 0 if x.split is not None else None, x)
+
+
+def gradient(f: DNDarray, *varargs, axis=None, edge_order: int = 1):
+    """Central-difference gradient (numpy semantics).
+
+    Returns one DNDarray per requested axis (or a single one for 1 axis);
+    each keeps the input's split — the stencil's neighbor exchange is derived
+    by XLA from the sharded slices.
+    """
+    from .manipulations import _wrap
+
+    if edge_order != 1:
+        raise NotImplementedError("gradient supports edge_order=1 only (XLA backend)")
+    jv = [v._jarray if isinstance(v, DNDarray) else v for v in varargs]
+    res = jnp.gradient(f._jarray, *jv, axis=axis)
+    if isinstance(res, (list, tuple)):
+        return [_wrap(r, f.split, f) for r in res]
+    return _wrap(res, f.split, f)
+
+
+def interp(x, xp, fp, left=None, right=None, period=None) -> DNDarray:
+    """1-D linear interpolation of ``x`` against sample points (xp, fp)."""
+    from .manipulations import _wrap
+
+    jx = x._jarray if isinstance(x, DNDarray) else jnp.asarray(np.asarray(x))
+    jxp = xp._jarray if isinstance(xp, DNDarray) else jnp.asarray(np.asarray(xp))
+    jfp = fp._jarray if isinstance(fp, DNDarray) else jnp.asarray(np.asarray(fp))
+    res = jnp.interp(jx, jxp, jfp, left=left, right=right, period=period)
+    proto = x if isinstance(x, DNDarray) else (xp if isinstance(xp, DNDarray) else fp)
+    split = x.split if isinstance(x, DNDarray) else None
+    return _wrap(res, split, proto)
+
+
+def nancumsum(x, axis: int = None, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum treating NaN as zero."""
+    return _cum_op(jnp.nancumsum, x, axis=axis, dtype=dtype, out=out)
+
+
+def nancumprod(x, axis: int = None, dtype=None, out=None) -> DNDarray:
+    """Cumulative product treating NaN as one."""
+    return _cum_op(jnp.nancumprod, x, axis=axis, dtype=dtype, out=out)
+
+
+def i0(x) -> DNDarray:
+    """Modified Bessel function of the first kind, order 0."""
+    return _local_op(jnp.i0, x)
+
+
+__all__ += ["ediff1d", "gradient", "i0", "interp", "nancumprod", "nancumsum", "nextafter", "reciprocal", "spacing"]
+
+
+# array-API bitwise aliases (numpy 2 names)
+bitwise_invert = invert
+bitwise_left_shift = left_shift
+bitwise_right_shift = right_shift
+
+
+def bitwise_count(x, out=None) -> DNDarray:
+    """Number of set bits per element (numpy ``bitwise_count``)."""
+    return _local_op(jnp.bitwise_count, x, out=out)
+
+
+__all__ += ["bitwise_count", "bitwise_invert", "bitwise_left_shift", "bitwise_right_shift"]

@@ -291,3 +291,164 @@ def from_partitioned(x, comm=None) -> DNDarray:
         chunks.append(np.asarray(data))
     full_arr = np.concatenate(chunks, axis=split or 0) if len(chunks) > 1 else chunks[0]
     return array(full_arr.reshape(shape), split=split, comm=comm)
+
+
+def identity(n: int, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """n×n identity matrix (numpy ``identity``)."""
+    return eye(int(n), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def geomspace(start, stop, num: int = 50, endpoint: bool = True, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Log-spaced samples between start and stop (inclusive ends)."""
+    dt = types.canonical_heat_type(dtype) if dtype is not None else types.float32
+    jarr = jnp.geomspace(start, stop, num=num, endpoint=endpoint, dtype=dt.jax_dtype())
+    return _finalize(jarr, split, device, comm, dt)
+
+
+def tri(N: int, M=None, k: int = 0, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Lower-triangular ones matrix."""
+    dt = types.canonical_heat_type(dtype)
+    jarr = jnp.tri(int(N), None if M is None else int(M), k, dtype=dt.jax_dtype())
+    return _finalize(jarr, split, device, comm, dt)
+
+
+def vander(x, N=None, increasing: bool = False) -> DNDarray:
+    """Vandermonde matrix of a 1-D input; rows follow the input's split."""
+    from .dndarray import DNDarray as _D
+
+    jx = x._jarray if isinstance(x, _D) else jnp.asarray(np.asarray(x))
+    jarr = jnp.vander(jx, N=N, increasing=increasing)
+    if isinstance(x, _D):
+        split = 0 if x.split is not None else None
+        jarr = x.comm.shard(jarr, split)
+        return _D(jarr, tuple(jarr.shape), types.canonical_heat_type(jarr.dtype), split, x.device, x.comm, True)
+    return _finalize(jarr, None, None, None, types.canonical_heat_type(jarr.dtype))
+
+
+def indices(dimensions, dtype=types.int32, sparse: bool = False):
+    """Grid-index arrays (numpy ``indices``); replicated."""
+    dt = types.canonical_heat_type(dtype)
+    res = jnp.indices(tuple(int(d) for d in dimensions), dtype=dt.jax_dtype(), sparse=sparse)
+    if sparse:
+        return tuple(_finalize(r, None, None, None, dt) for r in res)
+    return _finalize(res, None, None, None, dt)
+
+
+def ix_(*args):
+    """Open-mesh index arrays from 1-D sequences (numpy ``ix_``)."""
+    from .dndarray import DNDarray as _D
+
+    js = [a._jarray if isinstance(a, _D) else jnp.asarray(np.asarray(a)) for a in args]
+    outs = jnp.ix_(*js)
+    return tuple(_finalize(o, None, None, None, types.canonical_heat_type(o.dtype)) for o in outs)
+
+
+def diag_indices(n: int, ndim: int = 2):
+    """Index arrays addressing the main diagonal of an ndim-cube."""
+    res = jnp.diag_indices(int(n), int(ndim))
+    return tuple(_finalize(r, None, None, None, types.canonical_heat_type(r.dtype)) for r in res)
+
+
+def diag_indices_from(arr) -> tuple:
+    if arr.ndim < 2 or len(set(arr.shape)) != 1:
+        raise ValueError("input must be square along every axis")
+    return diag_indices(arr.shape[0], arr.ndim)
+
+
+def tril_indices_from(arr, k: int = 0):
+    from .indexing import tril_indices
+
+    if arr.ndim != 2:
+        raise ValueError("input must be 2-D")
+    return tril_indices(arr.shape[0], k=k, m=arr.shape[1])
+
+
+def triu_indices_from(arr, k: int = 0):
+    from .indexing import triu_indices
+
+    if arr.ndim != 2:
+        raise ValueError("input must be 2-D")
+    return triu_indices(arr.shape[0], k=k, m=arr.shape[1])
+
+
+def unravel_index(idx, shape):
+    from .dndarray import DNDarray as _D
+
+    ji = idx._jarray if isinstance(idx, _D) else jnp.asarray(np.asarray(idx))
+    res = jnp.unravel_index(ji, tuple(int(s) for s in shape))
+    if isinstance(idx, _D):
+        outs = []
+        for r in res:
+            r = idx.comm.shard(r, idx.split)
+            outs.append(_D(r, tuple(r.shape), types.canonical_heat_type(r.dtype), idx.split, idx.device, idx.comm, True))
+        return tuple(outs)
+    return tuple(_finalize(r, None, None, None, types.canonical_heat_type(r.dtype)) for r in res)
+
+
+def ravel_multi_index(multi_index, dims, mode: str = "raise", order: str = "C"):
+    from .dndarray import DNDarray as _D
+
+    js = [m._jarray if isinstance(m, _D) else jnp.asarray(np.asarray(m)) for m in multi_index]
+    dims_t = tuple(int(d) for d in dims)
+    if mode == "raise":
+        # numpy contract: out-of-bounds multi-indices are an error; validate
+        # eagerly, then index with clip semantics
+        for j, d in zip(js, dims_t):
+            lo = int(jnp.min(j)) if j.size else 0
+            hi = int(jnp.max(j)) if j.size else 0
+            if lo < 0 or hi >= d:
+                raise ValueError(f"invalid entry in coordinates array (range [{lo}, {hi}] for dim {d})")
+        mode = "clip"
+    res = jnp.ravel_multi_index(tuple(js), dims_t, mode=mode, order=order)
+    proto = next((m for m in multi_index if isinstance(m, _D)), None)
+    if proto is not None:
+        r = proto.comm.shard(res, proto.split)
+        return _D(r, tuple(r.shape), types.canonical_heat_type(r.dtype), proto.split, proto.device, proto.comm, True)
+    return _finalize(res, None, None, None, types.canonical_heat_type(res.dtype))
+
+
+def _window(fn, M: int) -> DNDarray:
+    jarr = fn(int(M))
+    return _finalize(jarr, None, None, None, types.canonical_heat_type(jarr.dtype))
+
+
+def bartlett(M: int) -> DNDarray:
+    return _window(jnp.bartlett, M)
+
+
+def blackman(M: int) -> DNDarray:
+    return _window(jnp.blackman, M)
+
+
+def hamming(M: int) -> DNDarray:
+    return _window(jnp.hamming, M)
+
+
+def hanning(M: int) -> DNDarray:
+    return _window(jnp.hanning, M)
+
+
+def kaiser(M: int, beta: float) -> DNDarray:
+    jarr = jnp.kaiser(int(M), beta)
+    return _finalize(jarr, None, None, None, types.canonical_heat_type(jarr.dtype))
+
+
+__all__ += [
+    "bartlett",
+    "blackman",
+    "diag_indices",
+    "diag_indices_from",
+    "geomspace",
+    "hamming",
+    "hanning",
+    "identity",
+    "indices",
+    "ix_",
+    "kaiser",
+    "ravel_multi_index",
+    "tri",
+    "tril_indices_from",
+    "triu_indices_from",
+    "unravel_index",
+    "vander",
+]

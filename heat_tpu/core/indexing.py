@@ -88,3 +88,16 @@ def where(cond, x=None, y=None) -> DNDarray:
 
 
 DNDarray.nonzero = nonzero
+
+
+def mask_indices(n: int, mask_func, k: int = 0):
+    """Indices selected by a mask function over an (n, n) grid (numpy)."""
+    import numpy as np
+
+    rows, cols = np.mask_indices(n, mask_func, k)
+    from . import factories
+
+    return factories.array(rows, split=None), factories.array(cols, split=None)
+
+
+__all__ += ["mask_indices"]

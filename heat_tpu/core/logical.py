@@ -101,3 +101,57 @@ DNDarray.all = all
 DNDarray.any = any
 DNDarray.allclose = allclose
 DNDarray.isclose = isclose
+
+
+def array_equal(a1, a2) -> bool:
+    """True iff shapes match and all elements are equal (numpy semantics)."""
+    j1 = a1._jarray if isinstance(a1, DNDarray) else jnp.asarray(np.asarray(a1))
+    j2 = a2._jarray if isinstance(a2, DNDarray) else jnp.asarray(np.asarray(a2))
+    if j1.shape != j2.shape:
+        return False
+    return bool(jnp.all(j1 == j2))
+
+
+def array_equiv(a1, a2) -> bool:
+    """True iff the inputs are broadcast-compatible and equal everywhere."""
+    j1 = a1._jarray if isinstance(a1, DNDarray) else jnp.asarray(np.asarray(a1))
+    j2 = a2._jarray if isinstance(a2, DNDarray) else jnp.asarray(np.asarray(a2))
+    try:
+        jnp.broadcast_shapes(j1.shape, j2.shape)
+    except ValueError:
+        return False
+    return bool(jnp.all(j1 == j2))
+
+
+def isin(element, test_elements, assume_unique: bool = False, invert: bool = False) -> DNDarray:
+    """Elementwise membership of ``element`` in ``test_elements``."""
+    from ._operations import _local_op
+
+    jt = test_elements._jarray if isinstance(test_elements, DNDarray) else jnp.asarray(np.asarray(test_elements))
+    return _local_op(lambda a: jnp.isin(a, jt, assume_unique=assume_unique, invert=invert), element)
+
+
+def in1d(ar1, ar2, assume_unique: bool = False, invert: bool = False) -> DNDarray:
+    """1-D membership (legacy numpy name; ``isin`` on the raveled input)."""
+    from .manipulations import ravel
+
+    return isin(ravel(ar1), ar2, assume_unique=assume_unique, invert=invert)
+
+
+def iscomplexobj(x) -> bool:
+    dt = x.dtype.jax_dtype() if isinstance(x, DNDarray) else np.asarray(x).dtype
+    return jnp.issubdtype(dt, jnp.complexfloating)
+
+
+def isrealobj(x) -> bool:
+    return not iscomplexobj(x)
+
+
+def isscalar(x) -> bool:
+    """numpy.isscalar semantics: Python/numpy scalars, NOT 0-d arrays."""
+    if isinstance(x, DNDarray):
+        return False
+    return np.isscalar(x)
+
+
+__all__ += ["array_equal", "array_equiv", "in1d", "iscomplexobj", "isin", "isrealobj", "isscalar"]

@@ -612,3 +612,440 @@ DNDarray.broadcast_to = broadcast_to
 DNDarray.concatenate = lambda self, others, axis=0: concatenate([self] + ([others] if isinstance(others, DNDarray) else list(others)), axis=axis)
 DNDarray.diagonal = diagonal
 DNDarray.shuffle = shuffle
+
+
+# --------------------------------------------------------------------------- #
+# numpy-parity batch (round 3): sorting/selection, set ops, reorder helpers.
+# All value work is global-jnp (GSPMD partitions it); split bookkeeping
+# follows the same rules as the ops above.  Data-dependent output shapes
+# (set ops, trim_zeros, extract) are eager, like `unique`/`nonzero`.
+# --------------------------------------------------------------------------- #
+
+
+def argsort(x: DNDarray, axis: int = -1, descending: bool = False) -> DNDarray:
+    """Indices that sort ``x`` along axis (global indices; see ``sort``)."""
+    _, idx = sort(x, axis=axis, descending=descending)
+    return idx
+
+
+def argwhere(x: DNDarray) -> DNDarray:
+    """(nnz, ndim) global indices of nonzero entries (eager)."""
+    from .indexing import nonzero
+
+    res = nonzero(x)
+    if x.ndim == 1:
+        return _wrap(res._jarray[:, None], res.split, x)
+    return res
+
+
+def searchsorted(a: DNDarray, v, side: str = "left", sorter=None) -> DNDarray:
+    """Insertion indices into the sorted 1-D array ``a``."""
+    jv = v._jarray if isinstance(v, DNDarray) else jnp.asarray(v)
+    ja = a._jarray
+    if sorter is not None:
+        js = sorter._jarray if isinstance(sorter, DNDarray) else jnp.asarray(sorter)
+        ja = ja[js]
+    res = jnp.searchsorted(ja, jv, side=side)
+    proto = v if isinstance(v, DNDarray) else a
+    return _wrap(res, proto.split if isinstance(v, DNDarray) else None, a)
+
+
+def take(a: DNDarray, indices, axis: Optional[int] = None) -> DNDarray:
+    """Take elements by (global) index, optionally along an axis.
+
+    Split bookkeeping: the taken axis is replaced by the index array's axes
+    (numpy), so a split before it is kept, ON it is kept when indices are
+    ≥1-D (the gathered axis stays shardable), after it shifts by
+    ``indices.ndim - 1``.
+    """
+    ji = indices._jarray if isinstance(indices, DNDarray) else jnp.asarray(np.asarray(indices))
+    res = jnp.take(a._jarray, ji, axis=axis)
+    if axis is None:
+        split = 0 if a.split is not None and res.ndim else None
+    else:
+        axis = sanitize_axis(a.shape, axis)
+        if a.split is None:
+            split = None
+        elif a.split < axis:
+            split = a.split
+        elif a.split == axis:
+            split = axis if ji.ndim >= 1 else None
+        else:
+            split = a.split + ji.ndim - 1
+    return _wrap(res, split, a)
+
+
+def take_along_axis(a: DNDarray, indices: DNDarray, axis: int) -> DNDarray:
+    ji = indices._jarray if isinstance(indices, DNDarray) else jnp.asarray(np.asarray(indices))
+    res = jnp.take_along_axis(a._jarray, ji, axis=sanitize_axis(a.shape, axis))
+    return _wrap(res, a.split, a)
+
+
+def partition(x: DNDarray, kth: int, axis: int = -1) -> DNDarray:
+    """Partial sort: element ``kth`` is in sorted position along axis."""
+    res = jnp.partition(x._jarray, kth, axis=sanitize_axis(x.shape, axis))
+    return _wrap(res, x.split, x)
+
+
+def argpartition(x: DNDarray, kth: int, axis: int = -1) -> DNDarray:
+    res = jnp.argpartition(x._jarray, kth, axis=sanitize_axis(x.shape, axis))
+    return _wrap(res.astype(jnp.int32), x.split, x)
+
+
+def lexsort(keys, axis: int = -1) -> DNDarray:
+    """Indirect stable sort on multiple keys (last key is primary)."""
+    jks = [k._jarray if isinstance(k, DNDarray) else jnp.asarray(k) for k in keys]
+    proto = next((k for k in keys if isinstance(k, DNDarray)), None)
+    if proto is None:
+        raise TypeError("lexsort needs at least one DNDarray key")
+    res = jnp.lexsort(jks, axis=axis)
+    return _wrap(res.astype(jnp.int32), proto.split, proto)
+
+
+def sort_complex(x: DNDarray) -> DNDarray:
+    res = jnp.sort_complex(x._jarray)
+    return _wrap(res, x.split, x)
+
+
+def compress(condition, a: DNDarray, axis: Optional[int] = None) -> DNDarray:
+    """Select slices where ``condition`` holds (eager: data-dependent size)."""
+    jc = condition._jarray if isinstance(condition, DNDarray) else jnp.asarray(np.asarray(condition))
+    res = jnp.compress(jc, a._jarray, axis=axis)
+    split = (0 if a.split is not None else None) if axis is None else a.split
+    return _wrap(res, split, a)
+
+
+def extract(condition, a: DNDarray) -> DNDarray:
+    """1-D array of elements where ``condition`` holds (eager)."""
+    jc = condition._jarray if isinstance(condition, DNDarray) else jnp.asarray(np.asarray(condition))
+    res = jnp.extract(jc, a._jarray)
+    return _wrap(res, 0 if a.split is not None else None, a)
+
+
+def select(condlist, choicelist, default=0) -> DNDarray:
+    """First-match multiplexer over condition/choice lists."""
+    jconds = [c._jarray if isinstance(c, DNDarray) else jnp.asarray(np.asarray(c)) for c in condlist]
+    jchoices = [c._jarray if isinstance(c, DNDarray) else jnp.asarray(np.asarray(c)) for c in choicelist]
+    proto = next(
+        (c for c in list(condlist) + list(choicelist) if isinstance(c, DNDarray)), None
+    )
+    if proto is None:
+        raise TypeError("select needs at least one DNDarray operand")
+    res = jnp.select(jconds, jchoices, default=default)
+    return _wrap(res, proto.split, proto)
+
+
+def choose(a: DNDarray, choices, mode: str = "raise") -> DNDarray:
+    jch = [c._jarray if isinstance(c, DNDarray) else jnp.asarray(np.asarray(c)) for c in choices]
+    if mode == "raise":
+        # numpy contract: out-of-range selectors are an error; validate
+        # eagerly (one cheap reduction), then index with clip semantics
+        lo = int(jnp.min(a._jarray)) if a.size else 0
+        hi = int(jnp.max(a._jarray)) if a.size else 0
+        if lo < 0 or hi >= len(jch):
+            raise ValueError(f"invalid entry in choice array (range [{lo}, {hi}], {len(jch)} choices)")
+        mode = "clip"
+    res = jnp.choose(a._jarray, jch, mode=mode)
+    return _wrap(res, a.split, a)
+
+
+def resize(a: DNDarray, new_shape) -> DNDarray:
+    """Resize with repetition/truncation (numpy semantics; replicated —
+    the cyclic repeat has no natural shard alignment)."""
+    res = jnp.resize(a._jarray, new_shape)
+    return _wrap(res, None, a)
+
+
+def rollaxis(a: DNDarray, axis: int, start: int = 0) -> DNDarray:
+    axis = sanitize_axis(a.shape, axis)
+    if start < 0:
+        start += a.ndim
+    dest = start if start <= axis else start - 1
+    return moveaxis(a, axis, dest)
+
+
+def trim_zeros(x: DNDarray, trim: str = "fb") -> DNDarray:
+    """Trim leading/trailing zeros of a 1-D array (eager)."""
+    res = jnp.asarray(np.trim_zeros(np.asarray(x.numpy()), trim))
+    return _wrap(res, 0 if x.split is not None else None, x)
+
+
+def diagflat(v, k: int = 0) -> DNDarray:
+    jv = v._jarray if isinstance(v, DNDarray) else jnp.asarray(np.asarray(v))
+    res = jnp.diagflat(jv, k)
+    proto = v if isinstance(v, DNDarray) else None
+    if proto is None:
+        raise TypeError("diagflat needs a DNDarray input")
+    return _wrap(res, 0 if proto.split is not None else None, proto)
+
+
+def fill_diagonal(a: DNDarray, val, wrap: bool = False) -> None:
+    """Set the diagonal IN-PLACE (numpy semantics; functional under the hood:
+    the sharded buffer is rebuilt with the diagonal scattered)."""
+    jv = val._jarray if isinstance(val, DNDarray) else val
+    a._jarray = jnp.fill_diagonal(a._jarray, jv, inplace=False, wrap=wrap)
+
+
+def unwrap(p: DNDarray, discont=None, axis: int = -1, period: float = 6.283185307179586) -> DNDarray:
+    res = jnp.unwrap(p._jarray, discont=discont, axis=axis, period=period)
+    return _wrap(res, p.split, p)
+
+
+# ---- set operations (eager: data-dependent output sizes) ------------------ #
+
+
+def _set_op(fn, ar1, ar2, **kw) -> DNDarray:
+    j1 = ar1._jarray if isinstance(ar1, DNDarray) else jnp.asarray(np.asarray(ar1))
+    j2 = ar2._jarray if isinstance(ar2, DNDarray) else jnp.asarray(np.asarray(ar2))
+    proto = ar1 if isinstance(ar1, DNDarray) else ar2
+    if not isinstance(proto, DNDarray):
+        raise TypeError("set operations need at least one DNDarray operand")
+    res = fn(j1, j2, **kw)
+    split = 0 if (getattr(ar1, "split", None) is not None or getattr(ar2, "split", None) is not None) else None
+    return _wrap(res, split, proto)
+
+
+def union1d(ar1, ar2) -> DNDarray:
+    return _set_op(jnp.union1d, ar1, ar2)
+
+
+def intersect1d(ar1, ar2, assume_unique: bool = False) -> DNDarray:
+    return _set_op(jnp.intersect1d, ar1, ar2, assume_unique=assume_unique)
+
+
+def setdiff1d(ar1, ar2, assume_unique: bool = False) -> DNDarray:
+    return _set_op(jnp.setdiff1d, ar1, ar2, assume_unique=assume_unique)
+
+
+def setxor1d(ar1, ar2, assume_unique: bool = False) -> DNDarray:
+    return _set_op(jnp.setxor1d, ar1, ar2, assume_unique=assume_unique)
+
+
+concat = concatenate
+
+
+def permute_dims(a: DNDarray, axes=None) -> DNDarray:
+    """Array-API name for transpose."""
+    from ..linalg.basics import transpose as _transpose
+
+    return _transpose(a, axes)
+
+
+def matrix_transpose(a: DNDarray) -> DNDarray:
+    """Swap the last two axes (array-API / numpy 2 semantics)."""
+    if a.ndim < 2:
+        raise ValueError("matrix_transpose requires ndim >= 2")
+    return swapaxes(a, -1, -2)
+
+
+__all__ += [
+    "argpartition",
+    "argsort",
+    "argwhere",
+    "choose",
+    "compress",
+    "concat",
+    "diagflat",
+    "extract",
+    "fill_diagonal",
+    "intersect1d",
+    "lexsort",
+    "matrix_transpose",
+    "partition",
+    "permute_dims",
+    "resize",
+    "rollaxis",
+    "searchsorted",
+    "select",
+    "setdiff1d",
+    "setxor1d",
+    "sort_complex",
+    "take",
+    "take_along_axis",
+    "trim_zeros",
+    "union1d",
+    "unwrap",
+]
+
+DNDarray.take = take
+DNDarray.argsort = argsort
+
+
+# ---- final numpy-parity mop-up: aliases, mutators, apply helpers ---------- #
+
+
+def append(arr: DNDarray, values, axis: Optional[int] = None) -> DNDarray:
+    """Append values (numpy semantics: raveled when axis is None)."""
+    jv = values._jarray if isinstance(values, DNDarray) else jnp.asarray(np.asarray(values))
+    res = jnp.append(arr._jarray, jv, axis=axis)
+    split = (0 if arr.split is not None else None) if axis is None else arr.split
+    return _wrap(res, split, arr)
+
+
+def astype(x: DNDarray, dtype, copy: bool = True) -> DNDarray:
+    """Free-function dtype cast (numpy 2 / array-API)."""
+    return x.astype(dtype, copy=copy)
+
+
+def ascontiguousarray(a, dtype=None) -> DNDarray:
+    """XLA buffers are always dense row-major; this is array() + cast."""
+    res = a if isinstance(a, DNDarray) else factories.array(a)
+    return res.astype(dtype) if dtype is not None else res
+
+
+asfortranarray = ascontiguousarray  # layout is an XLA-internal concern
+
+
+def array2string(a: DNDarray, *args, **kwargs) -> str:
+    return np.array2string(np.asarray(a.numpy()), *args, **kwargs)
+
+
+def array_str(a: DNDarray) -> str:
+    return str(a)
+
+
+def array_repr(a: DNDarray) -> str:
+    return repr(a)
+
+
+def put_along_axis(arr: DNDarray, indices, values, axis: int) -> None:
+    """Scatter values along axis IN-PLACE (functional under the hood)."""
+    ji = indices._jarray if isinstance(indices, DNDarray) else jnp.asarray(np.asarray(indices))
+    jv = values._jarray if isinstance(values, DNDarray) else jnp.asarray(np.asarray(values))
+    arr._jarray = jnp.put_along_axis(arr._jarray, ji, jv, axis, inplace=False)
+
+
+def put(a: DNDarray, ind, v, mode: str = "raise") -> None:
+    """Set flat-indexed elements IN-PLACE (numpy ``put``: a short value list
+    cycles; ``mode`` ∈ raise/wrap/clip governs out-of-bounds indices)."""
+    ji = jnp.atleast_1d(ind._jarray if isinstance(ind, DNDarray) else jnp.asarray(np.asarray(ind)))
+    jv = jnp.atleast_1d(v._jarray if isinstance(v, DNDarray) else jnp.asarray(np.asarray(v))).reshape(-1)
+    n = a.size
+    if mode == "raise":
+        lo = int(jnp.min(ji)) if ji.size else 0
+        hi = int(jnp.max(ji)) if ji.size else 0
+        if lo < -n or hi >= n:
+            raise IndexError(f"index out of range for array of size {n} (range [{lo}, {hi}])")
+        ji = jnp.where(ji < 0, ji + n, ji)
+    elif mode == "wrap":
+        ji = jnp.mod(ji, n)
+    elif mode == "clip":
+        ji = jnp.clip(ji, 0, n - 1)
+    else:
+        raise ValueError(f"mode must be raise/wrap/clip, got {mode!r}")
+    # numpy cycles a shorter value list over the indices
+    reps = -(-ji.size // jv.size)
+    jv = jnp.tile(jv, reps)[: ji.size]
+    flat = a._jarray.reshape(-1)
+    a._jarray = a.comm.shard(flat.at[ji].set(jv.astype(flat.dtype)).reshape(a._jarray.shape), a.split)
+
+
+def place(arr: DNDarray, mask, vals) -> None:
+    """Set masked elements from a cyclically-repeated value list IN-PLACE."""
+    jm = mask._jarray if isinstance(mask, DNDarray) else jnp.asarray(np.asarray(mask))
+    res = np.asarray(arr.numpy()).copy()
+    np.place(res, np.asarray(jm), np.asarray(vals))
+    arr._jarray = arr.comm.shard(jnp.asarray(res), arr.split)
+
+
+def putmask(a: DNDarray, mask, values) -> None:
+    """Set masked elements (values broadcast/cycled) IN-PLACE."""
+    jm = mask._jarray if isinstance(mask, DNDarray) else jnp.asarray(np.asarray(mask))
+    jv = values._jarray if isinstance(values, DNDarray) else jnp.asarray(np.asarray(values))
+    if jv.shape == a._jarray.shape:
+        a._jarray = jnp.where(jm, jv, a._jarray)
+    else:
+        res = np.asarray(a.numpy()).copy()
+        np.putmask(res, np.asarray(jm), np.asarray(jv))
+        a._jarray = a.comm.shard(jnp.asarray(res), a.split)
+
+
+def apply_along_axis(func1d, axis: int, arr: DNDarray, *args, **kwargs) -> DNDarray:
+    """Apply a 1-D function along an axis (vmapped over the other axes when
+    the function is jnp-traceable; numpy fallback otherwise)."""
+    res = jnp.apply_along_axis(func1d, sanitize_axis(arr.shape, axis), arr._jarray, *args, **kwargs)
+    split = arr.split if arr.split is not None and arr.split < res.ndim else None
+    return _wrap(res, split, arr)
+
+
+def apply_over_axes(func, a: DNDarray, axes) -> DNDarray:
+    res = jnp.apply_over_axes(lambda x, ax: func(x, ax), a._jarray, axes)
+    split = a.split if a.split is not None and a.split < res.ndim else None
+    return _wrap(res, split, a)
+
+
+def piecewise(x: DNDarray, condlist, funclist, *args, **kw) -> DNDarray:
+    jconds = [c._jarray if isinstance(c, DNDarray) else jnp.asarray(np.asarray(c)) for c in condlist]
+    res = jnp.piecewise(x._jarray, jconds, funclist, *args, **kw)
+    return _wrap(res, x.split, x)
+
+
+def unique_all(x: DNDarray):
+    """Array-API quartet: (values, indices, inverse_indices, counts)."""
+    j = x._jarray
+    vals, idx, inv, cnt = jnp.unique(j, return_index=True, return_inverse=True, return_counts=True)
+    outs = []
+    for r in (vals, idx, inv.reshape(j.shape), cnt):
+        outs.append(_wrap(r, 0 if x.split is not None and r.ndim else None, x))
+    import collections
+
+    UA = collections.namedtuple("UniqueAllResult", "values indices inverse_indices counts")
+    return UA(*outs)
+
+
+def unique_counts(x: DNDarray):
+    import collections
+
+    vals, cnt = jnp.unique(x._jarray, return_counts=True)
+    UC = collections.namedtuple("UniqueCountsResult", "values counts")
+    s = 0 if x.split is not None else None
+    return UC(_wrap(vals, s, x), _wrap(cnt, s, x))
+
+
+def unique_inverse(x: DNDarray):
+    import collections
+
+    vals, inv = jnp.unique(x._jarray, return_inverse=True)
+    UI = collections.namedtuple("UniqueInverseResult", "values inverse_indices")
+    s = 0 if x.split is not None else None
+    return UI(_wrap(vals, s, x), _wrap(inv.reshape(x._jarray.shape), x.split, x))
+
+
+def unique_values(x: DNDarray) -> DNDarray:
+    vals = jnp.unique(x._jarray)
+    return _wrap(vals, 0 if x.split is not None else None, x)
+
+
+__all__ += [
+    "append",
+    "apply_along_axis",
+    "apply_over_axes",
+    "array2string",
+    "array_repr",
+    "array_str",
+    "ascontiguousarray",
+    "asfortranarray",
+    "astype",
+    "piecewise",
+    "place",
+    "put",
+    "put_along_axis",
+    "putmask",
+    "unique_all",
+    "unique_counts",
+    "unique_inverse",
+    "unique_values",
+]
+
+
+def copyto(dst: DNDarray, src, casting: str = "same_kind", where=True) -> None:
+    """Copy values into ``dst`` IN-PLACE with broadcasting (numpy ``copyto``)."""
+    js = src._jarray if isinstance(src, DNDarray) else jnp.asarray(np.asarray(src))
+    jw = where._jarray if isinstance(where, DNDarray) else where
+    res = jnp.broadcast_to(js, dst._jarray.shape).astype(dst._jarray.dtype)
+    if jw is not True:
+        res = jnp.where(jw, res, dst._jarray)
+    dst._jarray = dst.comm.shard(res, dst.split)
+
+
+__all__ += ["copyto"]

@@ -109,3 +109,25 @@ DNDarray.modf = modf
 DNDarray.round = round
 DNDarray.trunc = trunc
 DNDarray.sign = sign
+
+
+def fix(x, out=None) -> DNDarray:
+    """Round toward zero (numpy ``fix``; equals ``trunc`` for floats)."""
+    return _local_op(jnp.trunc, x, out=out)
+
+
+def real_if_close(x, tol: float = 100.0) -> DNDarray:
+    """Drop an all-negligible imaginary part (numpy semantics)."""
+    j = x._jarray
+    if not jnp.issubdtype(j.dtype, jnp.complexfloating):
+        return x
+    finf = jnp.finfo(j.real.dtype)
+    thresh = tol * finf.eps if tol > 1 else tol  # numpy: absolute eps-scaled bound
+    if bool(jnp.all(jnp.abs(j.imag) < thresh)):
+        return _local_op(jnp.real, x)
+    return x
+
+
+around = round
+
+__all__ += ["around", "fix", "real_if_close"]

@@ -202,3 +202,22 @@ def convolve2d(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
     return DNDarray(
         res, tuple(res.shape), types.canonical_heat_type(res.dtype), a.split, a.device, a.comm, True
     )
+
+
+def correlate(a: DNDarray, v: DNDarray, mode: str = "valid") -> DNDarray:
+    """Cross-correlation of 1-D sequences (numpy ``correlate`` semantics:
+    ``a ⋆ v = a * conj(reverse(v))``) — rides the distributed ``convolve``
+    halo path for split signals."""
+    from . import factories, manipulations
+
+    if not isinstance(v, DNDarray):
+        v = factories.array(v)
+    flipped = manipulations.flip(v, 0)
+    if jnp.issubdtype(flipped.dtype.jax_dtype(), jnp.complexfloating):
+        from .complex_math import conjugate
+
+        flipped = conjugate(flipped)
+    return convolve(a, flipped, mode=mode)
+
+
+__all__ += ["correlate"]

@@ -330,3 +330,74 @@ DNDarray.median = median
 DNDarray.percentile = percentile
 DNDarray.kurtosis = kurtosis
 DNDarray.skew = skew
+
+
+amax = max
+amin = min
+
+
+def fmax(t1, t2, out=None) -> DNDarray:
+    """Elementwise max ignoring NaNs (numpy ``fmax``)."""
+    from ._operations import _binary_op
+
+    return _binary_op(jnp.fmax, t1, t2, out=out)
+
+
+def fmin(t1, t2, out=None) -> DNDarray:
+    """Elementwise min ignoring NaNs (numpy ``fmin``)."""
+    from ._operations import _binary_op
+
+    return _binary_op(jnp.fmin, t1, t2, out=out)
+
+
+def nanmedian(x, axis=None, keepdims: bool = False) -> DNDarray:
+    res = jnp.nanmedian(x._jarray.astype(jnp.float32), axis=sanitize_axis(x.shape, axis), keepdims=keepdims)
+    res = x.comm.shard(res, None)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def nanpercentile(x, q, axis=None, keepdims: bool = False, interpolation: str = "linear") -> DNDarray:
+    qj = q._jarray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
+    res = jnp.nanpercentile(x._jarray.astype(jnp.float32), qj, axis=sanitize_axis(x.shape, axis), method=interpolation, keepdims=keepdims)
+    res = x.comm.shard(res, None)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def nanquantile(x, q, axis=None, keepdims: bool = False, interpolation: str = "linear") -> DNDarray:
+    qj = q._jarray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
+    res = jnp.nanquantile(x._jarray.astype(jnp.float32), qj, axis=sanitize_axis(x.shape, axis), method=interpolation, keepdims=keepdims)
+    res = x.comm.shard(res, None)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def histogram_bin_edges(x, bins=10, range=None, weights=None) -> DNDarray:
+    res = jnp.histogram_bin_edges(x._jarray, bins=bins, range=range)
+    res = x.comm.shard(res, None)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def histogram2d(x, y, bins=10, range=None, weights=None, density=None):
+    jw = weights._jarray if isinstance(weights, DNDarray) else weights
+    h, ex, ey = jnp.histogram2d(x._jarray, y._jarray, bins=bins, range=range, weights=jw, density=density)
+
+    def wrap(j):
+        j = x.comm.shard(j, None)
+        return DNDarray(j, tuple(j.shape), types.canonical_heat_type(j.dtype), None, x.device, x.comm, True)
+
+    return wrap(h), wrap(ex), wrap(ey)
+
+
+def histogramdd(sample, bins=10, range=None, weights=None, density=None):
+    js = sample._jarray if isinstance(sample, DNDarray) else jnp.asarray(np.asarray(sample))
+    jw = weights._jarray if isinstance(weights, DNDarray) else weights
+    h, edges = jnp.histogramdd(js, bins=bins, range=range, weights=jw, density=density)
+    proto = sample
+
+    def wrap(j):
+        j = proto.comm.shard(j, None)
+        return DNDarray(j, tuple(j.shape), types.canonical_heat_type(j.dtype), None, proto.device, proto.comm, True)
+
+    return wrap(h), [wrap(e) for e in edges]
+
+
+__all__ += ["amax", "amin", "fmax", "fmin", "histogram2d", "histogram_bin_edges", "histogramdd", "nanmedian", "nanpercentile", "nanquantile"]

@@ -387,3 +387,17 @@ class iinfo:
         self.max = builtins.int(info.max)
         self.min = builtins.int(info.min)
         return self
+
+
+def isdtype(dtype, kind) -> bool:
+    """Array-API dtype predicate (numpy 2 ``isdtype``)."""
+    import numpy as _np
+
+    try:
+        dt = canonical_heat_type(dtype).np_dtype()
+    except (TypeError, ValueError):
+        dt = _np.dtype(dtype)
+    return _np.isdtype(dt, kind)
+
+
+__all__ += ["isdtype"]

@@ -375,3 +375,37 @@ DNDarray.__matmul__ = lambda self, other: matmul(self, other)
 DNDarray.transpose = transpose
 DNDarray.tril = lambda self, k=0: tril(self, k)
 DNDarray.triu = lambda self, k=0: triu(self, k)
+
+
+def inner(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Inner product over the last axes (numpy ``inner``)."""
+    from ..core import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a, device=b.device, comm=b.comm)
+    if not isinstance(b, DNDarray):
+        b = factories.array(b, device=a.device, comm=a.comm)
+    res = jnp.inner(a._jarray, b._jarray)
+    split = a.split if a.split is not None and a.split < max(a.ndim - 1, 0) else None
+    return _wrap(res, split, a)
+
+
+def tensordot(a: DNDarray, b: DNDarray, axes=2) -> DNDarray:
+    """Tensor contraction over the given axes; GSPMD partitions the
+    contraction (contracted split axes lower to sharded dot + psum)."""
+    if isinstance(axes, (list, tuple)):
+        ax_a, ax_b = axes
+        ax_a = [ax_a] if isinstance(ax_a, int) else list(ax_a)
+        ax_b = [ax_b] if isinstance(ax_b, int) else list(ax_b)
+        contracted_a = {x % a.ndim for x in ax_a}
+    else:
+        contracted_a = set(range(a.ndim - int(axes), a.ndim))
+    res = jnp.tensordot(a._jarray, b._jarray, axes=axes)
+    split = None
+    if a.split is not None and a.split not in contracted_a:
+        # a's free axes come first in the output, in order
+        split = sum(1 for x in range(a.split) if x not in contracted_a)
+    return _wrap(res, split, a)
+
+
+__all__ += ["inner", "tensordot"]
