@@ -11,6 +11,6 @@ rotation IS the cdist ring).
 
 from .ring import ring_map
 from .halo import halo_exchange, with_halos
-from .ring_attention import ring_self_attention
+from .ring_attention import ring_attention, ring_self_attention
 
-__all__ = ["ring_map", "halo_exchange", "with_halos", "ring_self_attention"]
+__all__ = ["ring_map", "halo_exchange", "with_halos", "ring_attention", "ring_self_attention"]

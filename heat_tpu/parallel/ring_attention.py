@@ -7,8 +7,10 @@ accumulation while K/V blocks rotate via ``lax.ppermute`` over the ICI ring,
 so sequence length scales with the mesh: each chip holds S/p of the sequence
 and peak memory is one block pair.
 
-Shapes: ``q, k, v`` are ``(S, d)`` sharded along the sequence axis over
-``comm``; batch/heads compose via ``jax.vmap`` outside.
+Shapes: ``q, k, v`` are ``(..., S, d)`` — any leading batch/head axes —
+sharded along the sequence axis over ``comm``.  Do NOT wrap the call in
+``jax.vmap`` for batching (that would trace the collectives per batch
+entry); the leading axes broadcast through the accumulator natively.
 """
 
 from __future__ import annotations
@@ -19,25 +21,42 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention"]
 
 
-def ring_self_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):
-    """Exact softmax attention with ring-rotated K/V (global result, S-sharded)."""
-    S, d = q.shape
+def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):
+    """Exact softmax attention, sequence-parallel over the mesh ring.
+
+    ``q, k, v`` have shape ``(..., S, d)`` — any leading batch/head axes —
+    with the sequence axis sharded over ``comm``.  Each chip holds S/p of the
+    sequence; K/V blocks rotate via ``lax.ppermute`` while a blockwise
+    (flash-style) online softmax accumulates, so the (S, S) score matrix
+    never materializes and peak memory is one block pair per chip.
+    """
+    S, d = q.shape[-2:]
     if scale is None:
         scale = 1.0 / (d**0.5)
+    if k.shape != q.shape or v.shape != q.shape:
+        # the sharded ring path has no broadcast semantics (each operand is
+        # split with q's spec); demand identical shapes up front
+        raise ValueError(
+            f"ring_attention requires identically-shaped q/k/v, got "
+            f"{q.shape}, {k.shape}, {v.shape} — broadcast/repeat shared K/V "
+            f"(e.g. MQA) to q's shape before the call"
+        )
     axis, size = comm.axis, comm.size
     if size == 1 or S % size != 0:
-        s = (q @ k.T) * scale
+        s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
         if causal:
             mask = jnp.tril(jnp.ones((S, S), bool))
             s = jnp.where(mask, s, -jnp.inf)
-        return jax.nn.softmax(s, axis=-1) @ v
+        return jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(s, axis=-1), v)
 
     blk = S // size
+    seq_axis = q.ndim - 2
 
     def shard_fn(q_blk, k_blk, v_blk):
+        # q_blk: (..., blk, d) — all math broadcasts over the leading axes
         my = lax.axis_index(axis)
         q_pos = my * blk + jnp.arange(blk)
 
@@ -47,20 +66,20 @@ def ring_self_attention(q, k, v, comm, causal: bool = False, scale: Optional[flo
 
             def attend(operands):
                 m, l, acc = operands
-                s = (q_blk @ k_rot.T) * scale  # (blk, blk)
+                s = jnp.einsum("...qd,...kd->...qk", q_blk, k_rot) * scale
                 if causal:
                     kv_pos = src * blk + jnp.arange(blk)
                     mask = q_pos[:, None] >= kv_pos[None, :]
                     s = jnp.where(mask, s, -jnp.inf)
-                m_step = jnp.max(s, axis=1)
+                m_step = jnp.max(s, axis=-1)
                 m_new = jnp.maximum(m, m_step)
                 # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) → 0
                 safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-                p = jnp.exp(s - safe_m[:, None])
+                p = jnp.exp(s - safe_m[..., None])
                 p = jnp.where(jnp.isfinite(s), p, 0.0)
                 corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-                l_new = l * corr + jnp.sum(p, axis=1)
-                acc_new = acc * corr[:, None] + p @ v_rot
+                l_new = l * corr + jnp.sum(p, axis=-1)
+                acc_new = acc * corr[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_rot)
                 return m_new, l_new, acc_new
 
             if causal:
@@ -75,15 +94,23 @@ def ring_self_attention(q, k, v, comm, causal: bool = False, scale: Optional[flo
             v_next = lax.ppermute(v_rot, axis, perm)
             return (k_next, v_next, m, l, acc), None
 
-        m0 = jnp.full((blk,), -jnp.inf, q_blk.dtype)
-        l0 = jnp.zeros((blk,), q_blk.dtype)
-        acc0 = jnp.zeros((blk, d), q_blk.dtype)
+        m0 = jnp.full(q_blk.shape[:-1], -jnp.inf, q_blk.dtype)
+        l0 = jnp.zeros(q_blk.shape[:-1], q_blk.dtype)
+        acc0 = jnp.zeros(q_blk.shape, q_blk.dtype)
         (k_f, v_f, m, l, acc), _ = lax.scan(
             step, (k_blk, v_blk, m0, l0, acc0), jnp.arange(size)
         )
-        return acc / jnp.maximum(l, 1e-30)[:, None]
+        return acc / jnp.maximum(l, 1e-30)[..., None]
 
+    nd = q.ndim
     mapped = comm.shard_map(
-        shard_fn, in_splits=((2, 0), (2, 0), (2, 0)), out_splits=(2, 0)
+        shard_fn,
+        in_splits=((nd, seq_axis),) * 3,
+        out_splits=(nd, seq_axis),
     )
     return mapped(q, k, v)
+
+
+def ring_self_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):
+    """2-D ``(S, d)`` alias of :func:`ring_attention` (original API)."""
+    return ring_attention(q, k, v, comm, causal=causal, scale=scale)
