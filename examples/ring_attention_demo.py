@@ -1,0 +1,76 @@
+"""Sequence-parallel long-context attention demo.
+
+Runs batched multi-head causal ring attention with the sequence axis sharded
+over the device mesh: each chip holds S/p of the sequence, K/V blocks rotate
+over the ICI ring (``lax.ppermute``) and a flash-style online softmax
+accumulates — the (S, S) score matrix never exists, so context length scales
+with the number of chips.
+
+Run (virtual 8-device CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/ring_attention_demo.py
+"""
+
+import os
+import sys
+import time
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.parallel import ring_attention
+
+
+def main() -> None:
+    comm = ht.communication.get_comm()
+    p = comm.size
+    B, H, d = 2, 4, 64
+    S = 1024 * p  # context scales linearly with the mesh
+    print(f"mesh: {p} devices — sequence length {S} ({S // p} per chip)")
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        comm.shard(jnp.asarray(rng.standard_normal((B, H, S, d)), jnp.float32), 2)
+        for _ in range(3)
+    )
+
+    step = jax.jit(lambda q, k, v: ring_attention(q, k, v, comm, causal=True))
+    out = jax.block_until_ready(step(q, k, v))  # compile
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(step(q, k, v))
+    dt = time.perf_counter() - t0
+
+    # causal attention FLOPs ≈ 2 * B*H*S²*d (QK^T) + 2 * B*H*S²*d (PV), halved
+    flops = 2 * 2 * B * H * S * S * d / 2
+    print(f"one causal pass: {dt * 1e3:.1f} ms  (~{flops / dt / 1e9:.1f} GFLOP/s)")
+    print(f"output sharded over {len(out.sharding.device_set)} devices")
+
+    # correctness spot-check against the dense reference on a small slice
+    Ss = 64
+    # slice on device, gather only the prefix
+    qs, ks, vs = (np.asarray(t[:, :, :Ss]) for t in (q, k, v))
+    s = np.einsum("bhqd,bhkd->bhqk", qs, ks) / np.sqrt(d)
+    s = np.where(np.tril(np.ones((Ss, Ss), bool)), s, -np.inf)
+    pr = np.exp(s - s.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", pr, vs)
+    # the spot check runs in HIGHEST precision so it is exact on TPU too
+    # (the timed pass above uses the default bf16 MXU passes)
+    with jax.default_matmul_precision("highest"):
+        small = jax.jit(lambda q, k, v: ring_attention(q, k, v, comm, causal=True))(
+            comm.shard(jnp.asarray(qs), 2), comm.shard(jnp.asarray(ks), 2), comm.shard(jnp.asarray(vs), 2)
+        )
+    np.testing.assert_allclose(np.asarray(small), want, rtol=2e-3, atol=2e-4)
+    print("matches dense reference on the 64-token prefix ✓")
+
+
+if __name__ == "__main__":
+    main()
