@@ -62,8 +62,13 @@ def _bf16_peak(device_kind: str):
     return None
 
 
-def _gemm_seconds(ht, jax, n: int, dtype, iters: int) -> float:
-    """Per-GEMM seconds for an n x n chain through the public ht.matmul."""
+def _gemm_seconds(ht, jax, n: int, dtype, iters: int, reps: int = 1) -> float:
+    """Per-GEMM seconds for an n x n chain through the public ht.matmul.
+
+    ``reps`` > 1 takes the best-of-``reps`` chain (the chip's capability,
+    not the jitter) via the shared ``timeit_min`` methodology — callers
+    enable it only when the watchdog budget comfortably allows the retries.
+    """
     a = ht.random.randn(n, n, dtype=dtype, split=0)
     b = ht.random.randn(n, n, dtype=dtype, split=1)
     scale = float(1.0 / np.sqrt(n))  # keeps chained values finite
@@ -76,11 +81,10 @@ def _gemm_seconds(ht, jax, n: int, dtype, iters: int) -> float:
         c, _ = jax.lax.scan(body, a, None, length=iters)
         return c
 
+    from heat_tpu.utils.profiler import timeit_min
+
     float(chain(a, b, iters)._jarray[0, 0])  # compile + warm
-    t0 = time.perf_counter()
-    c = chain(a, b, iters)
-    _ = float(c._jarray[0, 0])  # forces completion through the tunnel
-    return (time.perf_counter() - t0) / iters
+    return timeit_min(lambda: chain(a, b, iters)._jarray, reps=reps) / iters
 
 
 def _summa_vs_gspmd_cpu8(repo_root: str) -> dict:
@@ -155,7 +159,11 @@ def main(state: dict = None) -> dict:
     flops = 2.0 * N * N * N
 
     # --- headline: 16384^2 bf16 (native MXU precision) -------------------- #
-    t_bf16 = _gemm_seconds(ht, jax, N, ht.bfloat16, iters=10)
+    # best-of-3 only when >60% of the watchdog budget remains after warmup:
+    # each extra chain is ~10 GEMMs, cheap on a healthy chip but not worth
+    # risking the whole payload on a degraded tunnel
+    headline_reps = 3 if time_left() > 0.6 * budget else 1
+    t_bf16 = _gemm_seconds(ht, jax, N, ht.bfloat16, iters=10, reps=headline_reps)
     tflops_bf16 = flops / t_bf16 / 1e12 / n_chips
     extra["matmul_16384_bf16_wallclock_s"] = round(t_bf16, 6)
     if peak:
