@@ -8,7 +8,18 @@ from __future__ import annotations
 
 import optax
 
-__all__ = ["StepLR", "ExponentialLR", "CosineAnnealingLR", "LambdaLR"]
+__all__ = [
+    "ConstantLR",
+    "CosineAnnealingLR",
+    "CosineAnnealingWarmRestarts",
+    "ExponentialLR",
+    "LambdaLR",
+    "LinearLR",
+    "MultiStepLR",
+    "OneCycleLR",
+    "PolynomialLR",
+    "StepLR",
+]
 
 
 def StepLR(lr: float, step_size: int, gamma: float = 0.1):
@@ -31,3 +42,77 @@ def LambdaLR(lr: float, lr_lambda):
         return lr * lr_lambda(step)
 
     return schedule
+
+
+def MultiStepLR(lr: float, milestones, gamma: float = 0.1):
+    """Decay lr by ``gamma`` at each milestone step (torch semantics)."""
+    boundaries = {int(m): gamma for m in sorted(milestones)}
+    return optax.piecewise_constant_schedule(init_value=lr, boundaries_and_scales=boundaries)
+
+
+def ConstantLR(lr: float, factor: float = 1.0 / 3.0, total_iters: int = 5):
+    """lr * factor for the first ``total_iters`` steps, then lr (torch semantics)."""
+    return optax.join_schedules(
+        [optax.constant_schedule(lr * factor), optax.constant_schedule(lr)],
+        boundaries=[total_iters],
+    )
+
+
+def LinearLR(lr: float, start_factor: float = 1.0 / 3.0, end_factor: float = 1.0, total_iters: int = 5):
+    """Linear ramp from ``lr*start_factor`` to ``lr*end_factor`` over
+    ``total_iters`` steps, constant afterwards (torch semantics; optax's
+    linear_schedule already holds the end value past the transition)."""
+    return optax.linear_schedule(
+        init_value=lr * start_factor, end_value=lr * end_factor, transition_steps=total_iters
+    )
+
+
+def PolynomialLR(lr: float, total_iters: int = 5, power: float = 1.0):
+    """Polynomial decay to zero over ``total_iters`` steps (torch semantics)."""
+    return optax.polynomial_schedule(
+        init_value=lr, end_value=0.0, power=power, transition_steps=total_iters
+    )
+
+
+def CosineAnnealingWarmRestarts(lr: float, T_0: int, T_mult: int = 1, eta_min: float = 0.0):
+    """SGDR cosine schedule restarting indefinitely (torch semantics).
+
+    The restart position is computed analytically per step (jit-safe), so
+    there is no finite horizon: ``T_mult == 1`` cycles forever with period
+    ``T_0``; ``T_mult > 1`` grows the period geometrically."""
+    import jax.numpy as jnp
+
+    def schedule(step):
+        s = jnp.asarray(step, jnp.float32)
+        if T_mult == 1:
+            t_cur = jnp.mod(s, T_0)
+            period = jnp.asarray(T_0, jnp.float32)
+        else:
+            # n = floor(log_Tm(step*(Tm-1)/T_0 + 1)) restarts so far
+            n = jnp.floor(jnp.log(s * (T_mult - 1) / T_0 + 1.0) / jnp.log(float(T_mult)))
+            t_start = T_0 * (T_mult**n - 1.0) / (T_mult - 1.0)
+            period = T_0 * (float(T_mult) ** n)
+            t_cur = s - t_start
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * t_cur / period))
+        return eta_min + (lr - eta_min) * cos
+
+    return schedule
+
+
+def OneCycleLR(lr: float, total_steps: int, pct_start: float = 0.3,
+               div_factor: float = 25.0, final_div_factor: float = 1e4):
+    """One-cycle policy (torch semantics, cosine anneal): warm up from
+    ``lr/div_factor`` to ``lr``, anneal to the torch floor
+    ``(lr/div_factor)/final_div_factor``."""
+    warm = max(int(total_steps * pct_start), 1)
+    final_lr = (lr / div_factor) / final_div_factor
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(lr / div_factor, lr, warm),
+            optax.cosine_decay_schedule(
+                init_value=lr, decay_steps=max(total_steps - warm, 1),
+                alpha=final_lr / lr if lr else 0.0,
+            ),
+        ],
+        boundaries=[warm],
+    )

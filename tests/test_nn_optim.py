@@ -323,3 +323,45 @@ class TestDataParallelDistribution:
                 np.asarray(leaf.addressable_shards[-1].data),
                 rtol=0, atol=0,
             )
+
+
+class TestLRSchedulersBatch2:
+    """Round-3 additions: the rest of the torch scheduler zoo as optax-native
+    factories (reference thin-wraps torch.optim.lr_scheduler)."""
+
+    def test_multistep_constant_linear_polynomial(self):
+        from heat_tpu.optim import lr_scheduler as lrs
+
+        s = lrs.MultiStepLR(1.0, [3, 6], gamma=0.1)
+        np.testing.assert_allclose([float(s(i)) for i in range(8)], [1, 1, 1, 0.1, 0.1, 0.1, 0.01, 0.01], rtol=1e-6)
+        s = lrs.ConstantLR(0.9, factor=1 / 3, total_iters=2)
+        np.testing.assert_allclose([float(s(i)) for i in range(4)], [0.3, 0.3, 0.9, 0.9], rtol=1e-6)
+        s = lrs.LinearLR(1.0, 0.5, 1.0, 4)
+        np.testing.assert_allclose([float(s(i)) for i in range(6)], [0.5, 0.625, 0.75, 0.875, 1.0, 1.0], rtol=1e-6)
+        s = lrs.PolynomialLR(1.0, total_iters=4, power=1.0)
+        np.testing.assert_allclose([float(s(i)) for i in range(5)], [1.0, 0.75, 0.5, 0.25, 0.0], atol=1e-6)
+
+    def test_warm_restarts_and_onecycle(self):
+        from heat_tpu.optim import lr_scheduler as lrs
+
+        s = lrs.CosineAnnealingWarmRestarts(1.0, T_0=4, T_mult=2)
+        assert abs(float(s(0)) - 1.0) < 1e-6 and abs(float(s(4)) - 1.0) < 1e-6
+        assert float(s(3)) < 0.2
+        s = lrs.OneCycleLR(1.0, total_steps=10, pct_start=0.3)
+        assert float(s(0)) < 0.1 and abs(float(s(3)) - 1.0) < 1e-6 and float(s(9)) < 0.1
+
+    def test_warm_restarts_infinite_horizon_and_onecycle_floor(self):
+        """Regression: restarts continue forever (no 32-period cap) and
+        OneCycle anneals to torch's (lr/div)/final_div floor."""
+        from heat_tpu.optim import lr_scheduler as lrs
+
+        s = lrs.CosineAnnealingWarmRestarts(1.0, T_0=4, T_mult=1, eta_min=0.1)
+        for t in (0, 4, 128, 132, 10000):  # every period boundary restarts to lr
+            assert abs(float(s(t)) - 1.0) < 1e-4, t
+        assert abs(float(s(131)) - float(s(3))) < 1e-5  # periodic forever
+        s2 = lrs.CosineAnnealingWarmRestarts(1.0, T_0=4, T_mult=2)
+        for t in (0, 4, 12, 28):  # geometric restart points
+            assert abs(float(s2(t)) - 1.0) < 1e-3, t
+        assert float(s2(11)) < 0.05
+        s3 = lrs.OneCycleLR(1.0, total_steps=1000)
+        assert float(s3(999)) < 1e-5  # torch floor: (lr/25)/1e4
