@@ -486,11 +486,59 @@ def shuffle(x: DNDarray) -> DNDarray:
     return _wrap(res, x.split, x)
 
 
-def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None):
-    """Sort along axis; the reference's distributed sample-sort becomes XLA's
-    sharded sort.  Returns (sorted, original_indices) like the reference."""
+def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method: str = "auto"):
+    """Sort along axis; returns (sorted, original_indices) like the reference.
+
+    ``method``:
+
+    - ``'global'`` — sort the global array with XLA's sharded sort (the
+      partitioner typically gathers the sort axis; simple and exact).
+    - ``'sample'`` — the reference's distributed sample-sort, redesigned for
+      static shapes (``parallel.sample_sort``): static shuffle + exact
+      bisected splitters + one padded ``all_to_all``; per-shard memory stays
+      O(n/p).  1-D split float32/int-family ascending sorts only; overflow
+      of the static exchange width falls back to ``'global'``.
+    - ``'auto'`` — ``'sample'`` when eligible and the array is large enough
+      that the gather would dominate (≥ 1e6 elements), else ``'global'``.
+    """
     axis = sanitize_axis(x.shape, axis)
     j = x._jarray
+
+    eligible = (
+        x.ndim == 1
+        and axis == 0
+        and x.split == 0
+        and not descending
+        and x.comm.is_distributed()
+        # only dtypes whose order round-trips through the 32-bit key encoding
+        and j.dtype in (jnp.float32, jnp.int32, jnp.int16, jnp.int8)
+    )
+    if method == "sample" and not eligible:
+        raise ValueError(
+            "method='sample' needs a 1-D float32/int split-0 ascending sort on "
+            "a distributed comm"
+        )
+    if method not in ("auto", "global", "sample"):
+        raise ValueError(f"unknown sort method {method!r}")
+    use_sample = method == "sample" or (method == "auto" and eligible and x.size >= 1_000_000)
+
+    if use_sample:
+        from ..parallel.sample_sort import sample_sort_1d
+
+        svals, sidx, overflow = sample_sort_1d(x.comm, x._parray, x.shape[0])
+        if not bool(overflow):  # eager: pathological collision → global path
+            if jnp.issubdtype(j.dtype, jnp.integer):
+                svals = svals.astype(j.dtype)
+            v = DNDarray(svals, (x.shape[0],), x.dtype, 0, x.device, x.comm, True)
+            i = DNDarray(
+                sidx, (x.shape[0],), types.canonical_heat_type(sidx.dtype), 0,
+                x.device, x.comm, True,
+            )
+            if out is not None:
+                out._jarray = v._jarray
+                return out, i
+            return v, i
+
     if descending:
         idx = jnp.argsort(-j if jnp.issubdtype(j.dtype, jnp.number) else ~j, axis=axis, stable=True)
     else:

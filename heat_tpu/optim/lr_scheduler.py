@@ -101,16 +101,25 @@ def CosineAnnealingWarmRestarts(lr: float, T_0: int, T_mult: int = 1, eta_min: f
 
 def OneCycleLR(lr: float, total_steps: int, pct_start: float = 0.3,
                div_factor: float = 25.0, final_div_factor: float = 1e4):
-    """One-cycle policy (torch semantics, cosine anneal): warm up from
-    ``lr/div_factor`` to ``lr``, anneal to the torch floor
-    ``(lr/div_factor)/final_div_factor``."""
-    warm = max(int(total_steps * pct_start), 1)
-    final_lr = (lr / div_factor) / final_div_factor
+    """One-cycle policy (torch ``anneal_strategy='cos'`` semantics): cosine
+    warmup from ``lr/div_factor`` to ``lr``, cosine anneal to the torch
+    floor ``(lr/div_factor)/final_div_factor``."""
+    import jax.numpy as jnp
+
+    # torch's peak step: float(pct_start*total_steps) - 1
+    warm = max(int(round(pct_start * total_steps)) - 1, 1)
+    init_lr = lr / div_factor
+    final_lr = init_lr / final_div_factor
+
+    def warmup(step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / warm, 0.0, 1.0)
+        return init_lr + (lr - init_lr) * 0.5 * (1.0 - jnp.cos(jnp.pi * frac))
+
     return optax.join_schedules(
         [
-            optax.linear_schedule(lr / div_factor, lr, warm),
+            warmup,
             optax.cosine_decay_schedule(
-                init_value=lr, decay_steps=max(total_steps - warm, 1),
+                init_value=lr, decay_steps=max(total_steps - 1 - warm, 1),
                 alpha=final_lr / lr if lr else 0.0,
             ),
         ],

@@ -1,0 +1,185 @@
+"""Distributed sample-sort under XLA static shapes (SURVEY §7 hard part #3).
+
+The reference sorts a split axis with a hand-rolled MPI sample sort
+(``heat/core/manipulations.py::sort``: local sort, splitter exchange,
+``Alltoallv`` of variable-size buckets).  XLA collectives are static-shape,
+so variable-size exchange is impossible verbatim; this module is the
+TPU-native redesign:
+
+1. **Static shuffle** — a data-independent block transpose (``all_to_all``)
+   plus a fixed seeded local permutation.  This makes every shard's
+   per-destination bucket size concentrate around ``c/p`` for ANY input
+   order (including the adversarial already-sorted case, where the naive
+   bucket map is all-to-one).
+2. **Exact splitters** — the p−1 canonical chunk boundaries are global
+   order statistics; they are found by vectorized **bisection on the
+   order-preserving integer encoding** of the keys (32 rounds on value bits
+   + 32 on tie-breaking ids, each round one ``psum`` of a (p−1,) count
+   vector).  Exact splitters ⇒ every destination receives EXACTLY its
+   canonical ceil-div chunk, so the result lands directly in the
+   framework's standard layout — no rebalancing pass.
+3. **Padded exchange** — each shard packs per-destination runs into a
+   ``(p, w)`` buffer (``w ≈ 2c/p`` thanks to the shuffle) and one
+   ``all_to_all`` delivers them; receivers merge-sort ``(p·w)`` entries
+   with pad sentinels sorting last.  Per-shard memory stays O(c), not O(n).
+
+If any bucket overflows ``w`` (pathological key collisions), the caller
+falls back to the global XLA sort — correctness is never at risk.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["sample_sort_1d"]
+
+_PAD = jnp.uint32(0xFFFFFFFF)  # sorts after every real key
+_NAN = jnp.uint32(0xFFFFFFFE)  # NaNs sort last among real values (numpy)
+
+
+def _encode_f32(x):
+    """Order-preserving uint32 encoding of float32 (NaN → second-largest)."""
+    bits = lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    neg = bits >> 31 == 1
+    enc = jnp.where(neg, ~bits, bits | jnp.uint32(0x80000000))
+    return jnp.where(jnp.isnan(x), _NAN, enc)
+
+
+def _decode_f32(enc):
+    bits = jnp.where(enc >> 31 == 1, enc ^ jnp.uint32(0x80000000), ~enc)
+    val = lax.bitcast_convert_type(bits, jnp.float32)
+    return jnp.where(enc == _NAN, jnp.float32(jnp.nan), val)
+
+
+def _encode_i32(x):
+    return lax.bitcast_convert_type(x.astype(jnp.int32), jnp.uint32) ^ jnp.uint32(0x80000000)
+
+
+def _decode_i32(enc):
+    return lax.bitcast_convert_type(enc ^ jnp.uint32(0x80000000), jnp.int32)
+
+
+def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort a 1-D padded physical array sharded over ``comm``.
+
+    ``phys``: shape (p·c,), canonical ceil-div layout, entries at global
+    index ≥ n are pad.  Returns ``(sorted_phys, orig_idx_phys, overflow)``:
+    the sorted values and their ORIGINAL global indices in the same padded
+    layout, plus a bool scalar — True means a bucket overflowed the static
+    exchange width and the caller must use the global-sort fallback.
+    """
+    p = comm.size
+    P = phys.shape[0]
+    c = P // p
+    if jnp.issubdtype(phys.dtype, jnp.floating):
+        enc_in, dec = _encode_f32, _decode_f32
+        out_dt = jnp.float32
+    else:
+        enc_in, dec = _encode_i32, _decode_i32
+        out_dt = jnp.int32
+    # shuffle granularity: c padded up to a multiple of p
+    cs = -(-c // p) * p
+    g = cs // p
+    w = 2 * (-(-cs // p)) + 16  # exchange width per (src, dst) pair
+    axis = comm.axis
+
+    # fixed, data-independent local permutation (same on every shard is fine:
+    # the block transpose below mixes across shards regardless)
+    perm = np.random.default_rng(0xC0FFEE).permutation(cs)
+
+    def shard_fn(blk):
+        my = lax.axis_index(axis)
+        # int32 arithmetic, ONE cast: mixing int32 with uint32 would trigger
+        # jnp type promotion, and a promoted dtype inside the packed key/id
+        # stack silently scrambles the bit patterns
+        gidx = (my * c + jnp.arange(c)).astype(jnp.uint32)
+        valid = gidx < jnp.uint32(n)
+        keys = jnp.where(valid, enc_in(blk), _PAD)
+        ids = jnp.where(valid, gidx, jnp.uint32(0xFFFFFFFF))
+        # pad the block up to cs for the shuffle reshape
+        keys = jnp.concatenate([keys, jnp.full((cs - c,), _PAD, jnp.uint32)])
+        ids = jnp.concatenate([ids, jnp.full((cs - c,), 0xFFFFFFFF, jnp.uint32)])
+
+        # ---- 1. static shuffle: local fixed perm + block transpose -------- #
+        keys, ids = keys[perm], ids[perm]
+        pair = jnp.stack([keys, ids], axis=-1).reshape(p, g, 2)
+        pair = lax.all_to_all(pair, axis, split_axis=0, concat_axis=0, tiled=True)
+        keys, ids = pair[..., 0].reshape(-1), pair[..., 1].reshape(-1)
+
+        # ---- local sort by (key, id) -------------------------------------- #
+        order = jnp.lexsort((ids, keys))
+        keys, ids = keys[order], ids[order]
+
+        # ---- 2. exact canonical splitters via bisection ------------------- #
+        # canonical boundary targets: B_t = min((t+1)·c, n), t = 0..p-2
+        targets = jnp.minimum((jnp.arange(p - 1) + 1) * c, n).astype(jnp.int32)
+
+        def count_le(kb, ib):
+            # global count of (key, id) pairs lexicographically ≤ (kb, ib);
+            # kb/ib are (p-1,) — broadcast against the local (cs,) block
+            lt = keys[:, None] < kb[None, :]
+            eq = (keys[:, None] == kb[None, :]) & (ids[:, None] <= ib[None, :])
+            return lax.psum(jnp.sum(lt | eq, axis=0).astype(jnp.int32), axis)
+
+        def bisect(body_bits, lo0, hi0, fixed):
+            def body(i, carry):
+                lo, hi = carry
+                mid = lo + (hi - lo) // 2
+                cnt = body_bits(mid, fixed)
+                ge = cnt >= targets
+                return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+            lo, hi = lax.fori_loop(0, 32, body, (lo0, hi0))
+            return lo
+
+        # phase 1: smallest key bits kb with count(key ≤ kb, id=max) ≥ B_t
+        kmax = jnp.full((p - 1,), 0xFFFFFFFF, jnp.uint32)
+        kb = bisect(lambda mid, _f: count_le(mid, kmax), jnp.zeros((p - 1,), jnp.uint32), kmax, None)
+        # phase 2: smallest id ib with count((key,id) ≤ (kb, ib)) ≥ B_t
+        ib = bisect(lambda mid, _f: count_le(kb, mid), jnp.zeros((p - 1,), jnp.uint32), kmax, None)
+
+        # ---- 3. partition + padded exchange ------------------------------- #
+        # destination = number of splitters strictly below this element
+        below = (keys[:, None] > kb[None, :]) | (
+            (keys[:, None] == kb[None, :]) & (ids[:, None] > ib[None, :])
+        )
+        dest = jnp.sum(below, axis=1).astype(jnp.int32)  # (cs,) in [0, p)
+        counts = jnp.sum(dest[:, None] == jnp.arange(p)[None, :], axis=0)  # (p,)
+        overflow = lax.pmax(jnp.max(counts), axis) > w
+        # local data is sorted, so each destination's run is contiguous
+        starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+        slot = starts[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # (p, w)
+        inside = jnp.arange(w, dtype=jnp.int32)[None, :] < counts[:, None]
+        slot = jnp.clip(slot, 0, cs - 1)
+        send_k = jnp.where(inside, keys[slot], _PAD)
+        send_i = jnp.where(inside, ids[slot], jnp.uint32(0xFFFFFFFF))
+        pair = jnp.stack([send_k, send_i], axis=-1)  # (p, w, 2)
+        pair = lax.all_to_all(pair, axis, split_axis=0, concat_axis=0, tiled=True)
+        rk, ri = pair[..., 0].reshape(-1), pair[..., 1].reshape(-1)  # (p·w,)
+
+        # ---- merge: sort received, keep the canonical c slots ------------- #
+        order = jnp.lexsort((ri, rk))
+        rk, ri = rk[order][:c], ri[order][:c]
+        vals = dec(rk).astype(out_dt)
+        # pads are detected by their id sentinel, NOT the key: INT32_MAX
+        # legitimately encodes to the same bits as _PAD, and real ids are
+        # always < n < 2^32−1.  (Within equal keys the lexsort already put
+        # pads last, so real elements are never displaced.)
+        pad_slot = ri == jnp.uint32(0xFFFFFFFF)
+        vals = jnp.where(pad_slot, jnp.zeros((), out_dt), vals)
+        idx = jnp.where(pad_slot, jnp.uint32(0), ri).astype(jnp.int32)
+        return vals, idx, overflow
+
+    from jax.sharding import PartitionSpec as Pspec
+
+    mapped = comm.shard_map(
+        shard_fn,
+        in_splits=((1, 0),),
+        out_splits=((1, 0), (1, 0), Pspec()),
+    )
+    return mapped(phys)

@@ -348,7 +348,8 @@ class TestLRSchedulersBatch2:
         assert abs(float(s(0)) - 1.0) < 1e-6 and abs(float(s(4)) - 1.0) < 1e-6
         assert float(s(3)) < 0.2
         s = lrs.OneCycleLR(1.0, total_steps=10, pct_start=0.3)
-        assert float(s(0)) < 0.1 and abs(float(s(3)) - 1.0) < 1e-6 and float(s(9)) < 0.1
+        # torch-exact phases: peak at step pct*total - 1 = 2, floor at the end
+        assert float(s(0)) < 0.1 and abs(float(s(2)) - 1.0) < 1e-6 and float(s(9)) < 1e-4
 
     def test_warm_restarts_infinite_horizon_and_onecycle_floor(self):
         """Regression: restarts continue forever (no 32-period cap) and
@@ -365,3 +366,18 @@ class TestLRSchedulersBatch2:
         assert float(s2(11)) < 0.05
         s3 = lrs.OneCycleLR(1.0, total_steps=1000)
         assert float(s3(999)) < 1e-5  # torch floor: (lr/25)/1e4
+
+    def test_onecycle_matches_torch_exactly(self):
+        import torch
+
+        from heat_tpu.optim import lr_scheduler as lrs
+
+        opt = torch.optim.SGD([torch.nn.Parameter(torch.zeros(1))], lr=1.0)
+        ts = torch.optim.lr_scheduler.OneCycleLR(opt, max_lr=1.0, total_steps=10, pct_start=0.3)
+        want = []
+        for _ in range(10):
+            want.append(opt.param_groups[0]["lr"])
+            opt.step()
+            ts.step()
+        s = lrs.OneCycleLR(1.0, total_steps=10, pct_start=0.3)
+        np.testing.assert_allclose([float(s(i)) for i in range(10)], want, rtol=1e-4, atol=1e-6)

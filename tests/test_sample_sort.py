@@ -1,0 +1,93 @@
+"""Distributed sample-sort (SURVEY §7 hard part #3; reference
+``heat/core/manipulations.py::sort``'s MPI sample sort, redesigned for XLA
+static shapes — see ``heat_tpu/parallel/sample_sort.py``).
+
+The oracle matrix fixes the shapes (one compile each) and sweeps input
+distributions, including the adversarial already-sorted case the static
+shuffle exists for, heavy duplicates (tie-breaking by global id), NaNs
+(sort last, numpy semantics), and n < p.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+rng = np.random.default_rng(0)
+
+
+def _cases(n):
+    x = rng.standard_normal(n).astype(np.float32)
+    yield "uniform", x
+    yield "sorted", np.sort(x)
+    yield "reverse", np.sort(x)[::-1].copy()
+    yield "dups", np.round(x)
+    xn = x.copy()
+    xn[::7] = np.nan
+    yield "nan", xn
+
+
+class TestSampleSort(TestCase):
+    @pytest.mark.parametrize("n", [100, 999])
+    def test_oracle_matrix(self, n):
+        for name, x in _cases(n):
+            a = ht.array(x, split=0)
+            v, i = ht.sort(a, method="sample")
+            want = np.sort(x)
+            np.testing.assert_allclose(v.numpy(), want, equal_nan=True, rtol=0, atol=0), name
+            # the returned indices reproduce the sorted order from the input
+            np.testing.assert_allclose(x[i.numpy()], want, equal_nan=True)
+            self.assert_distributed(v)
+            self.assert_distributed(i)
+
+    def test_int_and_constant(self):
+        xi = rng.integers(-1000, 1000, size=777).astype(np.int32)
+        v, _ = ht.sort(ht.array(xi, split=0), method="sample")
+        np.testing.assert_array_equal(v.numpy(), np.sort(xi))
+        const = np.full(777, 3.5, np.float32)  # all ties: broken by global id
+        v, i = ht.sort(ht.array(const, split=0), method="sample")
+        np.testing.assert_array_equal(v.numpy(), const)
+        np.testing.assert_array_equal(np.sort(i.numpy()), np.arange(777))
+
+    def test_tiny_n_less_than_p(self):
+        x = np.array([5.0, -1.0, 3.0], np.float32)
+        v, i = ht.sort(ht.array(x, split=0), method="sample")
+        np.testing.assert_array_equal(v.numpy(), np.sort(x))
+        np.testing.assert_array_equal(x[i.numpy()], np.sort(x))
+
+    def test_method_validation(self):
+        with pytest.raises(ValueError):
+            ht.sort(ht.zeros((4, 4), split=0), method="sample")  # 2-D
+        with pytest.raises(ValueError):
+            ht.sort(ht.arange(10, dtype=ht.float32, split=0), method="nope")
+        # descending not eligible for the sample path
+        with pytest.raises(ValueError):
+            ht.sort(ht.arange(10, dtype=ht.float32, split=0), descending=True, method="sample")
+
+    def test_overflow_falls_back_to_global(self, monkeypatch):
+        """If the static exchange width ever overflows, sort must silently
+        deliver the global-path result, not wrong data."""
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import sample_sort as ss
+
+        orig = ss.sample_sort_1d
+
+        def forced_overflow(comm, phys, n):
+            v, i, _ = orig(comm, phys, n)
+            return v, i, jnp.asarray(True)
+
+        import heat_tpu.core.manipulations as man
+
+        monkeypatch.setattr(ss, "sample_sort_1d", forced_overflow)
+        x = rng.standard_normal(200).astype(np.float32)
+        v, i = ht.sort(ht.array(x, split=0), method="sample")
+        np.testing.assert_array_equal(v.numpy(), np.sort(x))
+
+    def test_global_path_untouched_for_small_auto(self):
+        x = rng.standard_normal((12, 5)).astype(np.float32)
+        a = ht.array(x, split=0)
+        v, i = ht.sort(a, axis=0)  # auto: 2-D → global path
+        self.assert_array_equal(v, np.sort(x, axis=0))
