@@ -510,8 +510,10 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
         and x.split == 0
         and not descending
         and x.comm.is_distributed()
-        # only dtypes whose order round-trips through the 32-bit key encoding
+        # only dtypes whose order round-trips through the 32-bit key encoding,
+        # and sizes whose rank counts fit int32
         and j.dtype in (jnp.float32, jnp.int32, jnp.int16, jnp.int8)
+        and x.shape[0] < 2**31
     )
     if method == "sample" and not eligible:
         raise ValueError(

@@ -305,9 +305,56 @@ def corrcoef(m, y=None, rowvar: bool = True) -> DNDarray:
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), c.split, c.device, c.comm, True)
 
 
+# elements above which the 1-D split percentile routes through the exact
+# bisected order statistics (no gather/sort); lowered by tests
+PERCENTILE_BISECT_THRESHOLD = 1_000_000
+
+
 def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
-    """q-th percentile(s) along axis."""
+    """q-th percentile(s) along axis.
+
+    Large 1-D split-0 float32 arrays with linear interpolation use the exact
+    distributed order statistics (``parallel.order_statistics_1d``: 32
+    psum-count bisection rounds, O(n/p) memory) instead of the global
+    gather-and-sort — the scalable path for the reference's distributed
+    median/percentile story.
+    """
     ax = sanitize_axis(x.shape, axis)
+    q_is_scalar = np.ndim(q) == 0 and not isinstance(q, DNDarray)
+    bisect_ok = (
+        x.ndim == 1
+        and ax in (None, 0)
+        and x.split == 0
+        and interpolation == "linear"
+        and not keepdims
+        and x.comm.is_distributed()
+        and x._jarray.dtype == jnp.float32
+        and not isinstance(q, DNDarray)
+        and PERCENTILE_BISECT_THRESHOLD <= x.shape[0] < 2**31
+    )
+    if bisect_ok:
+        from ..parallel.sample_sort import order_statistics_1d
+
+        n = x.shape[0]
+        qs = np.atleast_1d(np.asarray(q, np.float64))
+        pos = qs / 100.0 * (n - 1)
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.ceil(pos).astype(np.int64)
+        ranks = sorted(set(lo.tolist()) | set(hi.tolist()))
+        rank_pos = {rk: i for i, rk in enumerate(ranks)}
+        vals = order_statistics_1d(x.comm, x._parray, n, ranks)
+        vlo = vals[np.asarray([rank_pos[r] for r in lo])]
+        vhi = vals[np.asarray([rank_pos[r] for r in hi])]
+        frac = jnp.asarray(pos - lo, jnp.float32)
+        res = vlo + frac * (vhi - vlo)
+        if q_is_scalar:
+            res = res[0]
+        res = x.comm.shard(res, None)
+        r = DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+        if out is not None:
+            out._jarray = res.astype(out.dtype.jax_dtype())
+            return out
+        return r
     qj = q._jarray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
     res = jnp.percentile(x._jarray.astype(jnp.float32), qj, axis=ax, method=interpolation, keepdims=keepdims)
     res = x.comm.shard(res, None)

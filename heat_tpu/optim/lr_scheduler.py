@@ -101,27 +101,23 @@ def CosineAnnealingWarmRestarts(lr: float, T_0: int, T_mult: int = 1, eta_min: f
 
 def OneCycleLR(lr: float, total_steps: int, pct_start: float = 0.3,
                div_factor: float = 25.0, final_div_factor: float = 1e4):
-    """One-cycle policy (torch ``anneal_strategy='cos'`` semantics): cosine
-    warmup from ``lr/div_factor`` to ``lr``, cosine anneal to the torch
-    floor ``(lr/div_factor)/final_div_factor``."""
+    """One-cycle policy, replicating torch's ``anneal_strategy='cos'``
+    formula exactly (including FRACTIONAL phase boundaries: the peak step is
+    the float ``pct_start·total_steps − 1``, not a rounded integer)."""
     import jax.numpy as jnp
 
-    # torch's peak step: float(pct_start*total_steps) - 1
-    warm = max(int(round(pct_start * total_steps)) - 1, 1)
+    end1 = pct_start * total_steps - 1.0  # float, torch's phase-1 end step
     init_lr = lr / div_factor
     final_lr = init_lr / final_div_factor
+    anneal_span = (total_steps - 1.0) - end1
 
-    def warmup(step):
-        frac = jnp.clip(jnp.asarray(step, jnp.float32) / warm, 0.0, 1.0)
-        return init_lr + (lr - init_lr) * 0.5 * (1.0 - jnp.cos(jnp.pi * frac))
+    def _cos(frac, a, b):
+        return b + (a - b) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
 
-    return optax.join_schedules(
-        [
-            warmup,
-            optax.cosine_decay_schedule(
-                init_value=lr, decay_steps=max(total_steps - 1 - warm, 1),
-                alpha=final_lr / lr if lr else 0.0,
-            ),
-        ],
-        boundaries=[warm],
-    )
+    def schedule(step):
+        s = jnp.asarray(step, jnp.float32)
+        up = _cos(jnp.clip(s / jnp.maximum(end1, 1e-9), 0.0, 1.0), init_lr, lr)
+        down = _cos(jnp.clip((s - end1) / jnp.maximum(anneal_span, 1e-9), 0.0, 1.0), lr, final_lr)
+        return jnp.where(s <= end1, up, down)
+
+    return schedule

@@ -36,10 +36,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["sample_sort_1d"]
+__all__ = ["order_statistics_1d", "sample_sort_1d"]
 
 _PAD = jnp.uint32(0xFFFFFFFF)  # sorts after every real key
 _NAN = jnp.uint32(0xFFFFFFFE)  # NaNs sort last among real values (numpy)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=16)
+def _shuffle_perm(cs: int) -> np.ndarray:
+    """Fixed shuffle permutation, cached per block size (a fresh O(cs)
+    host-side permutation per call would dominate repeated sorts)."""
+    return np.random.default_rng(0xC0FFEE).permutation(cs)
 
 
 def _encode_f32(x):
@@ -88,9 +98,13 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
     w = 2 * (-(-cs // p)) + 16  # exchange width per (src, dst) pair
     axis = comm.axis
 
+    if n >= 2**31:
+        # int32 rank targets / psum counts would wrap; callers route the
+        # global path instead (documented contract)
+        raise ValueError("sample_sort_1d supports n < 2**31")
     # fixed, data-independent local permutation (same on every shard is fine:
     # the block transpose below mixes across shards regardless)
-    perm = np.random.default_rng(0xC0FFEE).permutation(cs)
+    perm = _shuffle_perm(cs)
 
     def shard_fn(blk):
         my = lax.axis_index(axis)
@@ -150,6 +164,12 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
         )
         dest = jnp.sum(below, axis=1).astype(jnp.int32)  # (cs,) in [0, p)
         counts = jnp.sum(dest[:, None] == jnp.arange(p)[None, :], axis=0)  # (p,)
+        # pads (id sentinel) all land in the tail of bucket p-1 (they sort
+        # last); exclude them from the exchange — receivers synthesize their
+        # own pad slots, and counting them would fire the overflow fallback
+        # spuriously whenever cs - c > w (large meshes)
+        npad = jnp.sum(ids == jnp.uint32(0xFFFFFFFF)).astype(counts.dtype)
+        counts = counts.at[p - 1].add(-npad)
         overflow = lax.pmax(jnp.max(counts), axis) > w
         # local data is sorted, so each destination's run is contiguous
         starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
@@ -182,4 +202,50 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
         in_splits=((1, 0),),
         out_splits=((1, 0), (1, 0), Pspec()),
     )
+    return mapped(phys)
+
+
+def order_statistics_1d(comm, phys: jax.Array, n: int, ranks) -> jax.Array:
+    """Exact values at the given global ranks (0-based) of a 1-D padded
+    physical array — WITHOUT sorting: vectorized 32-round bisection on the
+    order-preserving key encoding, one psum count per round.
+
+    O(r·c) compare work and O(32) collectives total, O(1) extra memory —
+    this is what lets ``percentile``/``median`` scale past the
+    gather-and-sort the global path pays.  float32 only (the use case);
+    ranks are static Python ints.
+    """
+    ranks = tuple(int(r) for r in ranks)
+    if n >= 2**31:
+        raise ValueError("order_statistics_1d supports n < 2**31")
+    r = len(ranks)
+    p = comm.size
+    c = phys.shape[0] // p
+    axis = comm.axis
+
+    def shard_fn(blk):
+        my = lax.axis_index(axis)
+        gidx = (my * c + jnp.arange(c)).astype(jnp.uint32)
+        keys = jnp.where(gidx < jnp.uint32(n), _encode_f32(blk), _PAD)
+        targets = jnp.asarray([rk + 1 for rk in ranks], jnp.int32)  # count ≥ rank+1
+
+        def body(i, carry):
+            lo, hi = carry
+            mid = lo + (hi - lo) // 2
+            cnt = lax.psum(
+                jnp.sum(keys[:, None] <= mid[None, :], axis=0).astype(jnp.int32), axis
+            )
+            ge = cnt >= targets
+            return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+        lo0 = jnp.zeros((r,), jnp.uint32)
+        hi0 = jnp.full((r,), 0xFFFFFFFF, jnp.uint32)
+        lo, _ = lax.fori_loop(0, 32, body, (lo0, hi0))
+        has_nan = lax.pmax(jnp.any(jnp.where(gidx < jnp.uint32(n), jnp.isnan(blk), False)).astype(jnp.int32), axis)
+        vals = _decode_f32(lo)
+        return jnp.where(has_nan > 0, jnp.float32(jnp.nan), vals)
+
+    from jax.sharding import PartitionSpec as Pspec
+
+    mapped = comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=Pspec())
     return mapped(phys)

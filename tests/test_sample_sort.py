@@ -36,7 +36,7 @@ class TestSampleSort(TestCase):
             a = ht.array(x, split=0)
             v, i = ht.sort(a, method="sample")
             want = np.sort(x)
-            np.testing.assert_allclose(v.numpy(), want, equal_nan=True, rtol=0, atol=0), name
+            np.testing.assert_allclose(v.numpy(), want, equal_nan=True, rtol=0, atol=0, err_msg=name)
             # the returned indices reproduce the sorted order from the input
             np.testing.assert_allclose(x[i.numpy()], want, equal_nan=True)
             self.assert_distributed(v)
@@ -79,8 +79,6 @@ class TestSampleSort(TestCase):
             v, i, _ = orig(comm, phys, n)
             return v, i, jnp.asarray(True)
 
-        import heat_tpu.core.manipulations as man
-
         monkeypatch.setattr(ss, "sample_sort_1d", forced_overflow)
         x = rng.standard_normal(200).astype(np.float32)
         v, i = ht.sort(ht.array(x, split=0), method="sample")
@@ -91,3 +89,44 @@ class TestSampleSort(TestCase):
         a = ht.array(x, split=0)
         v, i = ht.sort(a, axis=0)  # auto: 2-D → global path
         self.assert_array_equal(v, np.sort(x, axis=0))
+
+
+class TestOrderStatistics(TestCase):
+    """Exact distributed order statistics + the bisected percentile path."""
+
+    def test_exact_ranks(self):
+        from heat_tpu.parallel.sample_sort import order_statistics_1d
+
+        x = rng.standard_normal(1001).astype(np.float32)
+        a = ht.array(x, split=0)
+        ranks = [0, 7, 500, 999, 1000]
+        vals = np.asarray(order_statistics_1d(a.comm, a._parray, 1001, ranks))
+        np.testing.assert_array_equal(vals, np.sort(x)[ranks])
+
+    def test_nan_propagates(self):
+        from heat_tpu.parallel.sample_sort import order_statistics_1d
+
+        x = rng.standard_normal(301).astype(np.float32)
+        x[13] = np.nan
+        a = ht.array(x, split=0)
+        assert np.isnan(np.asarray(order_statistics_1d(a.comm, a._parray, 301, [150]))).all()
+
+    def test_percentile_bisect_path(self, monkeypatch):
+        import heat_tpu.core.statistics as st
+
+        monkeypatch.setattr(st, "PERCENTILE_BISECT_THRESHOLD", 100)
+        x = rng.standard_normal(999).astype(np.float32)
+        a = ht.array(x, split=0)
+        # integral ranks (q hitting exact order statistics) are EXACT
+        for q in (0.0, 50.0, 100.0):  # n-1 = 998 even → these are integral
+            np.testing.assert_allclose(
+                float(st.percentile(a, q).numpy()), np.percentile(x, q), rtol=1e-6, atol=1e-6
+            )
+        # fractional ranks interpolate in f32 on device vs numpy's f64:
+        # tolerance reflects interpolation rounding, not rank error
+        for q in (30.0, 99.9):
+            np.testing.assert_allclose(
+                float(st.percentile(a, q).numpy()), np.percentile(x, q), rtol=2e-5, atol=1e-5
+            )
+        got = st.percentile(a, [25.0, 75.0]).numpy()
+        np.testing.assert_allclose(got, np.percentile(x, [25.0, 75.0]), rtol=2e-5, atol=1e-5)
