@@ -227,7 +227,9 @@ def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
 
 
 def median(x, axis=None, keepdims: bool = False) -> DNDarray:
-    """Median — the reference does distributed selection; XLA sorts globally."""
+    """Median — the reference's distributed selection maps to the bisected
+    exact order statistics for large 1-D split arrays (via
+    :func:`percentile`); smaller/ND inputs use the global XLA sort."""
     return percentile(x, 50.0, axis=axis, keepdims=keepdims)
 
 
@@ -314,10 +316,10 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
     """q-th percentile(s) along axis.
 
     Large 1-D split-0 float32 arrays with linear interpolation use the exact
-    distributed order statistics (``parallel.order_statistics_1d``: 32
-    psum-count bisection rounds, O(n/p) memory) instead of the global
-    gather-and-sort — the scalable path for the reference's distributed
-    median/percentile story.
+    distributed order statistics (``parallel.order_statistics_1d``:
+    radix-256 digit selection, 4 psum'd-histogram rounds, O(n/p) memory)
+    instead of the global gather-and-sort — the scalable path for the
+    reference's distributed median/percentile story.
     """
     ax = sanitize_axis(x.shape, axis)
     q_is_scalar = np.ndim(q) == 0 and not isinstance(q, DNDarray)
@@ -337,6 +339,10 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
 
         n = x.shape[0]
         qs = np.atleast_1d(np.asarray(q, np.float64))
+        if np.any(qs < 0.0) or np.any(qs > 100.0):
+            # numpy contract (the global jnp path clamps; be stricter here
+            # than silently selecting a pad sentinel)
+            raise ValueError("Percentiles must be in the range [0, 100]")
         pos = qs / 100.0 * (n - 1)
         lo = np.floor(pos).astype(np.int64)
         hi = np.ceil(pos).astype(np.int64)

@@ -12,12 +12,12 @@ TPU-native redesign:
    order (including the adversarial already-sorted case, where the naive
    bucket map is all-to-one).
 2. **Exact splitters** — the p−1 canonical chunk boundaries are global
-   order statistics; they are found by vectorized **bisection on the
-   order-preserving integer encoding** of the keys (32 rounds on value bits
-   + 32 on tie-breaking ids, each round one ``psum`` of a (p−1,) count
-   vector).  Exact splitters ⇒ every destination receives EXACTLY its
-   canonical ceil-div chunk, so the result lands directly in the
-   framework's standard layout — no rebalancing pass.
+   order statistics; they are found by **radix-256 digit selection on the
+   order-preserving integer encoding** of the keys (4 rounds on value bits
+   + 4 on tie-breaking ids, each round ONE ``psum`` of an (r, 256)
+   scatter-add histogram).  Exact splitters ⇒ every
+   destination receives EXACTLY its canonical ceil-div chunk, so the result
+   lands directly in the framework's standard layout — no rebalancing pass.
 3. **Padded exchange** — each shard packs per-destination runs into a
    ``(p, w)`` buffer (``w ≈ 2c/p`` thanks to the shuffle) and one
    ``all_to_all`` delivers them; receivers merge-sort ``(p·w)`` entries
@@ -74,6 +74,49 @@ def _decode_i32(enc):
     return lax.bitcast_convert_type(enc ^ jnp.uint32(0x80000000), jnp.int32)
 
 
+def _radix_select(vals, targets, axis, base_mask=None):
+    """Smallest value whose global ≤-count reaches each target, by radix-256
+    digit selection: 4 rounds, ONE psum of an (r, 256) histogram per round —
+    4 collectives instead of 32 bisection rounds (collective latency is the
+    cost that matters at small n and on CPU meshes).
+
+    ``vals``: (c,) uint32 per shard; ``targets``: (r,) int32 ranks (1-based
+    counts); ``base_mask``: optional (c, r) int32 restricting each target's
+    population (used for tie-breaking by id within an equal-key class).
+    Returns ``(sel, remaining)``: selected values and the residual rank
+    within each selected value's equal class.
+    """
+    r = targets.shape[0]
+    prefix = jnp.zeros((r,), jnp.uint32)
+    remaining = targets
+    for rnd in range(4):
+        shift = 24 - 8 * rnd
+        if rnd == 0:
+            mask = jnp.ones((vals.shape[0], r), jnp.int32)
+        else:
+            mask = ((vals >> (shift + 8))[:, None] == prefix[None, :]).astype(jnp.int32)
+        if base_mask is not None:
+            mask = mask * base_mask
+        byte = ((vals >> shift) & jnp.uint32(0xFF)).astype(jnp.int32)
+        # (r, 256) histogram via scatter-add — O(c·r) work and memory,
+        # unlike a one-hot GEMM which would materialize a (c, 256) operand
+        hist = jax.vmap(
+            lambda m: jnp.zeros(256, jnp.int32).at[byte].add(m), in_axes=1
+        )(mask)
+        hist = lax.psum(hist, axis)
+        cum = jnp.cumsum(hist, axis=1)
+        ge = cum >= remaining[:, None]
+        b_star = jnp.argmax(ge, axis=1).astype(jnp.uint32)  # first reaching byte
+        below = jnp.where(
+            b_star > 0,
+            jnp.take_along_axis(cum, (b_star.astype(jnp.int32) - 1)[:, None], axis=1)[:, 0],
+            0,
+        )
+        remaining = remaining - below
+        prefix = (prefix << 8) | b_star
+    return prefix, remaining
+
+
 def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Sort a 1-D padded physical array sharded over ``comm``.
 
@@ -82,11 +125,19 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
     the sorted values and their ORIGINAL global indices in the same padded
     layout, plus a bool scalar — True means a bucket overflowed the static
     exchange width and the caller must use the global-sort fallback.
+
+    The whole pipeline is ONE cached jitted XLA program per
+    (comm, shape, dtype, n) — an eager shard_map would dispatch per-op
+    (measured ~500× slower on the CPU mesh).
     """
+    return _sort_program(comm, phys.shape[0], jnp.dtype(phys.dtype).name, n)(phys)
+
+
+@functools.lru_cache(maxsize=32)
+def _sort_program(comm, P: int, dtype_name: str, n: int):
     p = comm.size
-    P = phys.shape[0]
     c = P // p
-    if jnp.issubdtype(phys.dtype, jnp.floating):
+    if jnp.issubdtype(jnp.dtype(dtype_name), jnp.floating):
         enc_in, dec = _encode_f32, _decode_f32
         out_dt = jnp.float32
     else:
@@ -129,33 +180,14 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
         order = jnp.lexsort((ids, keys))
         keys, ids = keys[order], ids[order]
 
-        # ---- 2. exact canonical splitters via bisection ------------------- #
+        # ---- 2. exact canonical splitters via radix selection ------------- #
         # canonical boundary targets: B_t = min((t+1)·c, n), t = 0..p-2
         targets = jnp.minimum((jnp.arange(p - 1) + 1) * c, n).astype(jnp.int32)
-
-        def count_le(kb, ib):
-            # global count of (key, id) pairs lexicographically ≤ (kb, ib);
-            # kb/ib are (p-1,) — broadcast against the local (cs,) block
-            lt = keys[:, None] < kb[None, :]
-            eq = (keys[:, None] == kb[None, :]) & (ids[:, None] <= ib[None, :])
-            return lax.psum(jnp.sum(lt | eq, axis=0).astype(jnp.int32), axis)
-
-        def bisect(body_bits, lo0, hi0, fixed):
-            def body(i, carry):
-                lo, hi = carry
-                mid = lo + (hi - lo) // 2
-                cnt = body_bits(mid, fixed)
-                ge = cnt >= targets
-                return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
-
-            lo, hi = lax.fori_loop(0, 32, body, (lo0, hi0))
-            return lo
-
-        # phase 1: smallest key bits kb with count(key ≤ kb, id=max) ≥ B_t
-        kmax = jnp.full((p - 1,), 0xFFFFFFFF, jnp.uint32)
-        kb = bisect(lambda mid, _f: count_le(mid, kmax), jnp.zeros((p - 1,), jnp.uint32), kmax, None)
-        # phase 2: smallest id ib with count((key,id) ≤ (kb, ib)) ≥ B_t
-        ib = bisect(lambda mid, _f: count_le(kb, mid), jnp.zeros((p - 1,), jnp.uint32), kmax, None)
+        # phase 1: key value at each target rank (+ residual rank among ties)
+        kb, rem = _radix_select(keys, targets, axis)
+        # phase 2: tie-break — the rem-th id within each kb's equal-key class
+        key_eq = (keys[:, None] == kb[None, :]).astype(jnp.int32)
+        ib, _ = _radix_select(ids, rem, axis, base_mask=key_eq)
 
         # ---- 3. partition + padded exchange ------------------------------- #
         # destination = number of splitters strictly below this element
@@ -202,25 +234,30 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
         in_splits=((1, 0),),
         out_splits=((1, 0), (1, 0), Pspec()),
     )
-    return mapped(phys)
+    return jax.jit(mapped)
 
 
 def order_statistics_1d(comm, phys: jax.Array, n: int, ranks) -> jax.Array:
     """Exact values at the given global ranks (0-based) of a 1-D padded
-    physical array — WITHOUT sorting: vectorized 32-round bisection on the
-    order-preserving key encoding, one psum count per round.
+    physical array — WITHOUT sorting: radix-256 digit selection on the
+    order-preserving key encoding, one psum'd histogram per round (4 total).
 
-    O(r·c) compare work and O(32) collectives total, O(1) extra memory —
-    this is what lets ``percentile``/``median`` scale past the
-    gather-and-sort the global path pays.  float32 only (the use case);
-    ranks are static Python ints.
+    O(r·c) work, O(4) collectives, O(1) extra memory — this is what lets
+    ``percentile``/``median`` scale past the gather-and-sort the global path
+    pays.  float32 only (the use case); ranks are static Python ints.
+    One cached jitted program per (comm, shape, n, ranks).
     """
+    return _order_stats_program(comm, phys.shape[0], n, tuple(int(r) for r in ranks))(phys)
+
+
+@functools.lru_cache(maxsize=32)
+def _order_stats_program(comm, P: int, n: int, ranks: tuple):
     ranks = tuple(int(r) for r in ranks)
     if n >= 2**31:
         raise ValueError("order_statistics_1d supports n < 2**31")
     r = len(ranks)
     p = comm.size
-    c = phys.shape[0] // p
+    c = P // p
     axis = comm.axis
 
     def shard_fn(blk):
@@ -228,24 +265,12 @@ def order_statistics_1d(comm, phys: jax.Array, n: int, ranks) -> jax.Array:
         gidx = (my * c + jnp.arange(c)).astype(jnp.uint32)
         keys = jnp.where(gidx < jnp.uint32(n), _encode_f32(blk), _PAD)
         targets = jnp.asarray([rk + 1 for rk in ranks], jnp.int32)  # count ≥ rank+1
-
-        def body(i, carry):
-            lo, hi = carry
-            mid = lo + (hi - lo) // 2
-            cnt = lax.psum(
-                jnp.sum(keys[:, None] <= mid[None, :], axis=0).astype(jnp.int32), axis
-            )
-            ge = cnt >= targets
-            return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
-
-        lo0 = jnp.zeros((r,), jnp.uint32)
-        hi0 = jnp.full((r,), 0xFFFFFFFF, jnp.uint32)
-        lo, _ = lax.fori_loop(0, 32, body, (lo0, hi0))
+        sel, _ = _radix_select(keys, targets, axis)
         has_nan = lax.pmax(jnp.any(jnp.where(gidx < jnp.uint32(n), jnp.isnan(blk), False)).astype(jnp.int32), axis)
-        vals = _decode_f32(lo)
+        vals = _decode_f32(sel)
         return jnp.where(has_nan > 0, jnp.float32(jnp.nan), vals)
 
     from jax.sharding import PartitionSpec as Pspec
 
     mapped = comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=Pspec())
-    return mapped(phys)
+    return jax.jit(mapped)

@@ -130,3 +130,13 @@ class TestOrderStatistics(TestCase):
             )
         got = st.percentile(a, [25.0, 75.0]).numpy()
         np.testing.assert_allclose(got, np.percentile(x, [25.0, 75.0]), rtol=2e-5, atol=1e-5)
+
+    def test_out_of_range_q_raises(self, monkeypatch):
+        import heat_tpu.core.statistics as st
+
+        monkeypatch.setattr(st, "PERCENTILE_BISECT_THRESHOLD", 100)
+        a = ht.array(rng.standard_normal(500).astype(np.float32), split=0)
+        with pytest.raises(ValueError):
+            st.percentile(a, 100.5)
+        with pytest.raises(ValueError):
+            st.percentile(a, [-0.1, 50.0])
