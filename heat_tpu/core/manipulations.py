@@ -8,6 +8,8 @@ the reference's conventions.
 
 from __future__ import annotations
 
+import functools
+
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -605,10 +607,59 @@ def tile(x: DNDarray, reps) -> DNDarray:
     return _wrap(res, new_split, x)
 
 
+def _order_flip(a):
+    """Strictly order-reversing transform for smallest-k via top_k: bitwise
+    NOT for integers (``~x = -x-1`` — no overflow at INT_MIN, unlike
+    negation) and arithmetic negation for floats."""
+    return ~a if jnp.issubdtype(a.dtype, jnp.integer) else -a
+
+
+@functools.lru_cache(maxsize=32)
+def _topk_program(comm, k: int, largest: bool):
+    """One cached jitted XLA program per (comm, k, largest) — the repo's
+    convention for collective pipelines (a fresh shard_map+jit per call
+    would retrace and recompile every invocation)."""
+    axis = comm.axis
+
+    def shard_fn(blk):
+        my = jax.lax.axis_index(axis)
+        base = my * blk.shape[0]
+        keys = blk if largest else _order_flip(blk)
+        lv, li = jax.lax.top_k(keys, k)
+        gi = base + li  # local → global indices
+        allv = jax.lax.all_gather(lv, axis, axis=0, tiled=True)  # (p·k,)
+        alli = jax.lax.all_gather(gi, axis, axis=0, tiled=True)
+        fv, fi = jax.lax.top_k(allv, k)
+        return (fv if largest else _order_flip(fv)), alli[fi].astype(jnp.int32)
+
+    return jax.jit(comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=((1, None), (1, None))))
+
+
 def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
-    """Top-k values and indices along dim (reference: torch.topk + merge)."""
+    """Top-k values and GLOBAL indices along dim (reference: per-rank
+    torch.topk + merge).
+
+    1-D split arrays use the reference's merge scheme natively: each shard
+    takes its LOCAL top-k (static shape), one all_gather of the (p, k)
+    candidate sets, and a final top-k of the p·k merged candidates — exact,
+    O(p·k) memory instead of gathering all n elements.
+    """
     dim = sanitize_axis(x.shape, dim)
     j = x._jarray
+    if (
+        x.ndim == 1
+        and x.split == 0
+        and x.comm.is_distributed()
+        and k <= x.shape[0] // x.comm.size  # every shard can supply k candidates
+        and x._pad == 0  # pad rows would need masking inside the local top-k
+    ):
+        vals, idx = _topk_program(x.comm, k, largest)(x._parray)
+        v = _wrap(vals, None, x)
+        i = _wrap(idx, None, x)
+        if out is not None:
+            out[0]._jarray, out[1]._jarray = v._jarray, i._jarray
+            return out
+        return v, i
     if dim != x.ndim - 1:
         jm = jnp.moveaxis(j, dim, -1)
     else:
@@ -616,8 +667,8 @@ def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     if largest:
         vals, idx = jax.lax.top_k(jm, k)
     else:
-        vals, idx = jax.lax.top_k(-jm, k)
-        vals = -vals
+        vals, idx = jax.lax.top_k(_order_flip(jm), k)
+        vals = _order_flip(vals)
     if dim != x.ndim - 1:
         vals = jnp.moveaxis(vals, -1, dim)
         idx = jnp.moveaxis(idx, -1, dim)
