@@ -12,5 +12,14 @@ rotation IS the cdist ring).
 from .ring import ring_map
 from .halo import halo_exchange, with_halos
 from .ring_attention import ring_attention, ring_self_attention
+from .sample_sort import order_statistics_1d, sample_sort_1d
 
-__all__ = ["ring_map", "halo_exchange", "with_halos", "ring_attention", "ring_self_attention"]
+__all__ = [
+    "ring_map",
+    "halo_exchange",
+    "with_halos",
+    "ring_attention",
+    "ring_self_attention",
+    "order_statistics_1d",
+    "sample_sort_1d",
+]
