@@ -40,8 +40,12 @@ class TestHaloConvolve(TestCase):
                     )
 
     def test_halo_too_wide_falls_back(self):
-        # kernel wider than a block: halo cannot fit, global path must serve
-        n, m = 13, 6  # blocks of 2 on 8 devices, halo 5
+        # kernel wider than a block: halo cannot fit, global path must serve.
+        # sized from the ACTUAL device count so the halo (m-1) always
+        # exceeds the ceil-div block at any mesh width
+        p = ht.communication.get_comm().size
+        n = 13
+        m = -(-n // p) + 2
         an = np.arange(n, dtype=np.float32)
         vn = np.ones(m, dtype=np.float32)
         before = sg._HALO_CONV_RUNS

@@ -63,14 +63,18 @@ class TestBatchedRingAttention:
         p /= p.sum(-1, keepdims=True)
         return np.einsum("...qk,...kd->...qd", p, v)
 
-    @pytest.mark.parametrize("shape", [(32, 8), (3, 32, 8), (2, 4, 32, 8)])
+    @pytest.mark.parametrize("lead", [(), (3,), (2, 4)])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_reference(self, shape, causal):
+    def test_matches_reference(self, lead, causal):
         import jax
         import jax.numpy as jnp
         from heat_tpu.parallel.ring_attention import ring_attention
 
         comm = ht.communication.get_comm()
+        # S scales with the ACTUAL mesh so the ring path engages at any
+        # device count (non-divisible S falls back to the dense path by
+        # design, which would make the sharding assertion meaningless)
+        shape = (*lead, 8 * comm.size, 8)
         rng = np.random.default_rng(1)
         q, k, v = (rng.standard_normal(shape).astype(np.float32) for _ in range(3))
         seq_ax = len(shape) - 2
