@@ -12,7 +12,7 @@ from . import core
 from .core import random
 from . import linalg
 from .linalg import matmul, dot, transpose, norm  # hoist reference's flat exports
-from .linalg.basics import outer, trace, tril, triu, vdot, cross, projection, vector_norm, matrix_norm, einsum, kron, inner, tensordot, vecdot
+from .linalg.basics import outer, trace, tril, triu, vdot, cross, projection, vector_norm, matrix_norm, einsum, einsum_path, kron, inner, tensordot, vecdot
 from .linalg.qr import qr
 from .linalg.svdtools import svd
 from . import spatial

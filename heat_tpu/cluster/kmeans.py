@@ -11,6 +11,7 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 
+from ..core._cache import comm_cached
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
@@ -151,60 +152,50 @@ class KMeans(_KCluster):
         (k,d)/(k,) statistics, while_loop to convergence, final per-shard
         assignment via ``_assign``.  ``n`` (the logical row count) is a
         traced operand, so all row counts sharing a padded shape share one
-        compile."""
-        cache = cls.__dict__.get("_FIT_SHARDED")
-        if cache is None:
-            # weak keys: a Communication's compiled program (which pins its
-            # mesh + XLA executable) must die with the comm, not with the class
-            import weakref
+        compile.  Cached on the comm instance (``comm_cached``) so the
+        program — which pins mesh + XLA executable — dies with the comm."""
+        return _fit_sharded_program(comm, cls, _KCluster._ASSIGN_BLOCK)
 
-            cache = weakref.WeakKeyDictionary()
-            cls._FIT_SHARDED = cache
-        per_comm = cache.get(comm)
-        if per_comm is None:
-            per_comm = cache[comm] = {}
-        prog = per_comm.get(_KCluster._ASSIGN_BLOCK)
-        if prog is not None:
-            return prog
-        axis = comm.axis
 
-        def shard_fn(phys_blk, centers0, n, max_iter, tol):
-            c = phys_blk.shape[0]
-            base = jax.lax.axis_index(axis) * c
+@comm_cached
+def _fit_sharded_program(comm, cls, assign_block):
+    axis = comm.axis
 
-            def em(centers):
-                s, cnt = cls._local_em_stats(phys_blk, centers, base, n)
-                s = jax.lax.psum(s, axis)  # the reference's two Allreduces
-                cnt = jax.lax.psum(cnt, axis)
-                return cls._centers_from_stats(s, cnt, centers)
+    def shard_fn(phys_blk, centers0, n, max_iter, tol):
+        c = phys_blk.shape[0]
+        base = jax.lax.axis_index(axis) * c
 
-            def cond(state):
-                _, it, shift = state
-                return jnp.logical_and(it < max_iter, shift > tol)
+        def em(centers):
+            s, cnt = cls._local_em_stats(phys_blk, centers, base, n)
+            s = jax.lax.psum(s, axis)  # the reference's two Allreduces
+            cnt = jax.lax.psum(cnt, axis)
+            return cls._centers_from_stats(s, cnt, centers)
 
-            def body(state):
-                centers, it, _ = state
-                new = em(centers)
-                return new, it + 1, jnp.max(jnp.abs(new - centers))
+        def cond(state):
+            _, it, shift = state
+            return jnp.logical_and(it < max_iter, shift > tol)
 
-            centers, n_iter, _ = jax.lax.while_loop(
-                cond, body,
-                (centers0, jnp.asarray(0), jnp.asarray(jnp.inf, centers0.dtype)),
-            )
-            # final local assignment on the converged centers — _assign
-            # handles the small and blocked cases; pad rows are masked below
-            labels, d2min = cls._assign(phys_blk, centers)
-            w = (base + jnp.arange(c) < n).astype(d2min.dtype)
-            inertia = jax.lax.psum(jnp.sum(d2min * w), axis)
-            return centers, labels, inertia, n_iter
+        def body(state):
+            centers, it, _ = state
+            new = em(centers)
+            return new, it + 1, jnp.max(jnp.abs(new - centers))
 
-        from jax.sharding import PartitionSpec as P
-
-        mapped = comm.shard_map(
-            shard_fn,
-            in_splits=((2, 0), P(), P(), P(), P()),
-            out_splits=(P(), (1, 0), P(), P()),
+        centers, n_iter, _ = jax.lax.while_loop(
+            cond, body,
+            (centers0, jnp.asarray(0), jnp.asarray(jnp.inf, centers0.dtype)),
         )
-        prog = jax.jit(mapped)
-        per_comm[_KCluster._ASSIGN_BLOCK] = prog
-        return prog
+        # final local assignment on the converged centers — _assign
+        # handles the small and blocked cases; pad rows are masked below
+        labels, d2min = cls._assign(phys_blk, centers)
+        w = (base + jnp.arange(c) < n).astype(d2min.dtype)
+        inertia = jax.lax.psum(jnp.sum(d2min * w), axis)
+        return centers, labels, inertia, n_iter
+
+    from jax.sharding import PartitionSpec as P
+
+    mapped = comm.shard_map(
+        shard_fn,
+        in_splits=((2, 0), P(), P(), P(), P()),
+        out_splits=(P(), (1, 0), P(), P()),
+    )
+    return jax.jit(mapped)

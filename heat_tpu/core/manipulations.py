@@ -8,7 +8,6 @@ the reference's conventions.
 
 from __future__ import annotations
 
-import functools
 
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -17,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import factories, types
+from ._cache import comm_cached
 from ._operations import _local_op
 from .dndarray import DNDarray
 from .sanitation import sanitize_in
@@ -614,7 +614,7 @@ def _order_flip(a):
     return ~a if jnp.issubdtype(a.dtype, jnp.integer) else -a
 
 
-@functools.lru_cache(maxsize=32)
+@comm_cached
 def _topk_program(comm, k: int, largest: bool):
     """One cached jitted XLA program per (comm, k, largest) — the repo's
     convention for collective pipelines (a fresh shard_map+jit per call

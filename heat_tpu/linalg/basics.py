@@ -29,6 +29,7 @@ __all__ = [
     "det",
     "dot",
     "einsum",
+    "einsum_path",
     "inv",
     "kron",
     "matmul",
@@ -247,6 +248,22 @@ def einsum(subscripts: str, *operands, out=None) -> DNDarray:
         out._jarray = r._jarray.astype(out.dtype.jax_dtype())
         return out
     return r
+
+
+def einsum_path(subscripts: str, *operands, optimize="greedy"):
+    """Contraction-order plan for :func:`einsum` (numpy ``einsum_path``).
+
+    Pure planning metadata — shapes only, no data movement — so delegating to
+    numpy on the GLOBAL shapes is exact.  Note that under XLA the plan is
+    advisory: ``jnp.einsum`` hands contraction ordering to opt_einsum/XLA
+    itself; this exists for numpy-API parity and for users sizing
+    intermediates by hand.
+    """
+    hosts = [
+        np.broadcast_to(np.empty((), np.float32), o.shape) if isinstance(o, DNDarray) else np.asarray(o)
+        for o in operands
+    ]
+    return np.einsum_path(subscripts, *hosts, optimize=optimize)
 
 
 def kron(a, b) -> DNDarray:

@@ -7,13 +7,13 @@ reference (SURVEY §2.3).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..core import types
+from ..core._cache import comm_cached
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from .basics import dot, matmul
@@ -150,7 +150,7 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False, blocked=None
 
     tiles = SquareDiagTiles(A, tiles_per_proc=2)
     ends = tuple(int(e) for e in tiles.row_indices[1:]) + (n,)
-    prog = _blocked_tri_program(ends, lower)
+    prog = _blocked_tri_program(A.comm, ends, lower)
     jb = b._jarray if b.ndim == 2 else b._jarray[:, None]
     x = prog(A._jarray, jb)
     if b.ndim == 1:
@@ -158,8 +158,8 @@ def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False, blocked=None
     return _wrap(x, b.split, b)
 
 
-@functools.lru_cache(maxsize=64)
-def _blocked_tri_program(row_ends: tuple, lower: bool):
+@comm_cached
+def _blocked_tri_program(comm, row_ends: tuple, lower: bool):
     """One compiled XLA program per tile layout: the whole blocked
     substitution (tile boundaries are static) traces once, so repeated solves
     pay zero per-tile dispatch — unlike the reference, whose Python loop

@@ -79,7 +79,15 @@ def CosineAnnealingWarmRestarts(lr: float, T_0: int, T_mult: int = 1, eta_min: f
 
     The restart position is computed analytically per step (jit-safe), so
     there is no finite horizon: ``T_mult == 1`` cycles forever with period
-    ``T_0``; ``T_mult > 1`` grows the period geometrically."""
+    ``T_0``; ``T_mult > 1`` grows the period geometrically.
+
+    Boundary exactness: the restart index from the f32 log quotient is
+    corrected against the exact (rounded-integer) cycle starts, so steps
+    landing exactly on a restart return the restarted peak lr.  This is
+    *stricter than torch*, whose float64 ``log(epoch*(Tm-1)/T0 + 1, Tm)``
+    itself floors into the previous cycle for some boundaries (e.g.
+    ``T_0=5, T_mult=3`` at step 605 torch returns ``eta_min``; we return
+    the peak, which is the mathematically correct SGDR value)."""
     import jax.numpy as jnp
 
     def schedule(step):
@@ -88,9 +96,21 @@ def CosineAnnealingWarmRestarts(lr: float, T_0: int, T_mult: int = 1, eta_min: f
             t_cur = jnp.mod(s, T_0)
             period = jnp.asarray(T_0, jnp.float32)
         else:
-            # n = floor(log_Tm(step*(Tm-1)/T_0 + 1)) restarts so far
+            # n = floor(log_Tm(step*(Tm-1)/T_0 + 1)) restarts so far.  The
+            # f32 log quotient can land exactly-on-boundary steps at n∓eps
+            # (flooring into the wrong cycle → eta_min instead of the
+            # restarted peak), so correct n against the exact integer cycle
+            # starts T_0·(Tm^m − 1)/(Tm − 1), which torch computes iteratively.
             n = jnp.floor(jnp.log(s * (T_mult - 1) / T_0 + 1.0) / jnp.log(float(T_mult)))
-            t_start = T_0 * (T_mult**n - 1.0) / (T_mult - 1.0)
+
+            def cycle_start(m):
+                # integer by construction (T_0, T_mult ints) — round away the
+                # exp/log error in jnp.power so the boundary compares are exact
+                return jnp.round(T_0 * (jnp.power(float(T_mult), m) - 1.0) / (T_mult - 1.0))
+
+            n = jnp.where(s >= cycle_start(n + 1.0), n + 1.0, n)
+            n = jnp.where(s < cycle_start(n), n - 1.0, n)
+            t_start = cycle_start(n)
             period = T_0 * (float(T_mult) ** n)
             t_cur = s - t_start
         cos = 0.5 * (1.0 + jnp.cos(jnp.pi * t_cur / period))

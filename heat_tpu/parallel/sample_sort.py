@@ -44,6 +44,8 @@ _NAN = jnp.uint32(0xFFFFFFFE)  # NaNs sort last among real values (numpy)
 
 import functools
 
+from ..core._cache import comm_cached
+
 
 @functools.lru_cache(maxsize=16)
 def _shuffle_perm(cs: int) -> np.ndarray:
@@ -133,7 +135,7 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
     return _sort_program(comm, phys.shape[0], jnp.dtype(phys.dtype).name, n)(phys)
 
 
-@functools.lru_cache(maxsize=32)
+@comm_cached
 def _sort_program(comm, P: int, dtype_name: str, n: int):
     p = comm.size
     c = P // p
@@ -250,7 +252,7 @@ def order_statistics_1d(comm, phys: jax.Array, n: int, ranks) -> jax.Array:
     return _order_stats_program(comm, phys.shape[0], n, tuple(int(r) for r in ranks))(phys)
 
 
-@functools.lru_cache(maxsize=32)
+@comm_cached
 def _order_stats_program(comm, P: int, n: int, ranks: tuple):
     ranks = tuple(int(r) for r in ranks)
     if n >= 2**31:

@@ -367,6 +367,47 @@ class TestLRSchedulersBatch2:
         s3 = lrs.OneCycleLR(1.0, total_steps=1000)
         assert float(s3(999)) < 1e-5  # torch floor: (lr/25)/1e4
 
+    def test_warm_restarts_exact_boundaries(self):
+        """ADVICE r3: f32 log rounding must not floor an exact-restart step
+        into the previous cycle.  Every geometric cycle start returns the
+        restarted peak — including 605 for (T_0=5, T_mult=3), where torch's
+        own float64 log fails and returns eta_min."""
+        from heat_tpu.optim import lr_scheduler as lrs
+
+        for T0, Tm, bounds in (
+            (5, 3, (5, 20, 65, 200, 605, 1820)),
+            (2, 2, (2, 6, 14, 30, 62, 126, 254, 510, 1022)),
+            (7, 4, (7, 35, 147, 595)),
+        ):
+            s = lrs.CosineAnnealingWarmRestarts(1.0, T_0=T0, T_mult=Tm, eta_min=0.001)
+            for t in bounds:
+                assert abs(float(s(t)) - 1.0) < 1e-4, (T0, Tm, t, float(s(t)))
+                # the restart is a genuine upward jump from the old cycle's tail
+                assert float(s(t)) - float(s(t - 1)) > 0.2, (T0, Tm, t)
+
+    def test_warm_restarts_matches_torch_off_boundary(self):
+        """Full-trajectory oracle check vs torch, excluding the boundary
+        steps where torch's own log rounding is wrong (see docstring)."""
+        import jax
+        import jax.numpy as jnp
+        import torch
+
+        from heat_tpu.optim import lr_scheduler as lrs
+
+        T0, Tm = 5, 3
+        boundaries = {5, 20, 65, 200, 605}
+        s = jax.jit(jax.vmap(lrs.CosineAnnealingWarmRestarts(0.1, T_0=T0, T_mult=Tm, eta_min=0.001)))
+        ours = np.asarray(s(jnp.arange(700)))
+        opt = torch.optim.SGD([torch.nn.Parameter(torch.zeros(1))], lr=0.1)
+        ts = torch.optim.lr_scheduler.CosineAnnealingWarmRestarts(opt, T_0=T0, T_mult=Tm, eta_min=0.001)
+        want = []
+        for step in range(700):
+            ts.step(step)
+            want.append(ts.get_last_lr()[0])
+        for step in range(700):
+            if step not in boundaries:
+                assert abs(ours[step] - want[step]) < 1e-4, step
+
     def test_onecycle_matches_torch_exactly(self):
         import torch
 

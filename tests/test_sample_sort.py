@@ -175,3 +175,78 @@ class TestDistributedTopK(TestCase):
         xi = np.array([-128, 5, -1, 127] * 16, np.int8)
         v3, _ = ht.topk(ht.array(xi, split=0), 2, largest=False)
         np.testing.assert_array_equal(np.sort(v3.numpy()), [-128, -128])
+
+
+class TestCommCachedLifetime(TestCase):
+    def test_program_cache_dies_with_comm(self):
+        """ADVICE r3: compiled collective programs live ON the comm instance
+        — a dropped Communication releases its cached programs (and the
+        mesh/executables they pin), unlike the old lru_cache."""
+        import gc
+        import weakref
+
+        import jax
+        from jax.sharding import Mesh
+
+        from heat_tpu.core.manipulations import _topk_program
+
+        devs = np.asarray(jax.devices()[: min(4, len(jax.devices()))])
+        comm = ht.communication.Communication(Mesh(devs, ("x",)), "x")
+        x = ht.array(rng.standard_normal(64).astype(np.float32), split=0, comm=comm)
+        ht.topk(x, 3)
+        assert _topk_program._cache_slot in comm.__dict__["_compiled_programs"]
+        wr = weakref.ref(comm)
+        del x, comm
+        gc.collect()
+        # nothing (no global cache registry) pins the comm or its programs
+        assert wr() is None
+
+    def test_program_cache_is_per_instance(self):
+        """Two value-equal comms (same mesh+axis ⇒ __eq__/__hash__ equal)
+        must NOT alias cache entries: each instance owns its programs, so a
+        short-lived equal comm can die without touching the other's cache."""
+        import gc
+        import weakref
+
+        import jax
+        from jax.sharding import Mesh
+
+        from heat_tpu.core.manipulations import _topk_program
+
+        devs = np.asarray(jax.devices()[: min(4, len(jax.devices()))])
+        comm1 = ht.communication.Communication(Mesh(devs, ("x",)), "x")
+        comm2 = ht.communication.Communication(Mesh(devs, ("x",)), "x")
+        assert comm1 == comm2 and comm1 is not comm2
+        for comm in (comm1, comm2):
+            x = ht.array(rng.standard_normal(64).astype(np.float32), split=0, comm=comm)
+            ht.topk(x, 3)
+            del x
+        slot = _topk_program._cache_slot
+        assert slot in comm1.__dict__["_compiled_programs"]
+        assert slot in comm2.__dict__["_compiled_programs"]
+        wr = weakref.ref(comm2)
+        del comm, comm2
+        gc.collect()
+        assert wr() is None  # equal survivor comm1 does not pin it
+        assert slot in comm1.__dict__["_compiled_programs"]  # survivor unaffected
+
+    def test_program_cache_lru_bound(self):
+        """Data-derived static keys (n, k) are LRU-bounded per (comm, fn) —
+        a long-lived world comm cannot accumulate executables without bound."""
+        from heat_tpu.core._cache import comm_cached
+
+        calls = []
+
+        @comm_cached(maxsize=3)
+        def build(comm, n):
+            calls.append(n)
+            return n * 2
+
+        comm = ht.communication.get_comm()
+        for n in range(5):
+            assert build(comm, n) == n * 2
+        assert build(comm, 4) == 8 and calls == list(range(5))  # hit, no rebuild
+        table = comm.__dict__["_compiled_programs"][build._cache_slot]
+        assert len(table) == 3  # oldest evicted
+        build(comm, 0)  # evicted → rebuilt
+        assert calls == list(range(5)) + [0]
