@@ -131,21 +131,54 @@ class DCSR_matrix:
         return DCSR_matrix(self.__array, self.__gnnz, self.__gshape, self.__dtype,
                            self.__split, self.__device, self.__comm, self.__balanced)
 
-    def __matmul__(self, other):
-        from ..core.dndarray import DNDarray
+    def _row_sharded_parts(self):
+        """Per-shard COO blocks for the distributed spmm path (split=0):
+        ``(data, rows, cols)`` as ``(p, m)`` mesh-sharded arrays (``m`` =
+        max per-shard nnz; short shards padded with OUT-OF-RANGE indices
+        (local row = rows_per_shard, col = ncols), which BCOO treats as
+        padding and drops — explicit zeros at (0, 0) would instead poison
+        row 0 with NaN when the dense operand carries inf/NaN, since
+        0·inf = NaN), plus ``(m, rows_per_shard)``.
+        Row indices are LOCAL to the shard.  Computed once per matrix
+        (host-side bucket-by-shard over the COO triplets) and cached on the
+        instance, so repeated matmuls pay only the spmm program."""
+        cached = getattr(self, "_parts_cache", None)
+        if cached is not None:
+            return cached
+        comm = self.__comm
+        p = comm.size
+        nrows = self.__gshape[0]
+        rows_per_shard = comm.padded_extent(nrows) // p
+        idx = np.asarray(self.__array.indices)
+        data = np.asarray(self.__array.data)
+        shard_of = idx[:, 0] // rows_per_shard
+        counts = np.bincount(shard_of, minlength=p)
+        m = max(int(counts.max()), 1)
+        d = np.zeros((p, m), data.dtype)
+        r = np.full((p, m), rows_per_shard, np.int32)
+        c = np.full((p, m), self.__gshape[1], np.int32)
+        order = np.argsort(shard_of, kind="stable")
+        pos = 0
+        for s in range(p):
+            take = order[pos : pos + counts[s]]
+            d[s, : counts[s]] = data[take]
+            r[s, : counts[s]] = idx[take, 0] - s * rows_per_shard
+            c[s, : counts[s]] = idx[take, 1]
+            pos += counts[s]
+        parts = (
+            comm.shard(jnp.asarray(d), 0),
+            comm.shard(jnp.asarray(r), 0),
+            comm.shard(jnp.asarray(c), 0),
+            m,
+            rows_per_shard,
+        )
+        self._parts_cache = parts
+        return parts
 
-        if isinstance(other, DNDarray):
-            res = self.__array @ other._jarray
-            res = self.__comm.shard(res, self.__split)
-            return DNDarray(
-                res, tuple(res.shape), types.canonical_heat_type(res.dtype),
-                self.__split, self.__device, self.__comm, True,
-            )
-        if isinstance(other, DCSR_matrix):
-            res = (self.__array @ other.larray).sum_duplicates()
-            return DCSR_matrix(res, int(res.nse), (self.__gshape[0], other.gshape[1]),
-                               self.__dtype, self.__split, self.__device, self.__comm, True)
-        raise TypeError(f"unsupported matmul operand {type(other)}")
+    def __matmul__(self, other):
+        from .linalg import matmul
+
+        return matmul(self, other)
 
     def __repr__(self) -> str:
         return (

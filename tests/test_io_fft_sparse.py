@@ -182,6 +182,87 @@ class TestSparse(TestCase):
             (s @ v).numpy(), self.scipy_mat.toarray() @ v.numpy(), atol=1e-4
         )
 
+    def test_matmul_distributed_dense(self):
+        """DCSR(split=0) @ dense → split=0 dense, physically row-parallel
+        (each shard computes from its own nonzeros only), scipy oracle."""
+        import scipy.sparse as sp
+
+        A = sp.random(37, 23, density=0.15, format="csr", random_state=1, dtype=np.float32)
+        B = np.random.default_rng(0).standard_normal((23, 5)).astype(np.float32)
+        s = ht.sparse.sparse_csr_matrix(A, split=0)
+        r = ht.sparse.matmul(s, ht.array(B))
+        assert r.split == 0
+        self.assert_array_equal(r, A @ B, rtol=1e-4, atol=1e-4)
+        # the per-shard nnz buffers are mesh-sharded, not replicated
+        data, rows, cols, m, rps = s._row_sharded_parts()
+        comm = s.comm
+        if comm.is_distributed():
+            assert len(data.sharding.device_set) >= comm.size
+            for shard in data.addressable_shards:
+                assert shard.data.shape[1] == m and shard.data.shape[0] * comm.size == data.shape[0]
+
+    def test_matmul_vector_and_split_dense(self):
+        import scipy.sparse as sp
+
+        A = sp.random(37, 23, density=0.15, format="csr", random_state=1, dtype=np.float32)
+        s = ht.sparse.sparse_csr_matrix(A, split=0)
+        v = np.random.default_rng(1).standard_normal(23).astype(np.float32)
+        rv = s @ ht.array(v)
+        assert rv.shape == (37,) and rv.split == 0
+        self.assert_array_equal(rv, A @ v, rtol=1e-4, atol=1e-4)
+        # split dense RHS is resplit to None first (needs full columns)
+        B = np.random.default_rng(2).standard_normal((23, 4)).astype(np.float32)
+        r = s @ ht.array(B, split=0)
+        self.assert_array_equal(r, A @ B, rtol=1e-4, atol=1e-4)
+
+    def test_matmul_nonfinite_dense_matches_scipy(self):
+        """Regression: nnz-pad entries use out-of-range indices (dropped by
+        BCOO), not explicit zeros at (0,0) — explicit zeros would turn an
+        inf/NaN in dense row 0 into NaN on every under-full shard's first
+        row (0·inf = NaN)."""
+        import scipy.sparse as sp
+
+        A = sp.random(37, 23, density=0.15, format="csr", random_state=1, dtype=np.float32)
+        B = np.random.default_rng(0).standard_normal((23, 5)).astype(np.float32)
+        B[0, 0] = np.inf
+        B[1, 2] = np.nan
+        s = ht.sparse.sparse_csr_matrix(A, split=0)
+        ours = (s @ ht.array(B)).numpy()
+        want = A @ B
+        mask = np.isfinite(want)
+        np.testing.assert_allclose(ours[mask], want[mask], rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.isfinite(ours), mask)
+
+    def test_matmul_sparse_sparse(self):
+        """DCSR @ DCSR: pure sparse BCOO product (no dense intermediate),
+        result keeps the left operand's row split."""
+        import scipy.sparse as sp
+
+        A = sp.random(24, 16, density=0.2, format="csr", random_state=3, dtype=np.float32)
+        C = sp.random(16, 9, density=0.2, format="csr", random_state=4, dtype=np.float32)
+        s1 = ht.sparse.sparse_csr_matrix(A, split=0)
+        s2 = ht.sparse.sparse_csr_matrix(C)
+        rs = s1 @ s2
+        assert isinstance(rs, ht.sparse.DCSR_matrix)
+        assert rs.split == 0 and rs.shape == (24, 9)
+        np.testing.assert_allclose(rs.todense().numpy(), (A @ C).toarray(), rtol=1e-4, atol=1e-4)
+
+    def test_matmul_edge_shapes_and_errors(self):
+        import pytest as _pytest
+        import scipy.sparse as sp
+
+        # fewer rows than devices: pad shards carry zero nnz
+        A3 = sp.random(3, 23, density=0.3, format="csr", random_state=5, dtype=np.float32)
+        B = np.random.default_rng(3).standard_normal((23, 2)).astype(np.float32)
+        s3 = ht.sparse.sparse_csr_matrix(A3, split=0)
+        r3 = s3 @ ht.array(B)
+        self.assert_array_equal(r3, A3 @ B, rtol=1e-4, atol=1e-4)
+        s = ht.sparse.sparse_csr_matrix(A3, split=0)
+        with _pytest.raises(ValueError):
+            ht.sparse.matmul(s, ht.array(B[:5]))  # shape mismatch
+        with _pytest.raises(TypeError):
+            ht.sparse.matmul(s, B)  # raw numpy is not a DNDarray
+
     def test_sub_neg_scalar_ops(self):
         d = self.scipy_mat.toarray()
         s1 = ht.sparse.sparse_csr_matrix(self.scipy_mat)
