@@ -71,6 +71,23 @@ def main() -> None:
     np.testing.assert_allclose(np.asarray(small), want, rtol=2e-3, atol=2e-4)
     print("matches dense reference on the 64-token prefix ✓")
 
+    # ragged context: a prime sequence length still rides the ring — the
+    # sequence axis is padded to ceil(S/p)·p and pad keys are masked, so no
+    # length ever falls back to the O(S²) global path
+    import importlib
+
+    ra = importlib.import_module("heat_tpu.parallel.ring_attention")
+    Sr = 997  # prime
+    qr = jnp.asarray(rng.standard_normal((B, H, Sr, d)), jnp.float32)
+    before = dict(ra.path_counts)
+    out_r = ring_attention(qr, qr, qr, comm, causal=True)
+    assert out_r.shape == (B, H, Sr, d)
+    if comm.is_distributed():
+        assert ra.path_counts["ring"] == before["ring"] + 1
+        print(f"prime-length context S={Sr} stayed on the ring ✓")
+    else:
+        print(f"prime-length context S={Sr} ok (single device: no ring)")
+
 
 if __name__ == "__main__":
     main()
