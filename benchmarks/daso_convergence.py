@@ -1,0 +1,110 @@
+"""DASO-vs-DataParallel convergence curves (round-4 verdict item 6).
+
+Trains the same MLP on the same synthetic MNIST stream three ways —
+
+- ``dp``:            fully synchronous DataParallel (every-step global mean);
+- ``daso_static``:   DASO with a fixed ``global_skip`` (round-3 behavior);
+- ``daso_adaptive``: DASO with the reference's adaptive schedule
+  (``epoch_loss_logic``: skip halves on plateau, final cooldown epoch
+  fully synchronous) —
+
+and prints one JSON line per (variant, epoch) with the epoch-mean loss, so
+the staleness/skip trade-off is visible the way the reference's DASO paper
+plots it (accuracy parity at reduced global sync frequency).
+
+Run (virtual 8-device CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/daso_convergence.py [epochs]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_model(ht):
+    return ht.nn.Sequential(
+        ht.nn.Flatten(), ht.nn.Linear(784, 64), ht.nn.ReLU(), ht.nn.Linear(64, 10)
+    )
+
+
+def run_dp(ht, ds, epochs: int, batch: int):
+    import jax
+
+    model = make_model(ht)
+    opt = ht.optim.DataParallelOptimizer("adam", lr=2e-3)
+    dp = ht.nn.DataParallel(model, optimizer=opt)
+    params = dp.init(jax.random.key(0))
+    state = opt.init_state(params)
+    step = dp.make_train_step(ht.nn.functional.cross_entropy)
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        losses = []
+        for lo in range(0, len(ds), batch):
+            xb, yb = ds[lo : lo + batch]
+            params, state, l = step(params, state, xb._jarray, yb._jarray)
+            losses.append(float(l))
+        yield epoch, float(np.mean(losses)), time.perf_counter() - t0, None
+
+
+def run_daso(ht, ds, epochs: int, batch: int, adaptive: bool):
+    daso = ht.optim.DASO(
+        ht.optim.DataParallelOptimizer("adam", lr=2e-3),
+        global_skip=8,
+        stale_steps=2,
+        warmup_steps=4,
+        cooldown_epochs=1 if adaptive else 0,
+        total_epochs=epochs if adaptive else None,
+    )
+    daso.init(make_model(ht))
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        losses = []
+        for lo in range(0, len(ds), batch):
+            xb, yb = ds[lo : lo + batch]
+            losses.append(daso.step(ht.nn.functional.cross_entropy, xb, yb))
+        mean = float(np.mean(losses))
+        skip = daso.epoch_loss_logic(mean) if adaptive else daso.global_skip
+        yield epoch, mean, time.perf_counter() - t0, skip
+
+
+def main() -> None:
+    import heat_tpu as ht
+
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    batch = 512
+    ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=4096)
+    variants = {
+        "dp": lambda: run_dp(ht, ds, epochs, batch),
+        "daso_static": lambda: run_daso(ht, ds, epochs, batch, adaptive=False),
+        "daso_adaptive": lambda: run_daso(ht, ds, epochs, batch, adaptive=True),
+    }
+    for name, gen in variants.items():
+        for epoch, loss, secs, skip in gen():
+            print(
+                json.dumps(
+                    {
+                        "variant": name,
+                        "epoch": epoch,
+                        "loss": round(loss, 5),
+                        "seconds": round(secs, 3),
+                        "global_skip": skip,
+                    }
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -260,7 +260,12 @@ def einsum_path(subscripts: str, *operands, optimize="greedy"):
     intermediates by hand.
     """
     hosts = [
-        np.broadcast_to(np.empty((), np.float32), o.shape) if isinstance(o, DNDarray) else np.asarray(o)
+        # zero-copy shape carriers for anything shaped (DNDarray, jax array,
+        # ndarray) — np.asarray would device-to-host a large operand just to
+        # read its shape; asarray only for shapeless Python sequences
+        np.broadcast_to(np.empty((), np.float32), o.shape)
+        if hasattr(o, "shape")
+        else np.asarray(o)
         for o in operands
     ]
     return np.einsum_path(subscripts, *hosts, optimize=optimize)

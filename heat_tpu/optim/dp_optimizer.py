@@ -135,7 +135,9 @@ class DASO:
     ``global_skip`` (steps between inter-group syncs), ``stale_steps``
     (dispatch-to-consume delay of the global average), ``staleness_weight``
     (blend factor for the stale global params), ``warmup_steps`` (full sync
-    every step at the start), ``cooldown_epochs`` accepted for parity.
+    every step at the start), ``cooldown_epochs`` + ``total_epochs`` (fully
+    synchronous final phase), ``plateau_tol`` (relative improvement below
+    which :meth:`epoch_loss_logic` halves ``global_skip``).
     """
 
     def __init__(
@@ -147,6 +149,8 @@ class DASO:
         staleness_weight: float = 0.5,
         warmup_steps: int = 4,
         cooldown_epochs: int = 0,
+        total_epochs: Optional[int] = None,
+        plateau_tol: float = 0.05,
         mesh=None,
     ):
         if isinstance(local_optimizer, DataParallelOptimizer):
@@ -157,7 +161,18 @@ class DASO:
         self.stale_steps = max(int(stale_steps), 0)
         self.staleness_weight = float(staleness_weight)
         self.warmup_steps = int(warmup_steps)
-        self.cooldown_epochs = cooldown_epochs
+        self.cooldown_epochs = int(cooldown_epochs)
+        self.total_epochs = total_epochs
+        self.plateau_tol = float(plateau_tol)
+        if self.cooldown_epochs > 0 and total_epochs is None:
+            raise ValueError(
+                "cooldown_epochs requires total_epochs so DASO knows when the "
+                "final synchronous phase begins (reference: DASO's cooldown "
+                "switches to full sync for the LAST cooldown_epochs epochs)"
+            )
+        self._epoch = 0
+        self._best_epoch_loss = None
+        self.in_cooldown = False
 
         if mesh is None:
             all_devs = jax.devices()
@@ -348,6 +363,52 @@ class DASO:
                 else:
                     self._pending = (avg, t + self.stale_steps)
         return float(jnp.mean(losses))
+
+    def epoch_loss_logic(self, epoch_loss) -> int:
+        """Adaptive skip schedule — call once per epoch with the epoch's mean
+        loss (reference: ``heat/optim/dp_optimizer.py`` ``DASO.epoch_loss_logic``,
+        SURVEY §2.5 "auto-tuned skips shrinking as loss plateaus").
+
+        Two mechanisms, applied in priority order:
+
+        - **cooldown**: the call ends epoch ``e``; when every remaining
+          epoch lies in the final ``cooldown_epochs`` of ``total_epochs``,
+          switch to fully synchronous training (``global_skip=1``, no
+          staleness, full-weight blend) so the final model is exactly
+          averaged — the reference's cooldown phase.
+        - **plateau**: if the epoch loss failed to improve on the best loss
+          so far by more than ``plateau_tol`` (relative), halve
+          ``global_skip`` (floor 1): stale wide-interval averaging is cheap
+          while loss falls fast, but once progress stalls the groups must
+          sync tighter to keep converging.
+
+        Returns the ``global_skip`` now in force.
+        """
+        self._epoch += 1
+        epoch_loss = float(epoch_loss)
+        if (
+            self.total_epochs is not None
+            and self.cooldown_epochs > 0
+            and self._epoch >= self.total_epochs - self.cooldown_epochs
+        ):
+            self.in_cooldown = True
+            self.global_skip = 1
+            self.stale_steps = 0
+            self.staleness_weight = 1.0
+            # drop any in-flight pre-cooldown average: consuming it at the
+            # cooldown's full blend weight would overwrite every replica
+            # with stale parameters and discard the updates since dispatch
+            self._pending = None
+        elif self._best_epoch_loss is not None:
+            ref = abs(self._best_epoch_loss)
+            improved = (self._best_epoch_loss - epoch_loss) > self.plateau_tol * (
+                ref if ref > 0 else 1.0
+            )
+            if not improved and self.global_skip > 1:
+                self.global_skip = max(self.global_skip // 2, 1)
+        if self._best_epoch_loss is None or epoch_loss < self._best_epoch_loss:
+            self._best_epoch_loss = epoch_loss
+        return self.global_skip
 
     def consolidated_params(self):
         """The cross-group averaged parameters (for eval/checkpoint)."""

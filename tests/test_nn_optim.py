@@ -208,6 +208,99 @@ class TestDASO:
         cp = daso.consolidated_params()
         assert cp[1]["weight"].shape == (32, 784)
 
+    def test_adaptive_skip_halves_on_plateau(self):
+        """Verdict r3 #6: the reference auto-tunes global_skip as loss
+        plateaus.  Synthetic plateau → skip halves each epoch down to 1;
+        improving loss leaves it untouched."""
+        daso = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer("sgd", lr=0.1), global_skip=8
+        )
+        assert daso.epoch_loss_logic(1.0) == 8  # first epoch: baseline only
+        assert daso.epoch_loss_logic(0.5) == 8  # improving: keep the skip
+        assert daso.epoch_loss_logic(0.495) == 4  # <5% relative: plateau
+        assert daso.epoch_loss_logic(0.494) == 2
+        assert daso.epoch_loss_logic(0.60) == 1  # regression is a plateau too
+        assert daso.epoch_loss_logic(0.60) == 1  # floor
+        # a genuine new-best improvement stops the shrinking
+        daso2 = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer("sgd", lr=0.1), global_skip=8
+        )
+        daso2.epoch_loss_logic(1.0)
+        daso2.epoch_loss_logic(0.99)  # plateau → 4
+        assert daso2.global_skip == 4
+        assert daso2.epoch_loss_logic(0.5) == 4  # big improvement: hold
+
+    def test_cooldown_epochs_full_sync(self):
+        """cooldown_epochs is honored: the last cooldown_epochs of
+        total_epochs run fully synchronous (skip 1, no staleness)."""
+        daso = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer("sgd", lr=0.1),
+            global_skip=8, stale_steps=2, staleness_weight=0.5,
+            cooldown_epochs=2, total_epochs=5,
+        )
+        for loss in (1.0, 0.8):  # after epochs 1-2: epochs 3+ still free-run
+            daso.epoch_loss_logic(loss)
+        assert not daso.in_cooldown and daso.global_skip == 8
+        daso.epoch_loss_logic(0.6)  # ends epoch 3: epochs 4-5 are the cooldown
+        assert daso.in_cooldown
+        assert daso.global_skip == 1 and daso.stale_steps == 0
+        assert daso.staleness_weight == 1.0
+        daso.epoch_loss_logic(0.4)  # stays in cooldown
+        assert daso.in_cooldown and daso.global_skip == 1
+        # cooldown without total_epochs is rejected up front
+        with pytest.raises(ValueError):
+            ht.optim.DASO(
+                ht.optim.DataParallelOptimizer("sgd", lr=0.1), cooldown_epochs=1
+            )
+
+    def test_cooldown_drops_inflight_average(self):
+        """Regression: a pre-cooldown stale average left pending would be
+        consumed at the cooldown's blend weight 1.0, overwriting every
+        replica with stale params — entering cooldown must drop it."""
+        daso = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer("sgd", lr=0.1),
+            global_skip=8, stale_steps=2, cooldown_epochs=1, total_epochs=2,
+        )
+        daso._pending = (object(), 999)  # stand-in for a dispatched average
+        daso.epoch_loss_logic(1.0)  # ends epoch 1 → cooldown (total 2, cd 1)
+        assert daso.in_cooldown
+        assert daso._pending is None
+
+    def test_adaptive_training_converges(self):
+        """End-to-end: adaptive schedule drives a real training run; after a
+        plateau the tighter sync pulls the group replicas together."""
+        import jax
+        import jax.numpy as jnp
+
+        if len(jax.devices()) % 2:
+            pytest.skip("DASO test needs an even device count")
+        ds = ht.utils.data.MNISTDataset(root="/nonexistent", synthetic_n=512)
+        model = ht.nn.Sequential(
+            ht.nn.Flatten(), ht.nn.Linear(784, 16), ht.nn.ReLU(), ht.nn.Linear(16, 10)
+        )
+        daso = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer("adam", lr=2e-3),
+            total_local_comm_size=2, global_skip=4, stale_steps=1, warmup_steps=2,
+            cooldown_epochs=1, total_epochs=3,
+        )
+        daso.init(model)
+        first = last = None
+        for epoch in range(3):
+            ep = [
+                daso.step(ht.nn.functional.cross_entropy, ds.images[:256], ds.targets[:256])
+                for _ in range(6)
+            ]
+            if first is None:
+                first = ep[0]
+            last = ep[-1]
+            daso.epoch_loss_logic(float(np.mean(ep)))
+        assert daso.in_cooldown
+        assert last < first
+        # cooldown full-sync keeps replicas bit-close together
+        w = daso.parameters[1]["weight"]
+        div = float(jnp.max(jnp.abs(w - jnp.mean(w, axis=0, keepdims=True))))
+        assert div < 1e-5
+
     def test_invalid_group_size(self):
         import jax
 
