@@ -22,6 +22,14 @@ from .dndarray import DNDarray
 from .sanitation import sanitize_in
 from .stride_tricks import sanitize_axis, sanitize_shape
 
+# dtypes whose order round-trips the 32-bit sample-sort key encoding
+# (mirrors ``parallel.sample_sort._coders``; the runtime has no 64-bit
+# arrays — jax_enable_x64 is off).  Shared by sort/topk/unique eligibility.
+_SAMPLE_SORT_DTYPES = (
+    jnp.float32, jnp.int32, jnp.int16, jnp.int8,
+    jnp.uint32, jnp.uint16, jnp.uint8,
+)
+
 __all__ = [
     "array_split",
     "atleast_1d",
@@ -480,9 +488,15 @@ def rot90(x: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
 
 
 def shuffle(x: DNDarray) -> DNDarray:
-    """Random permutation along axis 0 (reference: cross-rank Alltoall)."""
+    """Random permutation along axis 0 (reference: cross-rank Alltoall).
+
+    The global fancy gather below crosses every shard pair; warned as an
+    implicit-gather trap when axis 0 is the split axis.
+    """
     from . import random as ht_random
 
+    if x.split == 0:
+        _warn_implicit_gather("shuffle", x)
     perm = ht_random.permutation(x.shape[0])
     res = x._jarray[perm._jarray]
     return _wrap(res, x.split, x)
@@ -498,8 +512,9 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
     - ``'sample'`` — the reference's distributed sample-sort, redesigned for
       static shapes (``parallel.sample_sort``): static shuffle + exact
       bisected splitters + one padded ``all_to_all``; per-shard memory stays
-      O(n/p).  1-D split float32/int-family ascending sorts only; overflow
-      of the static exchange width falls back to ``'global'``.
+      O(n/p).  1-D split float32/int/uint sorts, ascending or descending
+      (complemented keys); overflow of the static exchange width falls back
+      to ``'global'``.
     - ``'auto'`` — ``'sample'`` when eligible and the array is large enough
       that the gather would dominate (≥ 1e6 elements), else ``'global'``.
     """
@@ -510,16 +525,16 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
         x.ndim == 1
         and axis == 0
         and x.split == 0
-        and not descending
         and x.comm.is_distributed()
-        # only dtypes whose order round-trips through the 32-bit key encoding,
-        # and sizes whose rank counts fit int32
-        and j.dtype in (jnp.float32, jnp.int32, jnp.int16, jnp.int8)
+        # only dtypes whose order round-trips through the 32-bit key encoding
+        # (the runtime has no 64-bit arrays — jax_enable_x64 is off, so this
+        # is the whole dtype space), and sizes whose rank counts fit int32
+        and j.dtype in _SAMPLE_SORT_DTYPES
         and x.shape[0] < 2**31
     )
     if method == "sample" and not eligible:
         raise ValueError(
-            "method='sample' needs a 1-D float32/int split-0 ascending sort on "
+            "method='sample' needs a 1-D float32/int/uint split-0 sort on "
             "a distributed comm"
         )
     if method not in ("auto", "global", "sample"):
@@ -529,7 +544,7 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
     if use_sample:
         from ..parallel.sample_sort import sample_sort_1d
 
-        svals, sidx, overflow = sample_sort_1d(x.comm, x._parray, x.shape[0])
+        svals, sidx, overflow = sample_sort_1d(x.comm, x._parray, x.shape[0], descending)
         if not bool(overflow):  # eager: pathological collision → global path
             if jnp.issubdtype(j.dtype, jnp.integer):
                 svals = svals.astype(j.dtype)
@@ -544,7 +559,22 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
             return v, i
 
     if descending:
-        idx = jnp.argsort(-j if jnp.issubdtype(j.dtype, jnp.number) else ~j, axis=axis, stable=True)
+        if jnp.issubdtype(j.dtype, jnp.floating):
+            # torch semantics (and the sample path's): NaNs FIRST in
+            # descending — lexsort on (nan-flag, negated value); plain
+            # argsort(-j) would leave NaNs last
+            nanmask = jnp.isnan(j)
+            primary = jnp.where(nanmask, 0, 1)
+            secondary = jnp.where(nanmask, jnp.zeros_like(j), -j)
+            idx = jnp.lexsort((secondary, primary), axis=axis)
+        elif jnp.issubdtype(j.dtype, jnp.integer):
+            # bitwise NOT, not negation: -x wraps at INT_MIN and on every
+            # unsigned value (0 would negate to 0 and sort first)
+            idx = jnp.argsort(_order_flip(j), axis=axis, stable=True)
+        elif jnp.issubdtype(j.dtype, jnp.complexfloating):
+            idx = jnp.argsort(-j, axis=axis, stable=True)
+        else:  # bool
+            idx = jnp.argsort(~j, axis=axis, stable=True)
     else:
         idx = jnp.argsort(j, axis=axis, stable=True)
     vals = jnp.take_along_axis(j, idx, axis=axis)
@@ -646,10 +676,9 @@ def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     """
     dim = sanitize_axis(x.shape, dim)
     j = x._jarray
+    dist_1d = x.ndim == 1 and x.split == 0 and x.comm.is_distributed()
     if (
-        x.ndim == 1
-        and x.split == 0
-        and x.comm.is_distributed()
+        dist_1d
         and k <= x.shape[0] // x.comm.size  # every shard can supply k candidates
         and x._pad == 0  # pad rows would need masking inside the local top-k
     ):
@@ -660,6 +689,23 @@ def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
             out[0]._jarray, out[1]._jarray = v._jarray, i._jarray
             return out
         return v, i
+    if (
+        dist_1d
+        and k <= x.shape[0]
+        and x.shape[0] < 2**31
+        and j.dtype in _SAMPLE_SORT_DTYPES
+    ):
+        # large-k / ragged route (round-4): distributed sample sort in the
+        # requested direction, then an O(k) slice — the k results stay
+        # split-0; per-shard memory remains O(n/p), never O(n)
+        sv, si = sort(x, descending=largest, method="sample")
+        v, i = sv[:k], si[:k]
+        if out is not None:
+            out[0]._jarray, out[1]._jarray = v._jarray, i._jarray
+            return out
+        return v, i
+    if x.split is not None and dim == x.split:
+        _warn_implicit_gather("topk", x)
     if dim != x.ndim - 1:
         jm = jnp.moveaxis(j, dim, -1)
     else:
@@ -681,12 +727,83 @@ def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     return v, i
 
 
-def unique(x: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
-    """Unique elements (the reference's distributed unique ⇒ global XLA unique).
+# element-count threshold above which eligible 1-D split uniques run the
+# distributed path; module-level so tests can lower it
+_DIST_UNIQUE_THRESHOLD = 1_000_000
 
-    Eager-only (result shape is data-dependent), like the reference.
+
+def _warn_implicit_gather(op: str, x: DNDarray) -> None:
+    """Perf-trap warning (reference: ``warnings.warn`` on implicit-comm
+    traps, SURVEY §5.5): this operation's fallback gathers the split axis —
+    every device materializes the full array."""
+    import warnings
+
+    if x.split is not None and x.comm.is_distributed():
+        warnings.warn(
+            f"{op} on a split array falls back to a global formulation that "
+            f"gathers the split axis ({x.shape[x.split]} elements onto every "
+            "device); this is a communication- and memory-heavy operation.",
+            stacklevel=3,
+        )
+
+
+def unique(x: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
+    """Unique elements (reference: distributed unique over the split axis).
+
+    Eager-only (result shape is data-dependent), like the reference.  Large
+    1-D split arrays of sortable dtype run fully distributed: a sample sort
+    (O(n/p) per-shard memory), a neighbor-exchange first-occurrence mask,
+    and per-shard extraction of the O(u) unique values — the input is never
+    gathered.  ``return_inverse`` positions each element by binary search in
+    the (replicated, size-u) unique vector.  Other shapes use the global XLA
+    path, with an implicit-gather warning when that drops a distribution.
     """
-    res = jnp.unique(x._jarray, return_inverse=return_inverse, axis=axis)
+    j = x._jarray
+    dist_ok = (
+        axis is None
+        and x.ndim == 1
+        and x.split == 0
+        and x.comm.is_distributed()
+        and j.dtype in _SAMPLE_SORT_DTYPES
+        and _DIST_UNIQUE_THRESHOLD <= x.shape[0] < 2**31
+        # addressable_shards-based extraction sees only THIS process's
+        # devices: single-controller only (multi-process runs the global
+        # path until a device-side assembly exists)
+        and jax.process_count() == 1
+    )
+    if dist_ok:
+        from ..parallel.sample_sort import first_occurrence_mask, sample_sort_1d
+
+        svals, _, overflow = sample_sort_1d(x.comm, x._parray, x.shape[0])
+        if not bool(overflow):
+            mask = first_occurrence_mask(x.comm, svals, x.shape[0])
+            # extract each shard's (few) unique values host-side: O(u) total,
+            # the only data leaving the devices
+            parts = []
+            shards = list(zip(mask.addressable_shards, svals.addressable_shards))
+            shards.sort(key=lambda ms: ms[0].index[0].start or 0)
+            for mshard, vshard in shards:
+                lm = np.asarray(mshard.data)
+                if lm.any():
+                    parts.append(np.asarray(vshard.data)[lm])
+            uvals = np.concatenate(parts) if parts else np.empty(0, j.dtype)
+            v = factories.array(uvals, dtype=x.dtype, split=0, device=x.device, comm=x.comm)
+            if not return_inverse:
+                return v
+            # inverse: binary search of every element in the sorted unique
+            # vector (replicated — O(u) per device, u ≤ n and typically ≪ n)
+            uj = v._jarray
+            if jnp.issubdtype(j.dtype, jnp.floating):
+                # NaN representative: searchsorted can't match NaN — map NaNs
+                # to the last slot (the collapsed NaN, if any)
+                inv = jnp.searchsorted(uj, j)
+                inv = jnp.where(jnp.isnan(j), uj.shape[0] - 1, inv)
+            else:
+                inv = jnp.searchsorted(uj, j)
+            iv = _wrap(inv.astype(jnp.int32), x.split, x)
+            return v, iv
+    _warn_implicit_gather("unique", x)
+    res = jnp.unique(j, return_inverse=return_inverse, axis=axis)
     if return_inverse:
         vals, inv = res
         v = _wrap(vals, 0 if x.split is not None else None, x)
@@ -760,6 +877,10 @@ def take(a: DNDarray, indices, axis: Optional[int] = None) -> DNDarray:
     ``indices.ndim - 1``.
     """
     ji = indices._jarray if isinstance(indices, DNDarray) else jnp.asarray(np.asarray(indices))
+    if a.split is not None and (axis is None or sanitize_axis(a.shape, axis) == a.split):
+        # fancy indices may address any shard: XLA lowers this to a
+        # cross-shard gather of the split axis
+        _warn_implicit_gather("take", a)
     res = jnp.take(a._jarray, ji, axis=axis)
     if axis is None:
         split = 0 if a.split is not None and res.ndim else None

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["order_statistics_1d", "sample_sort_1d"]
+__all__ = ["first_occurrence_mask", "order_statistics_1d", "sample_sort_1d"]
 
 _PAD = jnp.uint32(0xFFFFFFFF)  # sorts after every real key
 _NAN = jnp.uint32(0xFFFFFFFE)  # NaNs sort last among real values (numpy)
@@ -74,6 +74,37 @@ def _encode_i32(x):
 
 def _decode_i32(enc):
     return lax.bitcast_convert_type(enc ^ jnp.uint32(0x80000000), jnp.int32)
+
+
+def _encode_u32(x):
+    """Unsigned keys ARE their own order-preserving encoding.  A legitimate
+    UINT32_MAX collides bitwise with ``_PAD``, which is safe everywhere in
+    this module: pads are detected by the id sentinel, never the key."""
+    return x.astype(jnp.uint32)
+
+
+def _decode_u32(enc):
+    return enc
+
+
+def _coders(dtype, descending: bool):
+    """(encode, decode, out_dtype) for a key dtype and direction.
+
+    Descending reuses the ascending machinery on complemented keys: bitwise
+    NOT is strictly order-reversing on uint32, pads stay ``_PAD`` (the valid
+    mask applies after encoding), and NaNs — ``~_NAN`` = 1, nearly smallest —
+    sort FIRST, matching torch's descending semantics (descending is the
+    exact reverse of ascending-with-NaN-last).
+    """
+    if jnp.issubdtype(dtype, jnp.floating):
+        enc, dec, out = _encode_f32, _decode_f32, jnp.float32
+    elif jnp.issubdtype(dtype, jnp.unsignedinteger):
+        enc, dec, out = _encode_u32, _decode_u32, jnp.uint32
+    else:
+        enc, dec, out = _encode_i32, _decode_i32, jnp.int32
+    if descending:
+        return (lambda x: ~enc(x)), (lambda k: dec(~k)), out
+    return enc, dec, out
 
 
 def _radix_select(vals, targets, axis, base_mask=None):
@@ -119,7 +150,9 @@ def _radix_select(vals, targets, axis, base_mask=None):
     return prefix, remaining
 
 
-def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+def sample_sort_1d(
+    comm, phys: jax.Array, n: int, descending: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Sort a 1-D padded physical array sharded over ``comm``.
 
     ``phys``: shape (p·c,), canonical ceil-div layout, entries at global
@@ -128,23 +161,29 @@ def sample_sort_1d(comm, phys: jax.Array, n: int) -> Tuple[jax.Array, jax.Array,
     layout, plus a bool scalar — True means a bucket overflowed the static
     exchange width and the caller must use the global-sort fallback.
 
+    ``descending`` runs the identical pipeline on complemented keys (see
+    ``_coders``) — same collectives, same memory, ties stay stable.
+
+    64-bit keys: none exist in this runtime — the framework runs with JAX's
+    default 32-bit mode (``jax_enable_x64`` off), so ``int64``/``float64``
+    inputs are canonicalized to 32-bit at ingest and the 32-bit key encoding
+    covers the entire representable dtype space.  (A two-word radix pass
+    would double the collective rounds for key widths that cannot occur.)
+
     The whole pipeline is ONE cached jitted XLA program per
-    (comm, shape, dtype, n) — an eager shard_map would dispatch per-op
-    (measured ~500× slower on the CPU mesh).
+    (comm, shape, dtype, n, direction) — an eager shard_map would dispatch
+    per-op (measured ~500× slower on the CPU mesh).
     """
-    return _sort_program(comm, phys.shape[0], jnp.dtype(phys.dtype).name, n)(phys)
+    return _sort_program(
+        comm, phys.shape[0], jnp.dtype(phys.dtype).name, n, bool(descending)
+    )(phys)
 
 
 @comm_cached
-def _sort_program(comm, P: int, dtype_name: str, n: int):
+def _sort_program(comm, P: int, dtype_name: str, n: int, descending: bool):
     p = comm.size
     c = P // p
-    if jnp.issubdtype(jnp.dtype(dtype_name), jnp.floating):
-        enc_in, dec = _encode_f32, _decode_f32
-        out_dt = jnp.float32
-    else:
-        enc_in, dec = _encode_i32, _decode_i32
-        out_dt = jnp.int32
+    enc_in, dec, out_dt = _coders(jnp.dtype(dtype_name), descending)
     # shuffle granularity: c padded up to a multiple of p
     cs = -(-c // p) * p
     g = cs // p
@@ -275,4 +314,40 @@ def _order_stats_program(comm, P: int, n: int, ranks: tuple):
     from jax.sharding import PartitionSpec as Pspec
 
     mapped = comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=Pspec())
+    return jax.jit(mapped)
+
+
+def first_occurrence_mask(comm, phys: jax.Array, n: int) -> jax.Array:
+    """Boolean mask of first occurrences in a SORTED 1-D padded physical
+    array (the dedup kernel of distributed ``unique``).
+
+    Each shard compares its block against itself shifted by one, with the
+    previous shard's last element delivered by a single neighbor
+    ``ppermute`` — O(1) collective payload, no gather.  Pad entries (global
+    index ≥ n) are never first occurrences; NaNs compare equal to NaNs so a
+    sorted NaN tail collapses to one representative (numpy.unique).
+    """
+    return _first_mask_program(comm, phys.shape[0], jnp.dtype(phys.dtype).name, n)(phys)
+
+
+@comm_cached
+def _first_mask_program(comm, P: int, dtype_name: str, n: int):
+    p = comm.size
+    c = P // p
+    axis = comm.axis
+
+    def shard_fn(blk):
+        my = lax.axis_index(axis)
+        gidx = my * c + jnp.arange(c)
+        valid = gidx < n
+        # previous shard's last element, ring-shifted forward one step
+        prev_last = lax.ppermute(blk[-1:], axis, [(j, (j + 1) % p) for j in range(p)])
+        prev = jnp.concatenate([prev_last, blk[:-1]])
+        same = prev == blk
+        if jnp.issubdtype(blk.dtype, jnp.floating):
+            same = same | (jnp.isnan(prev) & jnp.isnan(blk))
+        first = valid & ((gidx == 0) | ~same)
+        return first
+
+    mapped = comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=(1, 0))
     return jax.jit(mapped)
