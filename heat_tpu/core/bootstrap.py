@@ -31,11 +31,15 @@ def init_distributed(
     import jax
 
     if coordinator_address is not None or num_processes not in (None, 1):
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        # idempotent: callers that had to initialize before importing the
+        # package (jax.distributed must run before ANY backend touch, and
+        # importing heat_tpu resolves the default device) are fine
+        if not jax.distributed.is_initialized():
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
     from . import devices
     from .devices import make_mesh, use_mesh
 

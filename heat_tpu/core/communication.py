@@ -186,6 +186,21 @@ class Communication:
         """The ``NamedSharding`` realizing ``split`` over this communicator."""
         return NamedSharding(self.__mesh, self.spec(ndim, split))
 
+    @staticmethod
+    def host_fetch(array) -> "np.ndarray":
+        """Fetch a (possibly multi-process) jax array to host memory.
+
+        Single-controller arrays are fully addressable and ``device_get``
+        suffices; under multi-process JAX a sharded array's remote shards
+        are NOT addressable, so the fetch is an SPMD ``process_allgather``
+        (every process must call this together — the same contract the
+        reference's gather-to-all has)."""
+        if getattr(array, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(array))
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(array, tiled=True))
+
     def shard(self, array: jax.Array, split: Optional[int]) -> jax.Array:
         """Place/constrain ``array`` to the sharding of ``split``.
 

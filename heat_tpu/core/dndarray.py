@@ -377,7 +377,7 @@ class DNDarray:
         """Gather the global (logical) array to host memory as a numpy array."""
         src = self.__array
         try:
-            out = np.asarray(jax.device_get(src))
+            out = self.__comm.host_fetch(src)
         except jax.errors.JaxRuntimeError:
             if jnp.issubdtype(src.dtype, jnp.complexfloating):
                 # some TPU transports cannot ship complex buffers to host;

@@ -49,6 +49,15 @@ def _note_chunk(nbytes: int) -> None:
     _CHUNK_WRITES["max_bytes"] = max(_CHUNK_WRITES["max_bytes"], int(nbytes))
 
 
+def _proc_info(data) -> tuple:
+    """(n_processes, process_index) — (1, 0) for plain arrays/single-controller."""
+    import jax
+
+    if isinstance(data, DNDarray):
+        return data.comm.n_processes, data.comm.rank
+    return jax.process_count(), jax.process_index()
+
+
 def _iter_hyperslabs(x: DNDarray):
     """Yield ``(global_slices, chunk_ndarray)`` one shard at a time.
 
@@ -174,13 +183,42 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
     else:
         data = np.asarray(data)
         shape, np_dtype = data.shape, data.dtype
-    with h5py.File(path, mode) as f:
-        if dataset in f:
-            del f[dataset]
-        kwargs.setdefault("dtype", np_dtype)  # callers may override (cast-on-write)
-        ds = f.create_dataset(dataset, shape=shape, **kwargs)
-        for slices, chunk in _iter_hyperslabs(data):
-            ds[slices] = chunk
+    kwargs.setdefault("dtype", np_dtype)  # callers may override (cast-on-write)
+    nproc, rank = _proc_info(data)
+    if nproc == 1:
+        with h5py.File(path, mode) as f:
+            if dataset in f:
+                del f[dataset]
+            ds = f.create_dataset(dataset, shape=shape, **kwargs)
+            for slices, chunk in _iter_hyperslabs(data):
+                ds[slices] = chunk
+        return
+    # multi-process: serial-HDF5 cannot take concurrent writers, so the
+    # processes write their own hyperslabs in rank order — the reference's
+    # token-ring fallback when parallel HDF5 is unavailable (SURVEY §5.4).
+    # Each process only ever touches its ADDRESSABLE shards, so the union
+    # of the passes is the full array and peak memory stays one shard.
+    from jax.experimental import multihost_utils
+
+    only_rank0 = not (isinstance(data, DNDarray) and data.split is not None)
+    if only_rank0:
+        # replicated array: EVERY process fetches (host_fetch is a collective
+        # when shards span processes — rank-0-only would deadlock the others
+        # at the barrier below), then only rank 0 writes
+        host = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
+        slabs = [(tuple(slice(0, s) for s in host.shape), host)]
+    for r in range(nproc):
+        if r == rank and (r == 0 or not only_rank0):
+            with h5py.File(path, mode if r == 0 else "a") as f:
+                if r == 0:
+                    if dataset in f:
+                        del f[dataset]
+                    ds = f.create_dataset(dataset, shape=shape, **kwargs)
+                else:
+                    ds = f[dataset]
+                for slices, chunk in (slabs if only_rank0 else _iter_hyperslabs(data)):
+                    ds[slices] = chunk
+        multihost_utils.sync_global_devices(f"save_hdf5:{dataset}:{r}")
 
 
 # ---------------------------------------------------------------------- #

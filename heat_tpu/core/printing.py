@@ -66,7 +66,9 @@ def _edge_fetch(x) -> np.ndarray:
         else:
             slices.append(slice(None))
     if all(sl == slice(None) for sl in slices):
-        return np.asarray(jax.device_get(jarr))
+        from .communication import Communication
+
+        return Communication.host_fetch(jarr)
     # fetch per-axis edges by advanced indexing with index vectors
     idxs = []
     for s in x.shape:
@@ -75,7 +77,9 @@ def _edge_fetch(x) -> np.ndarray:
         else:
             idxs.append(np.arange(s))
     mesh_idx = np.ix_(*idxs)
-    return np.asarray(jax.device_get(jarr[mesh_idx]))
+    from .communication import Communication
+
+    return Communication.host_fetch(jarr[mesh_idx])
 
 
 def __str__(x) -> str:
@@ -88,7 +92,9 @@ def __str__(x) -> str:
         linewidth=opt["linewidth"],
     ):
         if x.size <= threshold or not np.isfinite(threshold):
-            data = np.asarray(jax.device_get(x._jarray))
+            from .communication import Communication
+
+            data = Communication.host_fetch(x._jarray)
             return np.array2string(data, separator=", ")
         data = _edge_fetch(x)
         # force summarization formatting of the stitched edges
