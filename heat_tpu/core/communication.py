@@ -194,8 +194,12 @@ class Communication:
         suffices; under multi-process JAX a sharded array's remote shards
         are NOT addressable, so the fetch is an SPMD ``process_allgather``
         (every process must call this together — the same contract the
-        reference's gather-to-all has)."""
-        if getattr(array, "is_fully_addressable", True):
+        reference's gather-to-all has).  Fully-replicated arrays read their
+        local replica directly — no collective, so ``if rank == 0: print(x)``
+        on replicated data stays legal."""
+        if getattr(array, "is_fully_addressable", True) or getattr(
+            array, "is_fully_replicated", False
+        ):
             return np.asarray(jax.device_get(array))
         from jax.experimental import multihost_utils
 

@@ -58,6 +58,47 @@ def _proc_info(data) -> tuple:
     return jax.process_count(), jax.process_index()
 
 
+def _token_ring_write(data, tag: str, body) -> None:
+    """Rank-ordered single-writer-at-a-time file writes for multi-process
+    runs — the reference's token-ring fallback when parallel HDF5 is absent
+    (SURVEY §5.4), generalized to every serial-writer format.
+
+    ``body(first, slabs)`` writes this process's part: ``first`` marks the
+    writer that must create/truncate the file; ``slabs`` iterates
+    ``(global_slices, ndarray)``.  Split data: each process writes only its
+    addressable hyperslabs, in rank order (ranks own ascending row ranges,
+    so appends land in order).  Replicated data: written once by rank 0,
+    prefetched on EVERY rank first (the fetch may be a collective;
+    rank-0-only collectives would deadlock the barrier).  A failing writer
+    still attends every remaining barrier, then re-raises — otherwise the
+    surviving ranks hang at their next sync instead of surfacing the error.
+    """
+    nproc, rank = _proc_info(data)
+    only_rank0 = not (
+        isinstance(data, DNDarray) and data.split is not None and data.comm.is_distributed()
+    )
+    if nproc == 1:
+        body(True, _iter_hyperslabs(data))
+        return
+    slabs = None
+    if only_rank0:
+        arr = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
+        _note_chunk(arr.nbytes)
+        slabs = [(tuple(slice(0, s) for s in arr.shape), arr)]
+    from jax.experimental import multihost_utils
+
+    failure = None
+    for r in range(nproc):
+        if failure is None and r == rank and (r == 0 or not only_rank0):
+            try:
+                body(r == 0, slabs if only_rank0 else _iter_hyperslabs(data))
+            except Exception as e:  # noqa: BLE001 — re-raised after the ring
+                failure = e
+        multihost_utils.sync_global_devices(f"token_ring:{tag}:{r}")
+    if failure is not None:
+        raise failure
+
+
 def _iter_hyperslabs(x: DNDarray):
     """Yield ``(global_slices, chunk_ndarray)`` one shard at a time.
 
@@ -137,16 +178,36 @@ def _read_hyperslab(reader, gshape, dtype, split, device, comm) -> DNDarray:
     if split is None or comm.n_processes == 1:
         data = np.asarray(reader(tuple(slice(0, s) for s in gshape)))
         return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
-    nproc, rank = comm.n_processes, comm.rank
+    rank = comm.rank
     n = gshape[split]
-    c = -(-n // nproc)
-    lo, hi = min(rank * c, n), min(rank * c + c, n)
+    # the process's slab must match its devices' slices of the CANONICAL
+    # padded ceil-div grid (make_array_from_process_local_data maps local
+    # data onto the process's addressable slice extents — a ceil-over-
+    # n_processes slab desynchronizes from the per-DEVICE grid whenever the
+    # extent is ragged)
+    cd = comm.padded_extent(n) // comm.size  # rows per device (padded grid)
+    mesh_devs = list(comm.mesh.devices.ravel())
+    idxs = [i for i, d in enumerate(mesh_devs) if d.process_index == rank]
+    assert idxs == list(range(min(idxs), max(idxs) + 1)), (
+        "mesh places this process's devices non-contiguously along the axis"
+    )
+    lo_pad, hi_pad = min(idxs) * cd, (max(idxs) + 1) * cd
+    lo, hi = min(lo_pad, n), min(hi_pad, n)
     slices = tuple(
         slice(lo, hi) if i == split else slice(0, s) for i, s in enumerate(gshape)
     )
-    data = np.asarray(reader(slices)).astype(types.canonical_heat_type(dtype).np_dtype())
+    np_dt = types.canonical_heat_type(dtype).np_dtype()
+    data = np.asarray(reader(slices)).astype(np_dt)
+    local_rows = hi_pad - lo_pad
+    if data.shape[split] != local_rows:  # trailing pad rows of the grid
+        widths = [(0, 0)] * len(gshape)
+        widths[split] = (0, local_rows - data.shape[split])
+        data = np.pad(data, widths)
+    pshape = tuple(
+        comm.padded_extent(n) if i == split else s for i, s in enumerate(gshape)
+    )
     sharding = comm.sharding(len(gshape), split)
-    jarr = jax.make_array_from_process_local_data(sharding, data, gshape)
+    jarr = jax.make_array_from_process_local_data(sharding, data, pshape)
     dev = devices.sanitize_device(device)
     return DNDarray(jarr, gshape, types.canonical_heat_type(dtype), split, dev, comm, True)
 
@@ -184,41 +245,19 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         data = np.asarray(data)
         shape, np_dtype = data.shape, data.dtype
     kwargs.setdefault("dtype", np_dtype)  # callers may override (cast-on-write)
-    nproc, rank = _proc_info(data)
-    if nproc == 1:
-        with h5py.File(path, mode) as f:
-            if dataset in f:
-                del f[dataset]
-            ds = f.create_dataset(dataset, shape=shape, **kwargs)
-            for slices, chunk in _iter_hyperslabs(data):
-                ds[slices] = chunk
-        return
-    # multi-process: serial-HDF5 cannot take concurrent writers, so the
-    # processes write their own hyperslabs in rank order — the reference's
-    # token-ring fallback when parallel HDF5 is unavailable (SURVEY §5.4).
-    # Each process only ever touches its ADDRESSABLE shards, so the union
-    # of the passes is the full array and peak memory stays one shard.
-    from jax.experimental import multihost_utils
 
-    only_rank0 = not (isinstance(data, DNDarray) and data.split is not None)
-    if only_rank0:
-        # replicated array: EVERY process fetches (host_fetch is a collective
-        # when shards span processes — rank-0-only would deadlock the others
-        # at the barrier below), then only rank 0 writes
-        host = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
-        slabs = [(tuple(slice(0, s) for s in host.shape), host)]
-    for r in range(nproc):
-        if r == rank and (r == 0 or not only_rank0):
-            with h5py.File(path, mode if r == 0 else "a") as f:
-                if r == 0:
-                    if dataset in f:
-                        del f[dataset]
-                    ds = f.create_dataset(dataset, shape=shape, **kwargs)
-                else:
-                    ds = f[dataset]
-                for slices, chunk in (slabs if only_rank0 else _iter_hyperslabs(data)):
-                    ds[slices] = chunk
-        multihost_utils.sync_global_devices(f"save_hdf5:{dataset}:{r}")
+    def body(first, slabs):
+        with h5py.File(path, mode if first else "a") as f:
+            if first:
+                if dataset in f:
+                    del f[dataset]
+                ds = f.create_dataset(dataset, shape=shape, **kwargs)
+            else:
+                ds = f[dataset]
+            for slices, chunk in slabs:
+                ds[slices] = chunk
+
+    _token_ring_write(data, f"h5:{dataset}", body)
 
 
 # ---------------------------------------------------------------------- #
@@ -262,15 +301,20 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[List[str]] = None
     from .. import _native
 
     # split=0 streaming path: one shard of rows at a time (reference: each
-    # rank writes its own row range) — no full host gather
+    # rank writes its own row range) — no full host gather; multi-process
+    # writers take turns in rank order (ranks own ascending row ranges)
     if isinstance(data, DNDarray) and data.split == 0 and data.comm.is_distributed():
         fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-        with open(path, "w", encoding="utf-8") as fh:
-            if header_lines:
-                fh.write("\n".join(header_lines) + "\n")
-            for _, chunk in _iter_hyperslabs(data):
-                block = chunk.reshape(-1, 1) if chunk.ndim == 1 else chunk
-                np.savetxt(fh, block, delimiter=sep, fmt=fmt)
+
+        def body(first, slabs):
+            with open(path, "w" if first else "a", encoding="utf-8") as fh:
+                if first and header_lines:
+                    fh.write("\n".join(header_lines) + "\n")
+                for _, chunk in slabs:
+                    block = chunk.reshape(-1, 1) if chunk.ndim == 1 else chunk
+                    np.savetxt(fh, block, delimiter=sep, fmt=fmt)
+
+        _token_ring_write(data, "csv", body)
         return
 
     arr = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
@@ -380,35 +424,44 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w",
 
     try:
         import netCDF4
-    except ImportError:
-        import h5py
 
-        with h5py.File(path, mode) as f:
-            if variable in f:
-                _check_existing(f[variable].shape, f[variable].dtype)
-                ds = f[variable]
+        has_netcdf4 = True
+    except ImportError:
+        has_netcdf4 = False
+
+    def body(first, slabs):
+        eff_mode = mode if first else "a"
+        if not has_netcdf4:
+            import h5py
+
+            with h5py.File(path, eff_mode) as f:
+                if variable in f:
+                    _check_existing(f[variable].shape, f[variable].dtype)
+                    ds = f[variable]
+                else:
+                    kwargs.setdefault("dtype", np_dtype)
+                    ds = f.create_dataset(variable, shape=shape, **kwargs)
+                    for i, dname in enumerate(dimension_names):
+                        if dname not in f:
+                            scale = f.create_dataset(dname, data=np.arange(shape[i], dtype=np.float64))
+                            scale.make_scale(dname)
+                        ds.dims[i].attach_scale(f[dname])
+                for slices, chunk in slabs:
+                    ds[slices] = chunk
+            return
+        with netCDF4.Dataset(path, eff_mode) as f:
+            if variable in f.variables:
+                var = f.variables[variable]
+                _check_existing(var.shape, var.dtype)
             else:
-                kwargs.setdefault("dtype", np_dtype)
-                ds = f.create_dataset(variable, shape=shape, **kwargs)
                 for i, dname in enumerate(dimension_names):
-                    if dname not in f:
-                        scale = f.create_dataset(dname, data=np.arange(shape[i], dtype=np.float64))
-                        scale.make_scale(dname)
-                    ds.dims[i].attach_scale(f[dname])
-            for slices, chunk in _iter_hyperslabs(data):
-                ds[slices] = chunk
-        return
-    with netCDF4.Dataset(path, mode) as f:
-        if variable in f.variables:
-            var = f.variables[variable]
-            _check_existing(var.shape, var.dtype)
-        else:
-            for i, dname in enumerate(dimension_names):
-                if dname not in f.dimensions:
-                    f.createDimension(dname, shape[i])
-            var = f.createVariable(variable, np_dtype, tuple(dimension_names), **kwargs)
-        for slices, chunk in _iter_hyperslabs(data):
-            var[slices] = chunk
+                    if dname not in f.dimensions:
+                        f.createDimension(dname, shape[i])
+                var = f.createVariable(variable, np_dtype, tuple(dimension_names), **kwargs)
+            for slices, chunk in slabs:
+                var[slices] = chunk
+
+    _token_ring_write(data, f"nc:{variable}", body)
 
 
 # ---------------------------------------------------------------------- #
@@ -437,16 +490,27 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
         return save_csv(data, path, *args, **kwargs)
     if ext == ".npy":
         if isinstance(data, DNDarray) and data.split is not None and data.comm.is_distributed():
-            # stream shard hyperslabs into a memmapped .npy — no host gather
-            mm = np.lib.format.open_memmap(
-                path, mode="w+", dtype=data.dtype.np_dtype(), shape=data.shape
-            )
-            for slices, chunk in _iter_hyperslabs(data):
-                mm[slices] = chunk
-            mm.flush()
-            del mm
+            # stream shard hyperslabs into a memmapped .npy — no host
+            # gather; multi-process writers append in rank order
+            def body(first, slabs):
+                mm = np.lib.format.open_memmap(
+                    path,
+                    mode="w+" if first else "r+",
+                    dtype=data.dtype.np_dtype(),
+                    shape=data.shape,
+                )
+                for slices, chunk in slabs:
+                    mm[slices] = chunk
+                mm.flush()
+                del mm
+
+            _token_ring_write(data, "npy", body)
             return
-        np.save(path, data.numpy() if isinstance(data, DNDarray) else np.asarray(data))
+
+        def body(first, slabs):
+            np.save(path, next(iter(slabs))[1])
+
+        _token_ring_write(data, "npy0", body)
         return
     if ext in (".nc", ".nc4", ".netcdf"):
         return save_netcdf(data, path, *args, **kwargs)

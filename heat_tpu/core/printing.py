@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from .communication import Communication
+
 __all__ = ["get_printoptions", "set_printoptions", "local_printing", "global_printing", "print0"]
 
 # numpy-style print options (threshold/edgeitems/precision/sci_mode)
@@ -66,8 +68,6 @@ def _edge_fetch(x) -> np.ndarray:
         else:
             slices.append(slice(None))
     if all(sl == slice(None) for sl in slices):
-        from .communication import Communication
-
         return Communication.host_fetch(jarr)
     # fetch per-axis edges by advanced indexing with index vectors
     idxs = []
@@ -77,8 +77,6 @@ def _edge_fetch(x) -> np.ndarray:
         else:
             idxs.append(np.arange(s))
     mesh_idx = np.ix_(*idxs)
-    from .communication import Communication
-
     return Communication.host_fetch(jarr[mesh_idx])
 
 
@@ -92,8 +90,6 @@ def __str__(x) -> str:
         linewidth=opt["linewidth"],
     ):
         if x.size <= threshold or not np.isfinite(threshold):
-            from .communication import Communication
-
             data = Communication.host_fetch(x._jarray)
             return np.array2string(data, separator=", ")
         data = _edge_fetch(x)

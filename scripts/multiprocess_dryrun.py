@@ -32,6 +32,28 @@ DEVS_PER_PROC = 4
 MARKER = "MPDRYRUN-OK"
 
 
+PASS_MARKER = "MULTIPROCESS DRYRUN: PASS"
+
+
+def launch(timeout: float = 540.0):
+    """Run the launcher as a subprocess with the scrub every caller needs
+    (XLA_FLAGS stripped so workers pick their own device count) — THE ONE
+    place the launch contract lives; the dryrun tier and the pytest lane
+    both call this.  Success iff ``returncode == 0`` and ``PASS_MARKER`` in
+    stdout."""
+    import subprocess as sp
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    return sp.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -117,6 +139,14 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
         multihost_utils.sync_global_devices("mpdryrun:h5-rep-written")
         back2 = ht.load_hdf5(os.path.join(tmpdir, "mp_rep.h5"), "d", dtype=ht.float32)
         np.testing.assert_allclose(back2.numpy(), data.numpy())
+        # RAGGED extent (101 rows on 8 devices): the per-process slab must
+        # follow the per-DEVICE padded grid, not ceil-over-processes
+        ragged = ht.arange(101, dtype=ht.float32, split=0)
+        ht.save_hdf5(ht.reshape(ragged, (101, 1)), os.path.join(tmpdir, "mp_rag.h5"), "d")
+        multihost_utils.sync_global_devices("mpdryrun:h5-rag-written")
+        back3 = ht.load_hdf5(os.path.join(tmpdir, "mp_rag.h5"), "d", dtype=ht.float32, split=0)
+        assert back3.shape == (101, 1) and back3._pad == 3
+        np.testing.assert_allclose(back3.numpy().ravel(), np.arange(101, dtype=np.float32))
         print(f"[{pid}] hdf5 hyperslab save/load: OK", flush=True)
     else:  # pragma: no cover
         print(f"[{pid}] hdf5 hyperslab save/load: SKIP (no h5py)", flush=True)
@@ -172,18 +202,29 @@ def main() -> int:
         for pid in range(N_PROC)
     ]
     ok = True
-    # per-worker budget stays BELOW the callers' 540 s outer timeout, so a
-    # hang is reaped by this launcher (which can kill its children) rather
-    # than by the caller killing the launcher and orphaning the workers
-    for pid, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=480)
-        except subprocess.TimeoutExpired:
-            for q in procs:  # a wedged collective wedges every worker
-                if q.poll() is None:
-                    q.kill()
-            out, _ = p.communicate()
+    # ONE shared deadline below the callers' 540 s outer timeout (a
+    # per-worker budget would stack sequentially past it), so any hang is
+    # reaped by this launcher — which can kill its children — rather than
+    # by the caller killing the launcher and orphaning the workers.  The
+    # poll loop watches ALL workers at once: one failing fast kills its
+    # peers immediately (a dead peer wedges every surviving worker's next
+    # collective — waiting out the deadline for that is pure lost time).
+    import time
+
+    deadline = time.monotonic() + 480
+    while time.monotonic() < deadline:
+        codes = [p.poll() for p in procs]
+        if any(c is not None and c != 0 for c in codes) or all(
+            c is not None for c in codes
+        ):
+            break
+        time.sleep(0.5)
+    for q in procs:
+        if q.poll() is None:
+            q.kill()
             ok = False
+    for pid, p in enumerate(procs):
+        out, _ = p.communicate()
         text = out.decode(errors="replace")
         sys.stdout.write(text)
         if p.returncode != 0 or MARKER not in text:

@@ -8,6 +8,10 @@ keeping their logical split metadata.  These tests force the mode via
 exercising the native path.
 """
 
+# assert_distributed exception (r4 #8): this file tests the HOST-complex
+# placement mode in subprocesses — its arrays are deliberately not
+# mesh-placed (that is the mode under test).
+
 import os
 import subprocess
 import sys

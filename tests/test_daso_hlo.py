@@ -13,6 +13,10 @@ MPI parameter averaging every k steps (inter-node).  The TPU mapping is a
   axis — the slow tier moves parameters between groups.
 """
 
+# assert_distributed exception (r4 #8): these tests prove distribution from
+# the compiled HLO itself (replica_groups of the per-step all-reduce) — a
+# stronger check than device placement; no DNDarrays are produced.
+
 import re
 
 import jax

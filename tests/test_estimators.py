@@ -40,7 +40,9 @@ class TestPCA(TestCase):
         p = ht.decomposition.PCA(n_components=8, svd_solver="full").fit(ht.array(X, split=0))
         t = p.transform(ht.array(X, split=0))
         assert t.split == 0
+        self.assert_distributed(t)
         back = p.inverse_transform(t)
+        self.assert_distributed(back)
         np.testing.assert_allclose(back.numpy(), X, atol=1e-3)
 
     def test_variance_fraction(self, regression_data):
@@ -85,6 +87,7 @@ class TestLasso(TestCase):
         assert np.all(np.abs(coef[[0, 2, 6, 7]]) < 0.05)
         pred = ls.predict(ht.array(X, split=0))
         assert pred.shape == (256, 1)
+        self.assert_distributed(pred)
         np.testing.assert_allclose(pred.numpy().ravel(), y, atol=1.0)
 
 
@@ -98,9 +101,11 @@ class TestGaussianNB(TestCase):
         sk = SKNB().fit(X, y)
         np.testing.assert_allclose(nb.theta_.numpy(), sk.theta_, rtol=1e-3, atol=1e-4)
         pred = nb.predict(ht.array(X, split=0))
+        self.assert_distributed(pred)
         agreement = (pred.numpy() == sk.predict(X)).mean()
         assert agreement > 0.98
         proba = nb.predict_proba(ht.array(X, split=0))
+        self.assert_distributed(proba)
         np.testing.assert_allclose(proba.numpy().sum(axis=1), 1.0, atol=1e-4)
 
     def test_priors_validation(self, regression_data):
@@ -129,6 +134,7 @@ class TestScalers(TestCase):
         X, _, _ = regression_data
         s = ht.preprocessing.StandardScaler().fit(ht.array(X, split=0))
         Z = s.transform(ht.array(X, split=0))
+        self.assert_distributed(Z)
         np.testing.assert_allclose(Z.numpy().mean(axis=0), 0, atol=1e-4)
         np.testing.assert_allclose(Z.numpy().std(axis=0), 1, atol=1e-3)
         np.testing.assert_allclose(s.inverse_transform(Z).numpy(), X, atol=1e-4)
@@ -150,6 +156,7 @@ class TestScalers(TestCase):
         Z = ht.preprocessing.RobustScaler().fit(hx).transform(hx)
         np.testing.assert_allclose(np.median(Z.numpy(), axis=0), 0, atol=1e-4)
         Z = ht.preprocessing.Normalizer().transform(hx)
+        self.assert_distributed(Z)
         np.testing.assert_allclose(np.linalg.norm(Z.numpy(), axis=1), 1, atol=1e-5)
 
 

@@ -5,6 +5,10 @@ parallel-CSV strategy (byte-range split + line fixup, heat/core/io.py) across
 threads; these tests also cover the ctypes fallback contract.
 """
 
+# assert_distributed exception (r4 #8): the native CSV engine is a
+# host-side component; the arrays it produces are checked for placement by
+# the io tests that consume it.
+
 import os
 
 import numpy as np

@@ -270,9 +270,13 @@ class TestMopUp(TestCase):
         import os
 
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # pin the subprocess to CPU: inheriting the accelerator platform
+        # hangs the import when the tunnel is wedged (it only lists names)
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["JAX_PLATFORMS"] = "cpu"
         out = subprocess.run(
             [sys.executable, os.path.join(repo, "scripts", "numpy_coverage.py")],
-            capture_output=True, text=True, timeout=240,
+            capture_output=True, text=True, timeout=240, env=env,
         )
         assert out.returncode == 0, out.stderr[-500:]
         assert "(100.0%)" in out.stdout, out.stdout[-300:]

@@ -144,6 +144,10 @@ MANIP = [
 
 
 def _run(ht_out, np_out, msg):
+    if isinstance(ht_out, ht.DNDarray):
+        # physical-sharding check on every swept op (round-4 verdict #8):
+        # split metadata must match the device placement, suite-wide
+        TestCase.assert_distributed(ht_out)
     got = ht_out.numpy() if hasattr(ht_out, "numpy") else np.asarray(ht_out)
     np.testing.assert_allclose(
         np.asarray(got, dtype=np.float64),

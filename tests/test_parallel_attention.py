@@ -1,5 +1,9 @@
 """Ring attention / sequence-parallel tests."""
 
+# assert_distributed exception (r4 #8): ring attention operates on raw jax
+# arrays (not DNDarrays); distribution is asserted directly via
+# sharding.device_set and compiled-HLO collective-permute checks below.
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,57 @@
+"""Suite-level sharding canary (round-4 verdict #8).
+
+``assert_distributed`` is what turns the split sweep into a *distribution*
+check — if it silently stopped detecting unsharded arrays, the whole suite
+would revert to value-only testing (round 2's headline failure mode: split
+metadata lying about placement).  This canary proves the detector works by
+breaking the sharding machinery on purpose and asserting the check FIRES.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestShardingCanary(TestCase):
+    def test_detector_fires_on_lost_sharding(self, monkeypatch):
+        """Force Communication.sharding to always claim replication: arrays
+        then carry split metadata their placement does not have, and
+        assert_distributed MUST raise."""
+        comm = ht.communication.get_comm()
+        if not comm.is_distributed():
+            pytest.skip("needs a multi-device mesh")
+        orig = ht.communication.Communication.sharding
+
+        def lying(self, ndim, split):
+            return orig(self, ndim, None)  # replicated, whatever was asked
+
+        monkeypatch.setattr(ht.communication.Communication, "sharding", lying)
+        x = ht.array(np.arange(8 * comm.size, dtype=np.float32), split=0)
+        with pytest.raises(AssertionError, match="metadata lies|does not shard"):
+            self.assert_distributed(x)
+
+    def test_detector_fires_on_partial_placement(self, monkeypatch):
+        """Single-device placement with distributed metadata is caught by the
+        device-count arm of the check."""
+        import jax
+
+        comm = ht.communication.get_comm()
+        if not comm.is_distributed():
+            pytest.skip("needs a multi-device mesh")
+        x = ht.array(np.arange(8 * comm.size, dtype=np.float32), split=0)
+        # sneak a single-device copy behind the metadata (bypasses the
+        # constructor choke point on purpose)
+        lying = jax.device_put(x._parray, jax.devices()[0])
+        monkeypatch.setattr(
+            type(x), "_parray", property(lambda self: lying), raising=True
+        )
+        with pytest.raises(AssertionError, match="metadata lies"):
+            self.assert_distributed(x)
+
+    def test_detector_passes_on_honest_arrays(self):
+        comm = ht.communication.get_comm()
+        x = ht.array(np.arange(8 * comm.size + 3, dtype=np.float32), split=0)
+        self.assert_distributed(x)  # ragged but honestly sharded

@@ -22,7 +22,8 @@ class TestCase:
             cls.comm = ht.communication.get_comm()
         return cls.comm
 
-    def assert_distributed(self, x):
+    @staticmethod
+    def assert_distributed(x):
         """Assert that ``split`` metadata reflects PHYSICAL sharding: the array
         actually lives on every device of its communicator and the sharding
         spec names the split axis.  This is what lets the suite distinguish a
