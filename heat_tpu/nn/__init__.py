@@ -2,6 +2,8 @@
 
 from .modules import *
 from . import modules
+from .attention import MultiheadAttention
+from .recurrent import GRU, LSTM, RNN
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from . import functional
 from . import models

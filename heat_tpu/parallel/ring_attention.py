@@ -37,10 +37,13 @@ path_counts = {"ring": 0, "global": 0}
 
 
 def _global_attention(q, k, v, S, causal, scale):
-    """Single-device fallback: materializes the (S, S) score block."""
+    """Dense attention: materializes the (Sq, Sk) score block.  Rectangular
+    shapes supported (cross-attention callers); the causal mask is top-left
+    aligned (torch ``is_causal``)."""
     s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
         s = jnp.where(mask, s, -jnp.inf)
     return jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(s, axis=-1), v)
 

@@ -515,3 +515,175 @@ class TestLRSchedulersBatch2:
             ts.step()
         s = lrs.OneCycleLR(1.0, total_steps=10, pct_start=0.3)
         np.testing.assert_allclose([float(s(i)) for i in range(10)], want, rtol=1e-4, atol=1e-6)
+
+
+class TestAttentionModule:
+    """MultiheadAttention: torch-oracle + sequence-parallel ring path
+    (VERDICT r4: the ring primitive becomes an ht.nn layer)."""
+
+    @staticmethod
+    def _torch_mha(E, H, params):
+        """torch MultiheadAttention with our params copied in (ONE copy
+        routine for every oracle in this class)."""
+        import torch
+
+        m = torch.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+        with torch.no_grad():
+            m.in_proj_weight.copy_(torch.from_numpy(np.asarray(params["in_proj_weight"])))
+            m.in_proj_bias.copy_(torch.from_numpy(np.asarray(params["in_proj_bias"])))
+            m.out_proj.weight.copy_(torch.from_numpy(np.asarray(params["out_proj"]["weight"])))
+            m.out_proj.bias.copy_(torch.from_numpy(np.asarray(params["out_proj"]["bias"])))
+        return m
+
+    def _torch_oracle(self, params, x, causal):
+        import torch
+
+        E = x.shape[-1]
+        m = self._torch_mha(E, 4, params)
+        tx = torch.from_numpy(x)
+        mask = None
+        if causal:
+            S = x.shape[1]
+            mask = torch.triu(torch.ones(S, S, dtype=torch.bool), diagonal=1)
+        with torch.no_grad():
+            y, _ = m(tx, tx, tx, attn_mask=mask)
+        return y.numpy()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_torch(self, causal):
+        import jax
+
+        E, H = 32, 4
+        mha = ht.nn.MultiheadAttention(E, H)
+        params = mha.init(jax.random.key(0))
+        x = np.random.default_rng(0).standard_normal((2, 16, E)).astype(np.float32)
+        ours = np.asarray(mha.apply(params, x, causal=causal))
+        want = self._torch_oracle(params, x, causal)
+        np.testing.assert_allclose(ours, want, rtol=2e-4, atol=2e-5)
+
+    def test_cross_attention_matches_torch(self):
+        import jax
+        import torch
+
+        E, H = 16, 2
+        mha = ht.nn.MultiheadAttention(E, H)
+        params = mha.init(jax.random.key(1))
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((3, 7, E)).astype(np.float32)
+        kv = rng.standard_normal((3, 11, E)).astype(np.float32)
+        ours = np.asarray(mha.apply(params, q, kv=kv))
+        m = self._torch_mha(E, H, params)
+        with torch.no_grad():
+            want, _ = m(torch.from_numpy(q), torch.from_numpy(kv), torch.from_numpy(kv))
+        np.testing.assert_allclose(ours, want.numpy(), rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("ragged", [False, True])
+    def test_sequence_parallel_matches_dense(self, ragged):
+        """comm= routes through the ring: same numbers, sequence sharded —
+        including ragged (prime) context lengths."""
+        import jax
+
+        comm = ht.communication.get_comm()
+        if not comm.is_distributed():
+            pytest.skip("needs a multi-device mesh")
+        E, H = 16, 4
+        S = 8 * comm.size + (3 if ragged else 0)
+        dense = ht.nn.MultiheadAttention(E, H)
+        ring = ht.nn.MultiheadAttention(E, H, comm=comm)
+        params = dense.init(jax.random.key(2))
+        x = np.random.default_rng(2).standard_normal((2, S, E)).astype(np.float32)
+        want = np.asarray(dense.apply(params, x, causal=True))
+        got = np.asarray(ring.apply(params, x, causal=True))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ht.nn.MultiheadAttention(30, 4)  # not divisible
+        with pytest.raises(ValueError):
+            ht.nn.MultiheadAttention(32, 4, batch_first=False)
+
+
+class TestRecurrentModules:
+    """RNN/LSTM/GRU vs the torch oracle with copied weights."""
+
+    def _copy_to_torch(self, tm, params):
+        import torch
+
+        with torch.no_grad():
+            for layer, p in enumerate(params):
+                getattr(tm, f"weight_ih_l{layer}").copy_(torch.from_numpy(np.asarray(p["weight_ih"])))
+                getattr(tm, f"weight_hh_l{layer}").copy_(torch.from_numpy(np.asarray(p["weight_hh"])))
+                getattr(tm, f"bias_ih_l{layer}").copy_(torch.from_numpy(np.asarray(p["bias_ih"])))
+                getattr(tm, f"bias_hh_l{layer}").copy_(torch.from_numpy(np.asarray(p["bias_hh"])))
+
+    @pytest.mark.parametrize("layers", [1, 2])
+    def test_lstm_matches_torch(self, layers):
+        import jax
+        import torch
+
+        m = ht.nn.LSTM(8, 12, num_layers=layers)
+        params = m.init(jax.random.key(0))
+        x = np.random.default_rng(0).standard_normal((3, 10, 8)).astype(np.float32)
+        out, (h, c) = m.apply(params, x)
+        tm = torch.nn.LSTM(8, 12, num_layers=layers, batch_first=True)
+        self._copy_to_torch(tm, params)
+        with torch.no_grad():
+            tout, (th, tc) = tm(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), tout.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), th.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c), tc.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        import jax
+        import torch
+
+        m = ht.nn.GRU(6, 9, num_layers=2)
+        params = m.init(jax.random.key(1))
+        x = np.random.default_rng(1).standard_normal((2, 7, 6)).astype(np.float32)
+        out, h = m.apply(params, x)
+        tm = torch.nn.GRU(6, 9, num_layers=2, batch_first=True)
+        self._copy_to_torch(tm, params)
+        with torch.no_grad():
+            tout, th = tm(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), tout.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), th.numpy(), rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("nonlin", ["tanh", "relu"])
+    def test_rnn_matches_torch(self, nonlin):
+        import jax
+        import torch
+
+        m = ht.nn.RNN(5, 7, nonlinearity=nonlin)
+        params = m.init(jax.random.key(2))
+        x = np.random.default_rng(2).standard_normal((2, 6, 5)).astype(np.float32)
+        out, h = m.apply(params, x)
+        tm = torch.nn.RNN(5, 7, batch_first=True, nonlinearity=nonlin)
+        self._copy_to_torch(tm, params)
+        with torch.no_grad():
+            tout, th = tm(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), tout.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), th.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_lstm_trains_in_sequential_pipeline(self):
+        """An LSTM-backed classifier trains end-to-end with jax.grad."""
+        import jax
+        import jax.numpy as jnp
+
+        lstm = ht.nn.LSTM(4, 16)
+        head = ht.nn.Linear(16, 2)
+        p = {"lstm": lstm.init(jax.random.key(0)), "head": head.init(jax.random.key(1))}
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((32, 12, 4)).astype(np.float32)
+        y = (x[:, -1].sum(axis=-1) > 0).astype(np.int32)  # last-step signal
+
+        @jax.jit
+        def loss_fn(p):
+            out, _ = lstm.apply(p["lstm"], x)
+            logits = head.apply(p["head"], out[:, -1])
+            return ht.nn.functional.cross_entropy(logits, y)
+
+        grad_fn = jax.jit(jax.grad(loss_fn))
+        l0 = float(loss_fn(p))
+        for _ in range(120):
+            p = jax.tree.map(lambda w, gw: w - 0.2 * gw, p, grad_fn(p))
+        assert float(loss_fn(p)) < l0 * 0.5
