@@ -333,16 +333,19 @@ def main(state: dict = None) -> dict:
         snapshot()
 
     # --- KMeans iter/sec at the largest n fitting HBM (config[2] path) ---- #
-    def _kmeans_attempt(n_rows: int, dtype=None, timed_iters: int = 8) -> float:
+    def _kmeans_attempt(n_rows: int, dtype=None, timed_iters: int = 8,
+                        assign_kernel: str = "auto") -> float:
         # scoped so a failed attempt's arrays are freed before the next rung
         X = ht.random.randn(n_rows, 32, dtype=dtype or ht.float32, split=0)
         km = ht.cluster.KMeans(
-            n_clusters=64, max_iter=2, tol=0.0, random_state=0, init="random"
+            n_clusters=64, max_iter=2, tol=0.0, random_state=0, init="random",
+            assign_kernel=assign_kernel,
         )
         km.fit(X)  # compile
         t0 = time.perf_counter()
         km2 = ht.cluster.KMeans(
-            n_clusters=64, max_iter=timed_iters, tol=0.0, random_state=0, init="random"
+            n_clusters=64, max_iter=timed_iters, tol=0.0, random_state=0, init="random",
+            assign_kernel=assign_kernel,
         )
         km2.fit(X)
         # force completion (f32 readback: bf16 scalars lack a Python float path)
@@ -372,6 +375,20 @@ def main(state: dict = None) -> dict:
         except Exception as e:
             extra["kmeans_2e23_sweep_error"] = str(e)[:80]
     snapshot()
+
+    # --- kernel-on vs kernel-off (VERDICT r4 #2: the Pallas E-step must
+    # earn its keep in the benched workload or stay opt-out) -------------- #
+    if largest is not None and not skip("kmeans_kernel_ab", 0.12):
+        n_ab = 2 ** min(largest, 26)
+        try:
+            t_on = _kmeans_attempt(n_ab, timed_iters=6, assign_kernel="pallas")
+            t_off = _kmeans_attempt(n_ab, timed_iters=6, assign_kernel="jnp")
+            extra[f"kmeans_{n_ab}_x32_k64_kernel_pallas_iter_per_s"] = round(1.0 / t_on, 3)
+            extra[f"kmeans_{n_ab}_x32_k64_kernel_jnp_iter_per_s"] = round(1.0 / t_off, 3)
+            extra["kmeans_kernel_speedup"] = round(t_off / t_on, 3)
+        except Exception as e:
+            extra["kmeans_kernel_ab_error"] = str(e)[:120]
+        snapshot()
 
     # --- BASELINE config[2] scale: 1e8×32 with bf16 storage --------------- #
     # The f32 working set (12.8 GiB + temporaries) exceeds one v5e's HBM; the

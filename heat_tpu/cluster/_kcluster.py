@@ -162,14 +162,16 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         raise NotImplementedError()
 
     @classmethod
-    def _em_step(cls, jx, centers):
+    def _em_step(cls, jx, centers, use_kernel: bool = False):
         """One Lloyd iteration: new centers from current ones.  Default =
-        assign then update (two passes over X); subclasses may fuse."""
+        assign then update (two passes over X); subclasses may fuse.
+        ``use_kernel`` requests the Pallas E+M path where a subclass has
+        one (base classes ignore it)."""
         labels, _ = cls._assign(jx, centers)
         return cls._update(jx, labels, centers)
 
     @classmethod
-    def _fit_program(cls):
+    def _fit_program(cls, use_kernel: bool = False):
         """The WHOLE Lloyd iteration as one compiled XLA program
         (lax.while_loop, SURVEY §3.4) — a single device dispatch per fit,
         no per-iteration host round-trips.  Cached per class so repeated
@@ -179,7 +181,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             cache = {}
             cls._FIT_PROGRAM = cache
         # the E/M block size is baked into the trace — key the cache on it
-        prog = cache.get(_KCluster._ASSIGN_BLOCK)
+        prog = cache.get((_KCluster._ASSIGN_BLOCK, use_kernel))
         if prog is None:
 
             @jax.jit
@@ -190,7 +192,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
                 def body(state):
                     centers, it, _ = state
-                    new = cls._em_step(jx, centers)
+                    new = cls._em_step(jx, centers, use_kernel)
                     return new, it + 1, jnp.max(jnp.abs(new - centers))
 
                 centers, n_iter, _ = jax.lax.while_loop(
@@ -199,7 +201,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
                 labels, d2 = cls._assign(jx, centers)
                 return centers, labels, jnp.sum(d2), n_iter
 
-            cache[_KCluster._ASSIGN_BLOCK] = prog
+            cache[(_KCluster._ASSIGN_BLOCK, use_kernel)] = prog
         return prog
 
     def fit(self, x: DNDarray):
@@ -220,8 +222,9 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             and x.split == 0
             and x.comm.is_distributed()
         )
+        use_kernel = bool(getattr(self, "_kernel_enabled", False))
         if use_sharded:
-            prog = self._fit_program_sharded(x.comm)
+            prog = self._fit_program_sharded(x.comm, use_kernel)
             centers, labels_phys, inertia, n_iter = prog(
                 x._masked(0),  # pads must be zero, not dead garbage
                 centers0,
@@ -243,7 +246,11 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             return self
 
         jx = x._jarray
-        centers, labels, inertia, n_iter = self._fit_program()(
+        if x.split is not None and x.comm.is_distributed():
+            # global-path fits on a distributed non-row split: pallas_call
+            # has no SPMD rule and would gather X — keep the jnp program
+            use_kernel = False
+        centers, labels, inertia, n_iter = self._fit_program(use_kernel)(
             jx, centers0, jnp.asarray(self.max_iter), jnp.asarray(self.tol, centers0.dtype)
         )
         n_iter = int(n_iter)
@@ -264,7 +271,18 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         from ..core.sanitation import sanitize_in
 
         sanitize_in(x)
-        labels, _ = self._assign(x._jarray, self._cluster_centers._jarray)
+        use_kernel = getattr(self, "_kernel_enabled", False) and not (
+            # pallas_call has no SPMD partitioning rule: on a distributed
+            # split array it would gather X onto every device — the jnp
+            # path stays GSPMD-partitioned
+            x.split is not None and x.comm.is_distributed()
+        )
+        if use_kernel:
+            from ..ops.kmeans_kernels import fused_assign
+
+            labels, _ = fused_assign(x._jarray, self._cluster_centers._jarray)
+        else:
+            labels, _ = self._assign(x._jarray, self._cluster_centers._jarray)
         lab = x.comm.shard(labels, x.split)
         return DNDarray(
             lab, tuple(lab.shape), types.canonical_heat_type(lab.dtype), x.split, x.device, x.comm, True

@@ -101,9 +101,7 @@ class MultiheadAttention(Module):
         if ring:
             out = ring_attention(qh, kh, vh, self.comm, causal=causal)
         else:
-            out = _global_attention(
-                qh, kh, vh, qh.shape[-2], causal, 1.0 / (self.head_dim**0.5)
-            )
+            out = _global_attention(qh, kh, vh, causal, 1.0 / (self.head_dim**0.5))
         B, H, S, d = out.shape
         merged = out.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = merged @ params["out_proj"]["weight"].T

@@ -7,6 +7,6 @@ touches HBM).  Every kernel has a jnp fallback and is selected automatically
 (`interpret=True` on CPU so the same code path is testable on the dev mesh).
 """
 
-from .kmeans_kernels import fused_assign
+from .kmeans_kernels import fused_assign, fused_em_stats
 
-__all__ = ["fused_assign"]
+__all__ = ["fused_assign", "fused_em_stats"]

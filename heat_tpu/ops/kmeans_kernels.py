@@ -1,16 +1,23 @@
-"""Pallas TPU kernel: fused KMeans assignment (E-step).
+"""Pallas TPU kernels for the KMeans E-step.
 
-The jnp form materializes the (n, k) squared-distance matrix in HBM before
-the argmin.  This kernel tiles the sample axis: each grid step loads a
-(TILE, d) row block plus the full (k, d) centers into VMEM, runs the
-distance GEMM on the MXU, and reduces to (TILE,) labels + min-distances in
-VMEM — the n×k matrix never exists in HBM.
+``fused_assign`` (labels + min-distance): each grid step loads a (TILE, d)
+row block plus the full (k, d) centers into VMEM, runs the distance GEMM
+on the MXU, and reduces in VMEM — the (n, k) matrix never exists in HBM.
 
-Measured on v5e (1M×32, k=64): XLA's own fusion of the jnp form runs at
-~4.8 ms vs ~14.6 ms for this kernel — XLA already avoids the HBM
-materialization here, so ``cluster.KMeans`` keeps the jnp path and this
-kernel remains an opt-in (`ht.ops.fused_assign`) for the regimes XLA fuses
-poorly (large k × large d where the (n,k) product spills).
+``fused_em_stats`` (round-4): the whole Lloyd iteration body — assignment
+AND the (k, d)/(k,) statistics accumulation in ONE grid sweep with
+constant-index accumulator blocks; labels never reach HBM.  Inputs stay in
+their storage dtype (bf16 at the 1e8×32 BASELINE scale) and are cast
+per-tile in VMEM.
+
+Both are WIRED into ``cluster.KMeans`` via ``assign_kernel='pallas'``
+(fit: fused E+M on both the sharded and global paths; predict: fused
+assign), with the jnp path as ``'jnp'`` and the measured-faster default as
+``'auto'``.  Measured on v5e round 3 (1M×32, k=64): XLA's fusion of the
+jnp form ran at ~4.8 ms vs ~14.6 ms for the assign-only kernel — the
+bench's kernel-on/off A-B rows (``kmeans_*_kernel_*``) re-measure the
+fused E+M kernel each round; flip ``'auto'`` in ``KMeans.__init__`` if it
+inverts.
 """
 
 from __future__ import annotations
@@ -28,13 +35,15 @@ try:
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["fused_assign"]
+__all__ = ["fused_assign", "fused_em_stats"]
 
 _TILE = 1024
 
 
 def _assign_kernel(x_ref, c_ref, cc_ref, lab_ref, d2_ref):
-    x = x_ref[:]  # (TILE, d)
+    # cast per-TILE in VMEM: casting X up front would materialize a full
+    # f32 copy in HBM (2x the bf16 working set — OOM at 1e8x32)
+    x = x_ref[:].astype(jnp.float32)  # (TILE, d)
     c = c_ref[:]  # (k, d)
     cc = cc_ref[:]  # (1, k) — precomputed ||c||²
     xx = jnp.sum(x * x, axis=1, keepdims=True)  # (TILE, 1)
@@ -71,8 +80,127 @@ def _fused_assign_impl(x, centers, interpret: bool):
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(x.astype(jnp.float32), centers.astype(jnp.float32), cc.astype(jnp.float32))
+    )(x, centers.astype(jnp.float32), cc.astype(jnp.float32))
     return labels[:, 0], d2[:, 0]
+
+
+def _em_stats_kernel(n_ref, x_ref, c_ref, cc_ref, sums_ref, counts_ref):
+    """Fused E+M grid step: assign one (TILE, d) row block and fold it
+    straight into the (k, d)/(1, k) statistics accumulators.
+
+    The accumulators' BlockSpecs are CONSTANT across the grid, so the TPU's
+    sequential grid revisits the same VMEM block — step 0 initializes,
+    later steps add (the `pl.when` idiom).  Labels never reach HBM and the
+    (n, k) distance matrix never exists anywhere: one X read per iteration
+    is the entire HBM traffic.
+    """
+    i = pl.program_id(0)
+    x = x_ref[:].astype(jnp.float32)  # (TILE, d) — cast per-tile (see above)
+    c = c_ref[:]  # (k, d)
+    cc = cc_ref[:]  # (1, k)
+    tile = x.shape[0]
+    k = c.shape[0]
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    dots = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    d2 = jnp.maximum(xx + cc - 2.0 * dots, 0.0)  # (TILE, k)
+    lab = jnp.argmin(d2, axis=1)  # (TILE,)
+    # rows at global index ≥ n are pad: contribute nothing
+    gidx = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile,), 0)
+    valid = gidx < n_ref[0]
+    # zero the pad/out-of-bounds rows of x too: a ragged final block reads
+    # undefined tile memory, and 0·garbage in the GEMM is only safe when
+    # the garbage cannot be inf/NaN — masking x makes it actually zero
+    x = jnp.where(valid[:, None], x, 0.0)
+    onehot = ((lab[:, None] == jax.lax.broadcasted_iota(jnp.int32, (tile, k), 1))
+              & valid[:, None]).astype(jnp.float32)
+    bs = jax.lax.dot_general(  # (k, TILE) @ (TILE, d) on the MXU
+        onehot, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    bc = jnp.sum(onehot, axis=0, keepdims=True)  # (1, k)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = bs
+        counts_ref[:] = bc
+
+    @pl.when(i > 0)
+    def _():
+        sums_ref[:] = sums_ref[:] + bs
+        counts_ref[:] = counts_ref[:] + bc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_em_stats_impl(x, centers, n, interpret: bool):
+    npad, d = x.shape
+    k = centers.shape[0]
+    tile = min(_TILE, npad)
+    grid = (pl.cdiv(npad, tile),)
+    cc = jnp.sum(centers * centers, axis=1)[None, :]
+    sums, counts = pl.pallas_call(
+        _em_stats_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM if _HAS_PALLAS and not interpret else None),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray([n], jnp.int32),
+        x,
+        centers.astype(jnp.float32),
+        cc.astype(jnp.float32),
+    )
+    return sums, counts[0]
+
+
+def fused_em_stats(x, centers, n=None):
+    """(sums (k, d), counts (k,)) of one fused assign-and-accumulate pass.
+
+    The Lloyd-iteration E+M kernel (round-4): assignment and per-cluster
+    statistics in ONE grid sweep — labels never reach HBM.  Rows at index
+    ≥ ``n`` (pad) contribute nothing.  Pallas on TPU, interpreter on small
+    CPU shards, jnp fallback otherwise.
+    """
+    rows = x.shape[0]
+    n = rows if n is None else n
+    if not _HAS_PALLAS:
+        return _jnp_em_stats(x, centers, n)
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "cpu") or (platform == "cpu" and rows > 16384):
+        return _jnp_em_stats(x, centers, n)
+    # conservative VMEM budget at trace time: the accumulator + centers +
+    # one tile must fit comfortably; oversize problems take the jnp path
+    # HERE because a Mosaic failure under an OUTER jit surfaces at that
+    # jit's compile, where the try below cannot catch it
+    k, d = centers.shape
+    tile = min(_TILE, rows)
+    vmem = 4 * (2 * k * d + tile * d + 2 * tile * k)
+    if vmem > 8 * 2**20:
+        return _jnp_em_stats(x, centers, n)
+    try:
+        return _fused_em_stats_impl(x, centers, n, interpret=(platform == "cpu"))
+    except Exception:
+        return _jnp_em_stats(x, centers, n)
+
+
+def _jnp_em_stats(x, centers, n):
+    lab, _ = _jnp_assign(x, centers)
+    k = centers.shape[0]
+    valid = jnp.arange(x.shape[0]) < n
+    onehot = ((lab[:, None] == jnp.arange(k)[None, :]) & valid[:, None]).astype(jnp.float32)
+    return onehot.T @ x.astype(jnp.float32), jnp.sum(onehot, axis=0)
 
 
 def _jnp_assign(x, centers):
@@ -87,8 +215,11 @@ def fused_assign(x, centers):
     """(labels, min_d2) of each row of ``x`` against ``centers``.
 
     Pallas-fused on TPU; interpreter mode on CPU shards; jnp fallback when
-    Pallas is unavailable or shapes are unfriendly (the kernel requires the
-    row count divisible by the tile, handled by padding).
+    Pallas is unavailable or the VMEM estimate says the blocks won't fit.
+    Ragged row counts ride the clipped final grid block — no padded copy
+    of X is ever made (a concatenate would double peak HBM at the 1e8×32
+    scale this kernel exists for); garbage values in the clipped tail are
+    discarded with the sliced outputs.
     """
     if not _HAS_PALLAS:
         return _jnp_assign(x, centers)
@@ -99,12 +230,12 @@ def fused_assign(x, centers):
     if platform == "cpu" and n > 16384:
         # interpreter mode is slow; only use it at test scale
         return _jnp_assign(x, centers)
+    k, d = centers.shape
     tile = min(_TILE, n)
-    pad = (-n) % tile
-    if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)], axis=0)
+    if 4 * (k * d + tile * d + 2 * tile * k) > 8 * 2**20:
+        return _jnp_assign(x, centers)  # VMEM-gated (see fused_em_stats)
     try:
         labels, d2 = _fused_assign_impl(x, centers, interpret=(platform == "cpu"))
     except Exception:
-        return _jnp_assign(x[:n], centers)
+        return _jnp_assign(x, centers)
     return labels[:n], d2[:n]

@@ -36,7 +36,7 @@ __all__ = ["ring_attention", "ring_self_attention"]
 path_counts = {"ring": 0, "global": 0}
 
 
-def _global_attention(q, k, v, S, causal, scale):
+def _global_attention(q, k, v, causal, scale):
     """Dense attention: materializes the (Sq, Sk) score block.  Rectangular
     shapes supported (cross-attention callers); the causal mask is top-left
     aligned (torch ``is_causal``)."""
@@ -73,7 +73,7 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
     axis, size = comm.axis, comm.size
     if size == 1:
         path_counts["global"] += 1
-        return _global_attention(q, k, v, S, causal, scale)
+        return _global_attention(q, k, v, causal, scale)
     path_counts["ring"] += 1
 
     seq_axis = q.ndim - 2
