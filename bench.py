@@ -376,6 +376,28 @@ def main(state: dict = None) -> dict:
             extra["kmeans_2e23_sweep_error"] = str(e)[:80]
     snapshot()
 
+    # --- BASELINE config[1]: tall-skinny QR (TSQR), 1e6 x 256 f32 --------- #
+    if not skip("qr_tsqr", 0.13):
+        try:
+            from heat_tpu.utils.profiler import timeit_min
+
+            A = ht.random.randn(1_000_000, 256, dtype=ht.float32, split=0)
+            # mode='r': the 2*m*n^2 flop model below covers the
+            # factorization only — timing Q formation too would misstate
+            # throughput ~2x (and double the benchmark cost)
+            rf = ht.linalg.qr(A, mode="r").R  # compile + warm
+            float(rf._jarray.astype("float32")[0, 0])
+            dt = timeit_min(lambda: ht.linalg.qr(A, mode="r").R, reps=2)
+            extra["qr_tsqr_1e6x256_f32_s"] = round(dt, 4)
+            # TSQR flop count ~ 2 m n^2 (the dominant local-QR + merge GEMMs)
+            extra["qr_tsqr_1e6x256_gflops"] = round(
+                2.0 * 1_000_000 * 256**2 / dt / 1e9, 1
+            )
+            del A, rf
+        except Exception as e:
+            extra["qr_tsqr_error"] = str(e)[:100]
+        snapshot()
+
     # --- kernel-on vs kernel-off (VERDICT r4 #2: the Pallas E-step must
     # earn its keep in the benched workload or stay opt-out) -------------- #
     if largest is not None and not skip("kmeans_kernel_ab", 0.12):

@@ -732,13 +732,25 @@ def topk(x: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
 _DIST_UNIQUE_THRESHOLD = 1_000_000
 
 
+# element count below which gather fallbacks stay silent (a 5-element
+# gather is not a trap; warning on it is pure noise); module-level so tests
+# can lower it, like _DIST_UNIQUE_THRESHOLD
+_GATHER_WARN_THRESHOLD = 512
+
+
 def _warn_implicit_gather(op: str, x: DNDarray) -> None:
     """Perf-trap warning (reference: ``warnings.warn`` on implicit-comm
     traps, SURVEY §5.5): this operation's fallback gathers the split axis —
-    every device materializes the full array."""
+    every device materializes the full array.  The guard is on the TOTAL
+    element count (what actually lands on every device), not the split
+    extent — (500, 1e6) split=0 is a 2 GB gather despite 500 rows."""
     import warnings
 
-    if x.split is not None and x.comm.is_distributed():
+    if (
+        x.split is not None
+        and x.comm.is_distributed()
+        and x.size >= _GATHER_WARN_THRESHOLD
+    ):
         warnings.warn(
             f"{op} on a split array falls back to a global formulation that "
             f"gathers the split axis ({x.shape[x.split]} elements onto every "
