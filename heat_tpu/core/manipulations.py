@@ -517,6 +517,10 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
       to ``'global'``.
     - ``'auto'`` — ``'sample'`` when eligible and the array is large enough
       that the gather would dominate (≥ 1e6 elements), else ``'global'``.
+
+    n-D arrays sorted ALONG their split axis use the FFT "transpose
+    method" (SURVEY §2.2): resplit so the sort axis is local, sort, resplit
+    back — two all_to_alls, O(n/p) per-device memory, no gather.
     """
     axis = sanitize_axis(x.shape, axis)
     j = x._jarray
@@ -558,25 +562,43 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
                 return out, i
             return v, i
 
-    if descending:
-        if jnp.issubdtype(j.dtype, jnp.floating):
-            # torch semantics (and the sample path's): NaNs FIRST in
-            # descending — lexsort on (nan-flag, negated value); plain
-            # argsort(-j) would leave NaNs last
-            nanmask = jnp.isnan(j)
-            primary = jnp.where(nanmask, 0, 1)
-            secondary = jnp.where(nanmask, jnp.zeros_like(j), -j)
-            idx = jnp.lexsort((secondary, primary), axis=axis)
-        elif jnp.issubdtype(j.dtype, jnp.integer):
-            # bitwise NOT, not negation: -x wraps at INT_MIN and on every
-            # unsigned value (0 would negate to 0 and sort first)
-            idx = jnp.argsort(_order_flip(j), axis=axis, stable=True)
-        elif jnp.issubdtype(j.dtype, jnp.complexfloating):
-            idx = jnp.argsort(-j, axis=axis, stable=True)
-        else:  # bool
-            idx = jnp.argsort(~j, axis=axis, stable=True)
-    else:
-        idx = jnp.argsort(j, axis=axis, stable=True)
+    # the target axis must actually reshard: Communication.shard leaves
+    # non-divisible extents where they are ("ragged: keep XLA's placement"),
+    # which would silently degrade this path into the very gather it exists
+    # to avoid — so only divisible non-sort axes qualify, and method='global'
+    # keeps its documented meaning as the escape hatch
+    transpose_axes = [
+        a for a in range(x.ndim)
+        if a != axis and x.shape[a] % x.comm.size == 0 and x.shape[a] > 0
+    ]
+    if (
+        x.ndim >= 2
+        and axis == x.split
+        and x.comm.is_distributed()
+        and method != "global"
+        and transpose_axes
+    ):
+        # n-D along-split sort: the reference redistributes rather than
+        # gathers; same here via the FFT "transpose method" (SURVEY §2.2):
+        # resplit so the sort axis is device-local, sort locally (other
+        # axes stay sharded), resplit back — two all_to_alls, per-device
+        # memory stays O(n/p), no gather
+        sort_paths["transpose"] += 1
+        other = transpose_axes[0]
+        xr = resplit(x, other)
+        idx = _argsort_directional(xr._jarray, axis, descending)
+        vals = jnp.take_along_axis(xr._jarray, idx, axis=axis)
+        v = resplit(_wrap(vals, other, x), axis)
+        i = resplit(_wrap(idx.astype(jnp.int32), other, x), axis)
+        if out is not None:
+            out._jarray = v._jarray
+            return out, i
+        return v, i
+
+    if x.split is not None and axis == x.split:
+        _warn_implicit_gather("sort", x)
+    sort_paths["global"] += 1
+    idx = _argsort_directional(j, axis, descending)
     vals = jnp.take_along_axis(j, idx, axis=axis)
     v = _wrap(vals, x.split, x)
     i = _wrap(idx.astype(jnp.int32), x.split, x)
@@ -584,6 +606,31 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
         out._jarray = v._jarray
         return out, i
     return v, i
+
+
+# eager routing counters (tests assert which path handled a shape)
+sort_paths = {"transpose": 0, "global": 0}
+
+
+def _argsort_directional(j, axis, descending):
+    """Stable argsort in either direction with exact dtype semantics."""
+    if not descending:
+        return jnp.argsort(j, axis=axis, stable=True)
+    if jnp.issubdtype(j.dtype, jnp.floating):
+        # torch semantics (and the sample path's): NaNs FIRST in
+        # descending — lexsort on (nan-flag, negated value); plain
+        # argsort(-j) would leave NaNs last
+        nanmask = jnp.isnan(j)
+        primary = jnp.where(nanmask, 0, 1)
+        secondary = jnp.where(nanmask, jnp.zeros_like(j), -j)
+        return jnp.lexsort((secondary, primary), axis=axis)
+    if jnp.issubdtype(j.dtype, jnp.integer):
+        # bitwise NOT, not negation: -x wraps at INT_MIN and on every
+        # unsigned value (0 would negate to 0 and sort first)
+        return jnp.argsort(_order_flip(j), axis=axis, stable=True)
+    if jnp.issubdtype(j.dtype, jnp.complexfloating):
+        return jnp.argsort(-j, axis=axis, stable=True)
+    return jnp.argsort(~j, axis=axis, stable=True)  # bool
 
 
 def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:

@@ -426,3 +426,76 @@ class TestGlobalDescendingFallback(TestCase):
         b = np.array([True, False, True])
         v, _ = ht.sort(ht.array(b), descending=True)
         np.testing.assert_array_equal(v.numpy(), [True, True, False])
+
+
+class TestNDSortTransposeMethod(TestCase):
+    """n-D along-split sort: the FFT transpose method (resplit → local sort
+    → resplit back) keeps per-device memory O(n/p) — no gather (r4)."""
+
+    @pytest.fixture(autouse=True)
+    def _needs_mesh(self):
+        _skip_if_single_device()
+
+    def test_2d_split0_axis0(self):
+        import heat_tpu.core.manipulations as M
+
+        # the non-sort axis must divide the device count for the resplit to
+        # genuinely reshard (ragged extents keep XLA's placement)
+        p = ht.communication.get_comm().size
+        x = rng.standard_normal((1000, 4 * p)).astype(np.float32)
+        x[3, 5] = np.nan
+        hx = ht.array(x, split=0)
+        before = dict(M.sort_paths)
+        v, i = ht.sort(hx, axis=0)
+        assert M.sort_paths["transpose"] == before["transpose"] + 1
+        np.testing.assert_allclose(v.numpy(), np.sort(x, axis=0), equal_nan=True)
+        np.testing.assert_allclose(
+            np.take_along_axis(x, i.numpy(), 0), np.sort(x, axis=0), equal_nan=True
+        )
+        assert v.split == 0
+        self.assert_distributed(v)
+        self.assert_distributed(i)
+
+    def test_3d_split1_descending(self):
+        p = ht.communication.get_comm().size
+        y = rng.integers(-50, 50, size=(2 * p, 40, 5)).astype(np.int32)
+        hy = ht.array(y, split=1)
+        v, i = ht.sort(hy, axis=1, descending=True)
+        want = np.sort(y, axis=1)[:, ::-1, :]
+        np.testing.assert_array_equal(v.numpy(), want)
+        np.testing.assert_array_equal(np.take_along_axis(y, i.numpy(), 1), want)
+        assert v.split == 1
+        self.assert_distributed(v)
+
+    def test_no_divisible_axis_falls_back_with_warning(self):
+        """No reshardable non-sort axis → documented global path + the
+        implicit-gather warning; method='global' is always an escape hatch."""
+        import heat_tpu.core.manipulations as M
+
+        p = ht.communication.get_comm().size
+        x = rng.standard_normal((16 * p, 4 * p + 1)).astype(np.float32)
+        hx = ht.array(x, split=0)
+        before = dict(M.sort_paths)
+        with pytest.warns(UserWarning, match="communication- and memory-heavy"):
+            v, _ = ht.sort(hx, axis=0)
+        assert M.sort_paths["transpose"] == before["transpose"]
+        assert M.sort_paths["global"] == before["global"] + 1
+        np.testing.assert_allclose(v.numpy(), np.sort(x, axis=0), rtol=1e-6)
+        # explicit method='global' bypasses the transpose path even when
+        # a divisible axis exists
+        hx2 = ht.array(rng.standard_normal((64, 4 * p)).astype(np.float32), split=0)
+        before = dict(M.sort_paths)
+        with pytest.warns(UserWarning, match="communication- and memory-heavy"):
+            ht.sort(hx2, axis=0, method="global")
+        assert M.sort_paths["transpose"] == before["transpose"]
+
+    def test_non_split_axis_stays_local(self):
+        import heat_tpu.core.manipulations as M
+
+        x = rng.standard_normal((64, 16)).astype(np.float32)
+        hx = ht.array(x, split=0)
+        before = dict(M.sort_paths)
+        v, _ = ht.sort(hx, axis=1)  # sort axis is already local
+        assert M.sort_paths["transpose"] == before["transpose"]
+        np.testing.assert_allclose(v.numpy(), np.sort(x, axis=1), rtol=1e-6)
+        self.assert_distributed(v)
