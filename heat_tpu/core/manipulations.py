@@ -915,16 +915,72 @@ def argwhere(x: DNDarray) -> DNDarray:
     return res
 
 
+@comm_cached
+def _searchsorted_program(comm, P: int, dtype_name: str, n: int, side: str):
+    """Distributed bisect: the global insertion index of each query is the
+    SUM over shards of its local insertion index — one psum, no gather.
+    Shard pads are rewritten to +dtype-max so each padded block stays
+    sorted; the per-shard count is clamped to the shard's valid extent,
+    which also fixes queries tying with the sentinel."""
+    p = comm.size
+    c = P // p
+    axis = comm.axis
+    dt = jnp.dtype(dtype_name)
+    # float pads become NaN: a sorted block with a real NaN tail stays
+    # "sorted with NaNs last" after padding (an inf sentinel would sit
+    # BELOW real NaNs and unsort the block); the valid-clamp below removes
+    # the pads' contribution for NaN queries too
+    sentinel = jnp.asarray(
+        jnp.nan if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).max, dt
+    )
+
+    def shard_fn(blk, v):
+        my = jax.lax.axis_index(axis)
+        base = my * c
+        valid = jnp.clip(n - base, 0, c)
+        blk = jnp.where(jnp.arange(c) < valid, blk, sentinel)
+        local = jnp.searchsorted(blk, v, side=side)
+        local = jnp.minimum(local, valid)
+        return jax.lax.psum(local.astype(jnp.int32), axis)
+
+    from jax.sharding import PartitionSpec as Pspec
+
+    mapped = comm.shard_map(shard_fn, in_splits=((1, 0), Pspec()), out_splits=Pspec())
+    return jax.jit(mapped)
+
+
 def searchsorted(a: DNDarray, v, side: str = "left", sorter=None) -> DNDarray:
-    """Insertion indices into the sorted 1-D array ``a``."""
+    """Insertion indices into the sorted 1-D array ``a``.
+
+    A distributed split ``a`` is bisected WITHOUT gathering (round-4,
+    closing the last global-only route of the order-dependent surface):
+    each shard bisects its own sorted block and the per-shard counts psum
+    into the global index (NaN tails ride the NaN pad sentinel).
+    ``sorter`` — an indirection layer — takes the global path.
+    """
     jv = v._jarray if isinstance(v, DNDarray) else jnp.asarray(v)
     ja = a._jarray
+    proto_split = v.split if isinstance(v, DNDarray) else None
+    if (
+        sorter is None
+        and a.ndim == 1
+        and a.split == 0
+        and a.comm.is_distributed()
+        and a.shape[0] < 2**31
+        and jnp.issubdtype(ja.dtype, jnp.number)
+        and not jnp.issubdtype(ja.dtype, jnp.complexfloating)
+    ):
+        prog = _searchsorted_program(
+            a.comm, a._parray.shape[0], jnp.dtype(ja.dtype).name, a.shape[0], side
+        )
+        res = prog(a._parray, jv)
+        return _wrap(res, proto_split, a)
+    _warn_implicit_gather("searchsorted", a)
     if sorter is not None:
         js = sorter._jarray if isinstance(sorter, DNDarray) else jnp.asarray(sorter)
         ja = ja[js]
     res = jnp.searchsorted(ja, jv, side=side)
-    proto = v if isinstance(v, DNDarray) else a
-    return _wrap(res, proto.split if isinstance(v, DNDarray) else None, a)
+    return _wrap(res, proto_split, a)
 
 
 def take(a: DNDarray, indices, axis: Optional[int] = None) -> DNDarray:
