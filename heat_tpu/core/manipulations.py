@@ -562,29 +562,16 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
                 return out, i
             return v, i
 
-    # the target axis must actually reshard: Communication.shard leaves
-    # non-divisible extents where they are ("ragged: keep XLA's placement"),
-    # which would silently degrade this path into the very gather it exists
-    # to avoid — so only divisible non-sort axes qualify, and method='global'
-    # keeps its documented meaning as the escape hatch
-    transpose_axes = [
-        a for a in range(x.ndim)
-        if a != axis and x.shape[a] % x.comm.size == 0 and x.shape[a] > 0
-    ]
-    if (
-        x.ndim >= 2
-        and axis == x.split
-        and x.comm.is_distributed()
-        and method != "global"
-        and transpose_axes
-    ):
+    # method='global' keeps its documented meaning as the escape hatch
+    t_axis = reshard_axis_for(x, {axis}) if method != "global" else None
+    if axis == x.split and t_axis is not None:
         # n-D along-split sort: the reference redistributes rather than
         # gathers; same here via the FFT "transpose method" (SURVEY §2.2):
         # resplit so the sort axis is device-local, sort locally (other
         # axes stay sharded), resplit back — two all_to_alls, per-device
         # memory stays O(n/p), no gather
         sort_paths["transpose"] += 1
-        other = transpose_axes[0]
+        other = t_axis
         xr = resplit(x, other)
         idx = _argsort_directional(xr._jarray, axis, descending)
         vals = jnp.take_along_axis(xr._jarray, idx, axis=axis)
@@ -610,6 +597,23 @@ def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None, method
 
 # eager routing counters (tests assert which path handled a shape)
 sort_paths = {"transpose": 0, "global": 0}
+
+
+def reshard_axis_for(x: DNDarray, busy) -> Optional[int]:
+    """Transpose-method target: the first axis NOT in ``busy`` whose extent
+    the device count divides.  The divisibility requirement is what makes
+    the resplit real — ``Communication.shard`` leaves ragged extents where
+    they are ("ragged: keep XLA's placement"), which would silently degrade
+    the transpose method into the very gather it exists to avoid.  Shared
+    by along-split ``sort`` and the FFT family; None when the array is not
+    distributed/multi-dimensional or no target qualifies."""
+    if x.split is None or not x.comm.is_distributed() or x.ndim < 2:
+        return None
+    p = x.comm.size
+    for a in range(x.ndim):
+        if a not in busy and x.shape[a] > 0 and x.shape[a] % p == 0:
+            return a
+    return None
 
 
 def _argsort_directional(j, axis, descending):
