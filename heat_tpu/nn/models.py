@@ -13,7 +13,7 @@ from typing import Sequence
 
 from . import modules as nn
 
-__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp"]
+__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder"]
 
 
 def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
@@ -121,3 +121,62 @@ def mlp(sizes: Sequence[int] = (784, 256, 128, 10)) -> nn.Module:
         if i < len(sizes) - 2:
             layers.append(nn.ReLU())
     return nn.Sequential(*layers)
+
+
+class _TransformerBlock(nn.Module):
+    """Pre-norm transformer encoder block: x + MHA(LN(x)), then
+    x + FFN(LN(x)).  ``comm`` routes the attention over the sequence-
+    parallel ring (long contexts scale with the mesh)."""
+
+    def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
+                 causal: bool = False, comm=None):
+        from .attention import MultiheadAttention
+
+        self.ln1 = nn.LayerNorm(embed_dim)
+        self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm)
+        self.ln2 = nn.LayerNorm(embed_dim)
+        self.ff = nn.Sequential(
+            nn.Linear(embed_dim, mlp_ratio * embed_dim),
+            nn.GELU(),
+            nn.Linear(mlp_ratio * embed_dim, embed_dim),
+        )
+        self.causal = causal
+
+    def init(self, key):
+        import jax
+
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {
+            "ln1": self.ln1.init(k1), "mha": self.mha.init(k2),
+            "ln2": self.ln2.init(k3), "ff": self.ff.init(k4),
+        }
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        h = x + self.mha.apply(params["mha"], self.ln1.apply(params["ln1"], x),
+                               causal=self.causal)
+        return h + self.ff.apply(params["ff"], self.ln2.apply(params["ln2"], h))
+
+
+def transformer_encoder(
+    embed_dim: int = 256,
+    num_heads: int = 8,
+    depth: int = 4,
+    mlp_ratio: int = 4,
+    causal: bool = False,
+    comm=None,
+) -> nn.Module:
+    """A stack of pre-norm transformer blocks over (B, S, embed_dim) input.
+
+    Bidirectional by default (torch ``TransformerEncoder`` convention);
+    pass ``causal=True`` for decoder-style masked attention.
+
+    Beyond-reference model family (the reference predates transformers —
+    SURVEY §2.8 honest-scope note), built entirely from this framework's
+    native modules; with ``comm`` every block's attention runs
+    sequence-parallel on the mesh ring, so context length scales with the
+    chip count.
+    """
+    return nn.Sequential(
+        *[_TransformerBlock(embed_dim, num_heads, mlp_ratio, causal, comm)
+          for _ in range(depth)]
+    )
