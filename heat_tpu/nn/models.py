@@ -152,9 +152,15 @@ class _TransformerBlock(nn.Module):
         }
 
     def apply(self, params, x, *, train: bool = False, key=None):
+        k1 = k2 = None
+        if key is not None:
+            import jax
+
+            k1, k2 = jax.random.split(key)
         h = x + self.mha.apply(params["mha"], self.ln1.apply(params["ln1"], x),
-                               causal=self.causal)
-        return h + self.ff.apply(params["ff"], self.ln2.apply(params["ln2"], h))
+                               causal=self.causal, train=train, key=k1)
+        return h + self.ff.apply(params["ff"], self.ln2.apply(params["ln2"], h),
+                                 train=train, key=k2)
 
 
 def transformer_encoder(

@@ -68,7 +68,8 @@ class TestShardParallelWrites(TestCase):
         path = str(tmp_path / "a.nc")
         ht.save_netcdf(x, path, "var")
         assert htio._CHUNK_WRITES["count"] == p
-        assert htio._CHUNK_WRITES["max_bytes"] < d.nbytes
+        if p > 1:  # at p=1 the single chunk IS the whole array
+            assert htio._CHUNK_WRITES["max_bytes"] < d.nbytes
         back = ht.load_netcdf(path, "var", split=0)
         self.assert_array_equal(back, d)
 
@@ -78,7 +79,8 @@ class TestShardParallelWrites(TestCase):
         reset_counters()
         path = str(tmp_path / "a.csv")
         ht.save_csv(x, path)
-        assert htio._CHUNK_WRITES["count"] == p
+        if p > 1:  # p=1 takes the (also correct) non-streaming fallback
+            assert htio._CHUNK_WRITES["count"] == p
         back = ht.load_csv(path, split=0)
         self.assert_array_equal(back, d, rtol=1e-5, atol=1e-5)
 
@@ -183,6 +185,8 @@ class TestArrayCheckpoint(TestCase):
         rng = np.random.default_rng(5)
         d = rng.uniform(size=(22, 3)).astype(np.float32)
         x = ht.array(d, split=0)  # world comm (8 devices)
+        if len(jax.devices()) < 3:
+            pytest.skip("remesh target needs >= 3 devices")
         ckpt = str(tmp_path / "remesh")
         ht.save_array_checkpoint(x, ckpt)
         comm3 = ht.communication.Communication(
