@@ -46,7 +46,17 @@ if n_dev >= 2:
     # the static-shape sample sort (SURVEY hard part #3) vs the global sort:
     # same input, distributed path keeps O(n/p) memory per shard
     results["sample_sort_1M"] = timed(lambda: ht.sort(v, method="sample")[0])
+    results["sample_sort_desc_1M"] = timed(lambda: ht.sort(v, method="sample", descending=True)[0])
     results["percentile_bisect_1M"] = timed(lambda: ht.percentile(v, 99.0))
+    # round-4 distributed selection surface
+    vi = ht.array(_np.random.default_rng(2).integers(0, 50_000, 2**20).astype(_np.int32), split=0)
+    import heat_tpu.core.manipulations as _M
+    _M._DIST_UNIQUE_THRESHOLD = 2**20  # engage the distributed path at this n
+    results["unique_1M_int"] = timed(lambda: ht.unique(vi))
+    sv = ht.sort(v, method="sample")[0]
+    q = ht.array(_np.linspace(-3, 3, 1024).astype(_np.float32))
+    results["searchsorted_1M_1k"] = timed(lambda: ht.searchsorted(sv, q))
+    results["topk_largek_1M"] = timed(lambda: ht.topk(v, 2**18)[0])
 
 # DASO vs sync DataParallel (reference's flagship comparison, SURVEY §2.5):
 # identical MLP + batch; DASO pays a per-step ici-subgroup allreduce + every-k
@@ -133,7 +143,7 @@ def main() -> None:
         "per shard — improves with mesh width); percentile_bisect_1M = "
         "exact order statistics, no sort. dp_mlp_step_256 = sync "
         "DataParallel step; daso_mlp_step_256 = hierarchical DASO step on "
-        "an (n/2)x2 mesh. Recorded round 3, 2026-07-30. TPU single-chip "
+        "an (n/2)x2 mesh. Recorded round 4, 2026-07-30; round-4 rows: descending sample sort, distributed unique/searchsorted/large-k topk. TPU single-chip "
         "numbers live in BENCH_r03.json; multi-chip ICI scaling requires a "
         "pod (unavailable: one tunneled v5e chip)."
     )}))
