@@ -376,7 +376,8 @@ class TestLargeKTopK(TestCase):
         hx = ht.array(x, split=0)
         if not hx.comm.is_distributed():
             pytest.skip("needs a distributed comm")
-        k = 20_000
+        # k must exceed n/p at ANY device count for the large-k route
+        k = 80_000 // hx.comm.size + 7
 
         def boom(*a, **kw):
             raise AssertionError("global lax.top_k used for large k")
@@ -386,6 +387,7 @@ class TestLargeKTopK(TestCase):
         np.testing.assert_allclose(v.numpy(), np.sort(x)[::-1][:k], rtol=1e-6)
         np.testing.assert_allclose(x[i.numpy()], np.sort(x)[::-1][:k], rtol=1e-6)
         self.assert_distributed(v)
+        assert k > 80_000 // hx.comm.size  # premise: the small-k path is ineligible
 
     def test_large_k_smallest(self):
         x = rng.standard_normal(40_001).astype(np.float32)  # ragged
