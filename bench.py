@@ -399,9 +399,11 @@ def main(state: dict = None) -> dict:
         snapshot()
 
     # --- kernel-on vs kernel-off (VERDICT r4 #2: the Pallas E-step must
-    # earn its keep in the benched workload or stay opt-out) -------------- #
+    # earn its keep in the benched workload or stay opt-out).  A-B at 2^23:
+    # beyond that the narrow-d relayout gate (kmeans_kernels._layout_bytes)
+    # silently falls the 'pallas' arm back to jnp and the A-B is vacuous --- #
     if largest is not None and not skip("kmeans_kernel_ab", 0.12):
-        n_ab = 2 ** min(largest, 26)
+        n_ab = 2 ** min(largest, 23)
         try:
             t_on = _kmeans_attempt(n_ab, timed_iters=6, assign_kernel="pallas")
             t_off = _kmeans_attempt(n_ab, timed_iters=6, assign_kernel="jnp")
@@ -410,6 +412,57 @@ def main(state: dict = None) -> dict:
             extra["kmeans_kernel_speedup"] = round(t_off / t_on, 3)
         except Exception as e:
             extra["kmeans_kernel_ab_error"] = str(e)[:120]
+        snapshot()
+
+    # --- flash attention: Pallas kernel vs dense XLA local attention ------ #
+    # (B,H,S,d) = (4,8,4096,64) causal bf16, slope-timed (chained lax.scan
+    # at two lengths so the tunnel dispatch constant cancels)
+    if not skip("flash_attention_ab", 0.1):
+        try:
+            import jax.numpy as jnp
+
+            from heat_tpu.ops.flash_attention import _dense_attention, flash_attention
+            from heat_tpu.utils.profiler import timeit_min
+
+            B, H, S, d = 4, 8, 4096, 64
+            key = jax.random.key(0)
+            qkv = [
+                jax.random.normal(jax.random.fold_in(key, i), (B, H, S, d), jnp.bfloat16)
+                for i in range(3)
+            ]
+
+            def slope_time(f):
+                def chain(iters):
+                    @jax.jit
+                    def run(q, k, v):
+                        def body(c, _):
+                            return f(c, k, v), None
+
+                        c, _ = jax.lax.scan(body, q, None, length=iters)
+                        return c
+
+                    return run
+
+                lo, hi = 2, 12
+                rl, rh = chain(lo), chain(hi)
+                for r in (rl, rh):  # compile + warm
+                    float(jnp.abs(r(*qkv)).sum())
+                t_lo = timeit_min(lambda: float(jnp.abs(rl(*qkv)).sum()), reps=2)
+                t_hi = timeit_min(lambda: float(jnp.abs(rh(*qkv)).sum()), reps=2)
+                s = (t_hi - t_lo) / (hi - lo)
+                if s <= 0:
+                    raise RuntimeError("slope noise-dominated")
+                return s
+
+            t_flash = slope_time(lambda q, k, v: flash_attention(q, k, v, causal=True))
+            t_dense = slope_time(
+                lambda q, k, v: _dense_attention(q, k, v, True, d**-0.5, S)
+            )
+            extra["attn_4x8x4096x64_causal_flash_ms"] = round(t_flash * 1e3, 3)
+            extra["attn_4x8x4096x64_causal_dense_ms"] = round(t_dense * 1e3, 3)
+            extra["flash_attention_speedup"] = round(t_dense / t_flash, 3)
+        except Exception as e:
+            extra["flash_attention_ab_error"] = str(e)[:120]
         snapshot()
 
     # --- BASELINE config[2] scale: 1e8×32 with bf16 storage --------------- #

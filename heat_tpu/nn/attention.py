@@ -100,6 +100,12 @@ class MultiheadAttention(Module):
 
         if ring:
             out = ring_attention(qh, kh, vh, self.comm, causal=causal)
+        elif qh.shape == kh.shape == vh.shape:
+            # local self-attention: flash-fused Pallas kernel on TPU (the
+            # (S, S) score matrix never reaches HBM), dense-jnp elsewhere
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(qh, kh, vh, causal=causal)
         else:
             out = _global_attention(qh, kh, vh, causal, 1.0 / (self.head_dim**0.5))
         B, H, S, d = out.shape

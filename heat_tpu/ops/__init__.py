@@ -3,10 +3,13 @@
 XLA's fusion covers most of the ops surface; these kernels target the spots
 where manual control of the VMEM working set wins (SURVEY §2.7): the KMeans
 assignment step (cdist+argmin fused so the (n, k) distance matrix never
-touches HBM).  Every kernel has a jnp fallback and is selected automatically
-(`interpret=True` on CPU so the same code path is testable on the dev mesh).
+touches HBM) and local softmax attention (flash-restructured so the (S, S)
+score matrix never touches HBM).  Every kernel has a jnp fallback and is
+selected automatically (`interpret=True` on CPU so the same code path is
+testable on the dev mesh).
 """
 
+from .flash_attention import flash_attention
 from .kmeans_kernels import fused_assign, fused_em_stats
 
-__all__ = ["fused_assign", "fused_em_stats"]
+__all__ = ["flash_attention", "fused_assign", "fused_em_stats"]

@@ -13,11 +13,23 @@ per-tile in VMEM.
 Both are WIRED into ``cluster.KMeans`` via ``assign_kernel='pallas'``
 (fit: fused E+M on both the sharded and global paths; predict: fused
 assign), with the jnp path as ``'jnp'`` and the measured-faster default as
-``'auto'``.  Measured on v5e round 3 (1M×32, k=64): XLA's fusion of the
-jnp form ran at ~4.8 ms vs ~14.6 ms for the assign-only kernel — the
-bench's kernel-on/off A-B rows (``kmeans_*_kernel_*``) re-measure the
-fused E+M kernel each round; flip ``'auto'`` in ``KMeans.__init__`` if it
-inverts.
+``'auto'``.
+
+**Measured verdict (v5e, round 4)**: XLA's fusion of the jnp form wins
+this workload at every tested geometry — 18.6 vs 16.8 it/s at 2^23×32
+k=64 f32 (the kernel's best, TILE=4096), 0.25×/0.48× at d=128/256 —
+so ``'auto'`` stays ``'jnp'`` and the kernel remains an opt-in, A-B'd by
+``bench.py`` every round.  Two hardware reasons, kept here for the next
+tuner: (1) a ``d < 128`` input forces Pallas to relayout X into the
+128-lane tiled layout — a ``128/d``× padded HBM copy per call (at
+1e8×32 bf16 that copy alone is 25.6 GiB — OOM; the `_relayout_copy_bytes`
+gate below falls back to jnp before that happens), while XLA's fused path
+keeps X in its native packed layout; (2) at the E-step's shapes the MXU
+contraction is shallow (k=64 output, d-deep) and XLA's pipelining of the
+two fused GEMM passes beats the kernel's sequential grid.  Contrast
+``flash_attention``, where the same Pallas treatment WINS ~4.5× — the
+difference is attention's (S, S) intermediate actually disappears,
+whereas KMeans' (n, k) intermediate was already fused away by XLA.
 """
 
 from __future__ import annotations
@@ -37,7 +49,19 @@ except ImportError:  # pragma: no cover
 
 __all__ = ["fused_assign", "fused_em_stats"]
 
-_TILE = 1024
+_TILE = 4096  # 4096 measured 4x faster than 1024 on v5e (grid-step amortization)
+
+
+def _relayout_copy_bytes(n_rows: int, d: int, itemsize: int) -> int:
+    """HBM bytes of the relayout copy Pallas forces for a non-lane-aligned
+    trailing dim: d % 128 != 0 pads every row to the 128-lane tile, so a
+    FULL padded copy of X materializes (the silent 4x blowup that OOMs
+    1e8x32 bf16).  Lane-aligned d needs no copy — returns 0 so an explicit
+    ``assign_kernel='pallas'`` opt-in is honored at any size there."""
+    if d % 128 == 0:
+        return 0
+    lanes = -(-d // 128) * 128
+    return n_rows * lanes * itemsize
 
 
 def _assign_kernel(x_ref, c_ref, cc_ref, lab_ref, d2_ref):
@@ -106,15 +130,17 @@ def _em_stats_kernel(n_ref, x_ref, c_ref, cc_ref, sums_ref, counts_ref):
     )
     d2 = jnp.maximum(xx + cc - 2.0 * dots, 0.0)  # (TILE, k)
     lab = jnp.argmin(d2, axis=1)  # (TILE,)
-    # rows at global index ≥ n are pad: contribute nothing
-    gidx = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile,), 0)
-    valid = gidx < n_ref[0]
+    # rows at global index ≥ n are pad: contribute nothing.  The iota MUST
+    # be ≥2-D: Mosaic rejects 1-D iota (the compile error only surfaces on
+    # real TPU hardware — interpret mode accepts it silently)
+    gidx = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+    valid = gidx < n_ref[0]  # (TILE, 1)
     # zero the pad/out-of-bounds rows of x too: a ragged final block reads
     # undefined tile memory, and 0·garbage in the GEMM is only safe when
     # the garbage cannot be inf/NaN — masking x makes it actually zero
-    x = jnp.where(valid[:, None], x, 0.0)
+    x = jnp.where(valid, x, 0.0)
     onehot = ((lab[:, None] == jax.lax.broadcasted_iota(jnp.int32, (tile, k), 1))
-              & valid[:, None]).astype(jnp.float32)
+              & valid).astype(jnp.float32)
     bs = jax.lax.dot_general(  # (k, TILE) @ (TILE, d) on the MXU
         onehot, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -189,6 +215,9 @@ def fused_em_stats(x, centers, n=None):
     vmem = 4 * (2 * k * d + tile * d + 2 * tile * k)
     if vmem > 8 * 2**20:
         return _jnp_em_stats(x, centers, n)
+    # the narrow-d relayout copy (see module docstring) must also fit HBM
+    if _relayout_copy_bytes(rows, d, x.dtype.itemsize) > 6 * 2**30:
+        return _jnp_em_stats(x, centers, n)
     try:
         return _fused_em_stats_impl(x, centers, n, interpret=(platform == "cpu"))
     except Exception:
@@ -234,6 +263,8 @@ def fused_assign(x, centers):
     tile = min(_TILE, n)
     if 4 * (k * d + tile * d + 2 * tile * k) > 8 * 2**20:
         return _jnp_assign(x, centers)  # VMEM-gated (see fused_em_stats)
+    if _relayout_copy_bytes(n, d, x.dtype.itemsize) > 6 * 2**30:
+        return _jnp_assign(x, centers)  # narrow-d relayout copy must fit HBM
     try:
         labels, d2 = _fused_assign_impl(x, centers, interpret=(platform == "cpu"))
     except Exception:

@@ -30,22 +30,24 @@ from jax import lax
 
 __all__ = ["ring_attention", "ring_self_attention"]
 
-# Eager engagement counters — tests assert the ring path (not the global
-# quadratic fallback) handles a given shape.  Incremented per *call* (at
-# trace time when called under an outer jit).
+# Eager engagement counters — tests assert the ring path (K/V rotation over
+# the mesh) handles a given shape.  "global" counts the single-chip local
+# path: no collective, whole sequence on one chip — executed by the Pallas
+# flash kernel on TPU or the dense form elsewhere (ops.flash_attention
+# decides and keeps its own pallas/dense counters).  Incremented per *call*
+# (at trace time when called under an outer jit).
 path_counts = {"ring": 0, "global": 0}
 
 
 def _global_attention(q, k, v, causal, scale):
     """Dense attention: materializes the (Sq, Sk) score block.  Rectangular
     shapes supported (cross-attention callers); the causal mask is top-left
-    aligned (torch ``is_causal``)."""
-    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
-    if causal:
-        Sq, Sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
-        s = jnp.where(mask, s, -jnp.inf)
-    return jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(s, axis=-1), v)
+    aligned (torch ``is_causal``).  Delegates to the shared dense reference
+    in ``ops.flash_attention`` so there is exactly ONE dense softmax path
+    (same fully-masked-row and pad-key semantics everywhere)."""
+    from ..ops.flash_attention import _dense_attention
+
+    return _dense_attention(q, k, v, causal, scale, k.shape[-2])
 
 
 def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):
@@ -72,8 +74,12 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
         )
     axis, size = comm.axis, comm.size
     if size == 1:
+        # degenerate ring: one chip holds the whole sequence — run the
+        # flash-fused local kernel (Pallas on TPU, dense fallback elsewhere)
+        from ..ops.flash_attention import flash_attention
+
         path_counts["global"] += 1
-        return _global_attention(q, k, v, causal, scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     path_counts["ring"] += 1
 
     seq_axis = q.ndim - 2
