@@ -377,22 +377,33 @@ def main(state: dict = None) -> dict:
     snapshot()
 
     # --- BASELINE config[1]: tall-skinny QR (TSQR), 1e6 x 256 f32 --------- #
+    # A-B: 'cholqr2' (the MXU-shaped CholeskyQR2 local factorization, the
+    # 'auto' default for tall blocks) vs 'householder' (XLA's QR — measured
+    # 7 GFLOPS in round 3).  The TSQR program is comm-cached, so warm reps
+    # time factorization, not the per-call retrace+recompile that round-3's
+    # 18.6 s figure mostly was.
     if not skip("qr_tsqr", 0.13):
         try:
             from heat_tpu.utils.profiler import timeit_min
 
             A = ht.random.randn(1_000_000, 256, dtype=ht.float32, split=0)
-            # mode='r': the 2*m*n^2 flop model below covers the
-            # factorization only — timing Q formation too would misstate
-            # throughput ~2x (and double the benchmark cost)
-            rf = ht.linalg.qr(A, mode="r").R  # compile + warm
-            float(rf._jarray.astype("float32")[0, 0])
-            dt = timeit_min(lambda: ht.linalg.qr(A, mode="r").R, reps=2)
-            extra["qr_tsqr_1e6x256_f32_s"] = round(dt, 4)
-            # TSQR flop count ~ 2 m n^2 (the dominant local-QR + merge GEMMs)
-            extra["qr_tsqr_1e6x256_gflops"] = round(
-                2.0 * 1_000_000 * 256**2 / dt / 1e9, 1
-            )
+            for meth in ("cholqr2", "householder"):
+                if meth == "householder" and skip("qr_householder", 0.1):
+                    break
+                # mode='r' label: the 2*m*n^2 flop model covers the
+                # factorization only (Q formation would misstate ~2x)
+                rf = ht.linalg.qr(A, mode="r", method=meth).R  # compile+warm
+                float(rf._jarray.astype("float32")[0, 0])
+                dt = timeit_min(
+                    lambda: float(
+                        ht.linalg.qr(A, mode="r", method=meth).R._jarray[0, 0]
+                    ),
+                    reps=2,
+                )
+                extra[f"qr_tsqr_1e6x256_f32_{meth}_s"] = round(dt, 4)
+                extra[f"qr_tsqr_1e6x256_{meth}_gflops"] = round(
+                    2.0 * 1_000_000 * 256**2 / dt / 1e9, 1
+                )
             del A, rf
         except Exception as e:
             extra["qr_tsqr_error"] = str(e)[:100]
@@ -400,7 +411,7 @@ def main(state: dict = None) -> dict:
 
     # --- kernel-on vs kernel-off (VERDICT r4 #2: the Pallas E-step must
     # earn its keep in the benched workload or stay opt-out).  A-B at 2^23:
-    # beyond that the narrow-d relayout gate (kmeans_kernels._layout_bytes)
+    # beyond that the narrow-d relayout gate (_relayout_copy_bytes)
     # silently falls the 'pallas' arm back to jnp and the A-B is vacuous --- #
     if largest is not None and not skip("kmeans_kernel_ab", 0.12):
         n_ab = 2 ** min(largest, 23)

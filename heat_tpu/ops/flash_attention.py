@@ -76,7 +76,7 @@ def _dense_attention(q, k, v, causal: bool, scale: float, s_valid: int):
     return jnp.einsum("...qk,...kd->...qd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                   *, scale: float, causal: bool, s_valid: int,
                   blk_q: int, blk_k: int, nk: int, masked: bool):
     iq = pl.program_id(1)
@@ -99,11 +99,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)  # (blk_q, d)
-        k = k_ref[0].astype(jnp.float32)  # (blk_k, d)
+        # GEMM operands stay in the storage dtype (bf16 rides the MXU's
+        # native input type); accumulation is f32 via preferred_element_type
+        q = q_ref[0]  # (blk_q, d)
+        k = k_ref[0]  # (blk_k, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (blk_q, blk_k) — in VMEM only
+        ) * scale  # (blk_q, blk_k) f32 — in VMEM only
         if masked:
             kv_pos = k_lo + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             mask = kv_pos < s_valid
@@ -119,8 +121,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
         l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
+        # p is cast to v's storage dtype for the PV GEMM (bf16 probabilities
+        # against bf16 values — the standard TPU flash layout); f32 accum
         pv = jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32),
+            p.astype(v_ref.dtype), v_ref[0],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
         acc_scr[:] = acc_scr[:] * corr[:, None] + pv
@@ -130,18 +134,124 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     def _():
         out = acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
         o_ref[0] = out.astype(o_ref.dtype)
+        # logsumexp per row, for the backward recompute (finite even for
+        # fully-masked rows: log(1e-30) ≈ -69, where exp(s - lse) = 0)
+        lse_ref[0] = jnp.where(
+            jnp.isfinite(m_scr[:, 0]), m_scr[:, 0], 0.0
+        ) + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+
+
+def _recompute_p(q, k, lse_row, *, scale, causal, masked, s_valid,
+                 q_lo, k_lo, blk_q, blk_k):
+    """Shared backward-side recompute: p_ij = exp(s_ij - lse_i), with the
+    same masking the forward applied."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if masked:
+        kv_pos = k_lo + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        mask = kv_pos < s_valid
+        if causal:
+            q_pos = q_lo + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            mask = mask & (q_pos >= kv_pos)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - lse_row[:, None])
+    return jnp.where(jnp.isfinite(s), p, 0.0)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                         dq_ref, dq_scr,
+                         *, scale, causal, s_valid, blk_q, blk_k, nk, masked):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_lo, k_lo = iq * blk_q, ik * blk_k
+    live = k_lo < s_valid
+    if causal:
+        live = live & (k_lo <= q_lo + blk_q - 1)
+
+    @pl.when(live)
+    def _():
+        p = _recompute_p(
+            q_ref[0], k_ref[0], lse_ref[0], scale=scale, causal=causal,
+            masked=masked, s_valid=s_valid, q_lo=q_lo, k_lo=k_lo,
+            blk_q=blk_q, blk_k=blk_k,
+        )
+        dp = jax.lax.dot_general(  # dOᵢ · Vⱼᵀ  (blk_q, blk_k)
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(  # dSᵢⱼ · Kⱼ
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr,
+                          *, scale, causal, s_valid, blk_q, blk_k, nq, masked):
+    ik = pl.program_id(1)  # fixed K/V block
+    iq = pl.program_id(2)  # sweeping Q blocks
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_lo, k_lo = iq * blk_q, ik * blk_k
+    live = k_lo < s_valid
+    if causal:
+        live = live & (k_lo <= q_lo + blk_q - 1)
+
+    @pl.when(live)
+    def _():
+        p = _recompute_p(
+            q_ref[0], k_ref[0], lse_ref[0], scale=scale, causal=causal,
+            masked=masked, s_valid=s_valid, q_lo=q_lo, k_lo=k_lo,
+            blk_q=blk_q, blk_k=blk_k,
+        )
+        dv_scr[:] += jax.lax.dot_general(  # Pᵀ · dOᵢ  (blk_k, d)
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0][:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(  # dSᵀ · Qᵢ  (blk_k, d)
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _blocks(Sp: int):
+    blk_q = min(_BLK_Q, _round_up(Sp, 128))
+    blk_k = min(_BLK_K, _round_up(Sp, 128))
+    return blk_q, blk_k, pl.cdiv(Sp, blk_q), pl.cdiv(Sp, blk_k)
 
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "scale", "s_valid", "interpret")
 )
-def _flash_impl(q, k, v, causal: bool, scale: float, s_valid: int,
-                interpret: bool):
+def _flash_fwd_impl(q, k, v, causal: bool, scale: float, s_valid: int,
+                    interpret: bool):
     B, Sp, d = q.shape
-    blk_q = min(_BLK_Q, _round_up(Sp, 128))
-    blk_k = min(_BLK_K, _round_up(Sp, 128))
-    nq = pl.cdiv(Sp, blk_q)
-    nk = pl.cdiv(Sp, blk_k)
+    blk_q, blk_k, nq, nk = _blocks(Sp)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, s_valid=s_valid,
         blk_q=blk_q, blk_k=blk_k, nk=nk,
@@ -155,8 +265,14 @@ def _flash_impl(q, k, v, causal: bool, scale: float, s_valid: int,
             pl.BlockSpec((1, blk_k, d), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, blk_k, d), lambda b, iq, ik: (b, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, iq, ik: (b, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, Sp, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, iq, ik: (b, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Sp, d), q.dtype),
+            jax.ShapeDtypeStruct((B, Sp), jnp.float32),  # logsumexp
+        ],
         scratch_shapes=[
             # (blk_q, 1) not (blk_q,): TPU scratch wants >=2-D tiles
             pltpu.VMEM((blk_q, 1), jnp.float32),
@@ -165,6 +281,79 @@ def _flash_impl(q, k, v, causal: bool, scale: float, s_valid: int,
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "s_valid", "interpret")
+)
+def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
+                    s_valid: int, interpret: bool):
+    B, Sp, d = q.shape
+    blk_q, blk_k, nq, nk = _blocks(Sp)
+    masked = causal or (Sp != s_valid)
+    # D_i = Σ_d dOᵢ ⊙ Oᵢ — one cheap fused elementwise pass, fine in XLA
+    dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, s_valid=s_valid,
+            blk_q=blk_q, blk_k=blk_k, nk=nk, masked=masked,
+        ),
+        grid=(B, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, Sp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd)
+
+    # dk/dv sweep: K/V block fixed per middle grid index, Q blocks stream
+    qspec2 = pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            s_valid=s_valid, blk_q=blk_q, blk_k=blk_k, nq=nq, masked=masked,
+        ),
+        grid=(B, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Sp, d), k.dtype),
+            jax.ShapeDtypeStruct((B, Sp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal: bool, scale: float, s_valid: int,
+           interpret: bool):
+    out, _ = _flash_fwd_impl(q, k, v, causal, scale, s_valid, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, s_valid, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, s_valid, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, s_valid, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, do, causal, scale, s_valid,
+                           interpret)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -216,8 +405,10 @@ def flash_attention(q, k, v, causal: bool = False,
         pad = ((0, 0), (0, Sp - S), (0, 0))
         qf, kf, vf = (jnp.pad(t, pad) for t in (qf, kf, vf))
     try:
-        out = _flash_impl(qf, kf, vf, causal, scale, S,
-                          interpret=(platform == "cpu"))
+        # custom_vjp: jax.grad runs the Pallas backward kernels (dq sweep +
+        # dk/dv sweep) instead of failing out of pallas_call's missing
+        # autodiff rule — training keeps the flash memory profile
+        out = _flash(qf, kf, vf, causal, scale, S, platform == "cpu")
     except Exception:
         path_counts["dense"] += 1
         return _dense_attention(q, k, v, causal, scale, S)

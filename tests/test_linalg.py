@@ -99,6 +99,40 @@ class TestQR(TestCase):
         q, r = ht.linalg.qr(ht.array(a, split=0))
         np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
 
+    def test_qr_methods_agree(self):
+        """cholqr2 (the MXU-shaped round-4 local factorization) and
+        householder produce the same factorization up to column signs, and
+        both are orthogonal to f32 working precision."""
+        rng = np.random.default_rng(11)
+        a = rng.normal(size=(512, 16)).astype(np.float32)
+        ha = ht.array(a, split=0)
+        for method in ("cholqr2", "householder"):
+            q, r = ht.linalg.qr(ha, method=method)
+            np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+            np.testing.assert_allclose(
+                q.numpy().T @ q.numpy(), np.eye(16), atol=1e-4
+            )
+            np.testing.assert_allclose(np.tril(r.numpy(), -1), 0, atol=1e-5)
+
+    def test_qr_cholqr2_illconditioned_fallback(self):
+        """kappa ~ 1e7 breaks the Gram Cholesky (kappa^2 >> 1/eps_f32); the
+        in-jit lax.cond must fall back to Householder per shard and still
+        return an orthogonal Q."""
+        rng = np.random.default_rng(12)
+        u, _ = np.linalg.qr(rng.normal(size=(1024, 16)))
+        v, _ = np.linalg.qr(rng.normal(size=(16, 16)))
+        bad = ((u * np.logspace(0, -7, 16)) @ v).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(bad, split=0), method="cholqr2")
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), bad, atol=1e-5)
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(16), atol=1e-3)
+
+    def test_qr_method_validation(self):
+        import pytest
+
+        a = ht.array(np.eye(8, 4, dtype=np.float32), split=0)
+        with pytest.raises(ValueError):
+            ht.linalg.qr(a, method="bogus")
+
 
 class TestSVD(TestCase):
     def test_tssvd(self):
