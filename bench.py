@@ -394,11 +394,9 @@ def main(state: dict = None) -> dict:
                 # factorization only (Q formation would misstate ~2x)
                 rf = ht.linalg.qr(A, mode="r", method=meth).R  # compile+warm
                 float(rf._jarray.astype("float32")[0, 0])
+                # timeit_min's sync() already blocks on the executable
                 dt = timeit_min(
-                    lambda: float(
-                        ht.linalg.qr(A, mode="r", method=meth).R._jarray[0, 0]
-                    ),
-                    reps=2,
+                    lambda: ht.linalg.qr(A, mode="r", method=meth).R, reps=2
                 )
                 extra[f"qr_tsqr_1e6x256_f32_{meth}_s"] = round(dt, 4)
                 extra[f"qr_tsqr_1e6x256_{meth}_gflops"] = round(

@@ -81,7 +81,14 @@ def _tall_qr(blk, method: str = "auto"):
         res = jnp.linalg.qr(b, mode="reduced")
         return res[0], res[1]  # plain tuple: cond needs matching pytrees
 
-    q, r = lax.cond(ok, lambda _: (q2, r2 @ r1), _householder, None)
+    # R reconstruction at HIGHEST too — a default-precision (bf16-pass)
+    # product here would cap ||A - QR|| at ~bf16 epsilon on TPU
+    q, r = lax.cond(
+        ok,
+        lambda _: (q2, jnp.matmul(r2, r1, precision=hi)),
+        _householder,
+        None,
+    )
     if orig_dtype != q.dtype:
         q, r = q.astype(orig_dtype), r.astype(orig_dtype)
     return q, r
@@ -97,11 +104,12 @@ def _wrap(jarr, split, proto: DNDarray) -> DNDarray:
 
 
 @comm_cached
-def _tsqr_program(comm, method: str):
+def _tsqr_program(comm, method: str, r_only: bool):
     """Jitted TSQR pipeline, cached on the comm (``comm_cached``): a fresh
     shard_map closure per call would force jax to re-trace AND re-compile
     every invocation — the round-3 'qr takes 18 s' measurement was mostly
-    that recompile, not factorization."""
+    that recompile, not factorization.  ``r_only`` (mode='r') skips Q
+    formation entirely — the factorization is then honestly ~2mn² flops."""
     axis = comm.axis
 
     def shard_fn(a_blk):
@@ -109,13 +117,16 @@ def _tsqr_program(comm, method: str):
         # merge: gather all shards' R factors and QR the (p·n, n) stack
         rs = lax.all_gather(r1, axis, axis=0, tiled=True)
         q2, r = jnp.linalg.qr(rs, mode="reduced")
+        if r_only:
+            return (r,)
         my = lax.axis_index(axis)
         q2_blk = lax.dynamic_slice_in_dim(q2, my * r1.shape[0], r1.shape[0], axis=0)
         q = jnp.matmul(q1, q2_blk, precision=lax.Precision.HIGHEST)
         return q, r
 
+    out_splits = ((2, None),) if r_only else ((2, 0), (2, None))
     return jax.jit(
-        comm.shard_map(shard_fn, in_splits=((2, 0),), out_splits=((2, 0), (2, None)))
+        comm.shard_map(shard_fn, in_splits=((2, 0),), out_splits=out_splits)
     )
 
 
@@ -144,7 +155,10 @@ def tsqr(a: DNDarray, mode: str = "reduced", method: str = "auto") -> QR:
         jq, jr = _tall_qr(a0._jarray, method)
         return QR(_wrap(jq, 0, a), _wrap(jr, None, a))
 
-    jq, jr = _tsqr_program(comm, method)(phys)
+    if mode == "r":
+        (jr,) = _tsqr_program(comm, method, True)(phys)
+        return QR(None, _wrap(jr, None, a))
+    jq, jr = _tsqr_program(comm, method, False)(phys)
     if phys.shape[0] != m:
         # Q's pad rows are exactly zero; keep the padded physical (pad=Mp-m)
         q_d = DNDarray(
@@ -196,4 +210,6 @@ def qr(a: DNDarray, mode: str = "reduced", procs_to_merge: int = 2,
     return QR(res.Q, res.R)
 
 
-DNDarray.qr = lambda self, mode="reduced": qr(self, mode=mode)
+DNDarray.qr = lambda self, mode="reduced", method="auto": qr(
+    self, mode=mode, method=method
+)

@@ -100,19 +100,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(live)
     def _():
         # GEMM operands stay in the storage dtype (bf16 rides the MXU's
-        # native input type); accumulation is f32 via preferred_element_type
-        q = q_ref[0]  # (blk_q, d)
-        k = k_ref[0]  # (blk_k, d)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (blk_q, blk_k) f32 — in VMEM only
-        if masked:
-            kv_pos = k_lo + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            mask = kv_pos < s_valid
-            if causal:
-                q_pos = q_lo + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-                mask = mask & (q_pos >= kv_pos)
-            s = jnp.where(mask, s, -jnp.inf)
+        # native input type); accumulation is f32 via preferred_element_type.
+        # s: (blk_q, blk_k) f32 — in VMEM only
+        s = _masked_scores(
+            q_ref[0], k_ref[0], scale=scale, causal=causal, masked=masked,
+            s_valid=s_valid, q_lo=q_lo, k_lo=k_lo, blk_q=blk_q, blk_k=blk_k,
+        )
         m_prev = m_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         # fully-masked-so-far rows keep m=-inf; exp against a safe 0 stays 0
@@ -141,10 +134,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         ) + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
 
 
-def _recompute_p(q, k, lse_row, *, scale, causal, masked, s_valid,
-                 q_lo, k_lo, blk_q, blk_k):
-    """Shared backward-side recompute: p_ij = exp(s_ij - lse_i), with the
-    same masking the forward applied."""
+def _masked_scores(q, k, *, scale, causal, masked, s_valid,
+                   q_lo, k_lo, blk_q, blk_k):
+    """THE score+mask computation — forward and backward share this one
+    definition, so the masking convention can never silently diverge
+    between the saved lse and the backward recompute."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -155,6 +149,12 @@ def _recompute_p(q, k, lse_row, *, scale, causal, masked, s_valid,
             q_pos = q_lo + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             mask = mask & (q_pos >= kv_pos)
         s = jnp.where(mask, s, -jnp.inf)
+    return s
+
+
+def _recompute_p(q, k, lse_row, **kw):
+    """Backward-side recompute: p_ij = exp(s_ij - lse_i)."""
+    s = _masked_scores(q, k, **kw)
     p = jnp.exp(s - lse_row[:, None])
     return jnp.where(jnp.isfinite(s), p, 0.0)
 

@@ -715,6 +715,8 @@ class TestTransformerEncoder:
         l0 = float(loss(p))
         step = jax.jit(lambda pp: jax.tree.map(
             lambda w, g: w - 0.1 * g, pp, jax.grad(loss)(pp)))
-        for _ in range(5):
+        # 2 steps suffice for the loss-decrease check; each step executes
+        # the flash bwd kernels in interpret mode on CPU (slow per step)
+        for _ in range(2):
             p = step(p)
         assert float(loss(p)) < l0
