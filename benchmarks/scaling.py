@@ -40,9 +40,26 @@ b = ht.random.randn(1024, 1024, split=1)
 results["matmul_1024_s0xs1"] = timed(lambda: a @ b)
 m = ht.random.randn(1024, 1024, split=0)
 results["resplit_1024_0to1"] = timed(lambda: m.resplit(1))
+# round-4b: TSQR with the CholeskyQR2 local factorization (comm-cached
+# program — warm timing measures factorization, not retrace)
+ta = ht.random.randn(2**18, 64, split=0)
+ht.linalg.qr(ta, mode="r").R  # compile
+results["tsqr_262k_64_r"] = timed(lambda: ht.linalg.qr(ta, mode="r").R)
 v = ht.random.randn(2**20, split=0)
 results["sort_1M"] = timed(lambda: ht.sort(v, method="global")[0])
 if n_dev >= 2:
+    # round-4b: sequence-parallel exact attention — S/p per device, K/V on
+    # the ppermute ring (the CPU mesh shows the algorithmic scaling; the
+    # Pallas flash local path is TPU-only and A-B'd in bench.py)
+    from heat_tpu.parallel.ring_attention import ring_attention
+    import jax.numpy as _rjnp
+    _rq = _rjnp.asarray(_np.random.default_rng(5).normal(size=(2, 4, 4096, 32)), _rjnp.float32)
+    comm = ht.communication.get_comm()
+    _rqs = comm.shard(_rq, 2)
+    _ring = jax.jit(lambda t: ring_attention(t, t, t, comm, causal=True))
+    _ring(_rqs)  # compile
+    results["ring_attn_2x4x4096x32"] = timed(lambda: _ring(_rqs))
+
     # the static-shape sample sort (SURVEY hard part #3) vs the global sort:
     # same input, distributed path keeps O(n/p) memory per shard
     results["sample_sort_1M"] = timed(lambda: ht.sort(v, method="sample")[0])
@@ -143,9 +160,14 @@ def main() -> None:
         "per shard — improves with mesh width); percentile_bisect_1M = "
         "exact order statistics, no sort. dp_mlp_step_256 = sync "
         "DataParallel step; daso_mlp_step_256 = hierarchical DASO step on "
-        "an (n/2)x2 mesh. Recorded round 4, 2026-07-30; round-4 rows: descending sample sort, distributed unique/searchsorted/large-k topk. TPU single-chip "
-        "numbers live in BENCH_r03.json; multi-chip ICI scaling requires a "
-        "pod (unavailable: one tunneled v5e chip)."
+        "an (n/2)x2 mesh. Recorded round 4, 2026-07-30; round-4 rows: "
+        "descending sample sort, distributed unique/searchsorted/large-k "
+        "topk; round-4b rows: tsqr_262k_64_r (CholeskyQR2 local "
+        "factorization, comm-cached program) and ring_attn_2x4x4096x32 "
+        "(sequence-parallel exact attention, S/p per device — improves "
+        "with mesh width even on the shared-memory mesh). TPU single-chip "
+        "numbers live in BENCH_r03.json (BENCH_r04.json once the driver records this round); multi-chip ICI "
+        "scaling requires a pod (unavailable: one tunneled v5e chip)."
     )}))
 
 

@@ -129,7 +129,7 @@ class _TransformerBlock(nn.Module):
     parallel ring (long contexts scale with the mesh)."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
-                 causal: bool = False, comm=None):
+                 causal: bool = False, comm=None, remat: bool = False):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
@@ -141,6 +141,8 @@ class _TransformerBlock(nn.Module):
             nn.Linear(mlp_ratio * embed_dim, embed_dim),
         )
         self.causal = causal
+        self.remat = remat
+        self._remat_fns = {}  # train -> jitted checkpointed block
 
     def init(self, key):
         import jax
@@ -151,16 +153,41 @@ class _TransformerBlock(nn.Module):
             "ln2": self.ln2.init(k3), "ff": self.ff.init(k4),
         }
 
+    def _block(self, params, x, k1, k2, train):
+        h = x + self.mha.apply(
+            params["mha"], self.ln1.apply(params["ln1"], x),
+            causal=self.causal, train=train, key=k1,
+        )
+        return h + self.ff.apply(
+            params["ff"], self.ln2.apply(params["ln2"], h),
+            train=train, key=k2,
+        )
+
     def apply(self, params, x, *, train: bool = False, key=None):
         k1 = k2 = None
         if key is not None:
             import jax
 
             k1, k2 = jax.random.split(key)
-        h = x + self.mha.apply(params["mha"], self.ln1.apply(params["ln1"], x),
-                               causal=self.causal, train=train, key=k1)
-        return h + self.ff.apply(params["ff"], self.ln2.apply(params["ln2"], h),
-                                 train=train, key=k2)
+
+        if self.remat:
+            # rematerialize the block under grad: activations are recomputed
+            # in the backward pass instead of living in HBM for the whole
+            # forward — the standard TPU trade of FLOPs for HBM that makes
+            # depth x sequence-length checkpointing work.  jax.checkpoint is
+            # the mechanism; the jit around it is REQUIRED (checkpoint's
+            # closed_call cannot evaluate eagerly inside the ring path's
+            # shard_map) and is cached per train flag so repeat applies
+            # reuse one compiled/traced wrapper instead of re-tracing.
+            import jax
+
+            fn = self._remat_fns.get(train)
+            if fn is None:
+                fn = self._remat_fns[train] = jax.jit(jax.checkpoint(
+                    lambda p, xx, a, b: self._block(p, xx, a, b, train)
+                ))
+            return fn(params, x, k1, k2)
+        return self._block(params, x, k1, k2, train)
 
 
 def transformer_encoder(
@@ -170,6 +197,7 @@ def transformer_encoder(
     mlp_ratio: int = 4,
     causal: bool = False,
     comm=None,
+    remat: bool = False,
 ) -> nn.Module:
     """A stack of pre-norm transformer blocks over (B, S, embed_dim) input.
 
@@ -180,9 +208,14 @@ def transformer_encoder(
     SURVEY §2.8 honest-scope note), built entirely from this framework's
     native modules; with ``comm`` every block's attention runs
     sequence-parallel on the mesh ring, so context length scales with the
-    chip count.
+    chip count.  ``remat=True`` wraps each block in ``jax.checkpoint`` so
+    training recomputes block activations in the backward pass instead of
+    holding depth × (B, S, E) of them in HBM — combine with the flash
+    local kernel (which already never materializes (S, S)) for the full
+    long-context memory story.
     """
     return nn.Sequential(
-        *[_TransformerBlock(embed_dim, num_heads, mlp_ratio, causal, comm)
+        *[_TransformerBlock(embed_dim, num_heads, mlp_ratio, causal, comm,
+                            remat=remat)
           for _ in range(depth)]
     )

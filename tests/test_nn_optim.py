@@ -720,3 +720,29 @@ class TestTransformerEncoder:
         for _ in range(2):
             p = step(p)
         assert float(loss(p)) < l0
+
+    def test_remat_same_values_and_grads(self):
+        """remat=True (jax.checkpoint per block) must be a pure memory/FLOPs
+        trade: identical outputs AND gradients."""
+        import jax
+        import jax.numpy as jnp
+
+        p = None
+        grads, vals = {}, {}
+        x = jnp.asarray(
+            np.random.default_rng(3).standard_normal((2, 17, 16)), jnp.float32
+        )
+        for remat in (False, True):
+            m = ht.nn.models.transformer_encoder(16, 2, depth=2, remat=remat)
+            if p is None:
+                p = m.init(jax.random.key(0))
+            loss = lambda pp: jnp.mean(m.apply(pp, x) ** 2)
+            vals[remat] = float(loss(p))
+            grads[remat] = jax.grad(loss)(p)
+        # remat runs under its own jit (required for the shard_map ring
+        # combo), so last-ULP fusion differences are expected — tolerance,
+        # not bitwise equality
+        np.testing.assert_allclose(vals[False], vals[True], rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads[False]), jax.tree.leaves(grads[True])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
