@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from . import types
+from ._cache import comm_cached
 from .dndarray import DNDarray
 from .sanitation import sanitize_in
 
@@ -56,25 +57,35 @@ def _halo_body(a: DNDarray, jv: jnp.ndarray, offset: int) -> jnp.ndarray:
     physical result aligned with the signal's shards.
     """
     global _HALO_CONV_RUNS
-    from ..parallel.halo import halo_exchange
-
     comm = a.comm
-    m = jv.shape[0]
-    h = m - 1
     # pads are DEAD data, not guaranteed zero (elementwise fast paths leave
     # f(0) garbage there) — mask to the conv zero-padding this path relies on
     phys = a._masked(0).astype(jv.dtype)
+    body = _halo_conv_program(comm, int(jv.shape[0]), offset)(phys, jv)
+    _HALO_CONV_RUNS += 1
+    return body
 
-    def shard_fn(blk):
+
+@comm_cached
+def _halo_conv_program(comm, m: int, offset: int):
+    """Jitted + comm-cached halo-convolve pipeline (the TSQR recompile
+    lesson applied to the op surface: convolve is called eagerly, so a
+    fresh shard_map per call would recompile every time).  The kernel rides
+    as a replicated argument, not a closure constant, so one program serves
+    every kernel of length ``m``."""
+    from ..parallel.halo import halo_exchange
+
+    h = m - 1
+
+    def shard_fn(blk, jv):
         prev, nxt = halo_exchange(blk, h, comm.axis, comm.size, 0)
         ext = jnp.concatenate([prev, blk, nxt], axis=0)
         val = _conv1d_valid(ext, jv)  # c + m - 1 rows: G[lo : lo + c + m - 1]
-        c = blk.shape[0]
-        return jax.lax.dynamic_slice_in_dim(val, offset, c)
+        return jax.lax.dynamic_slice_in_dim(val, offset, blk.shape[0])
 
-    body = comm.shard_map(shard_fn, in_splits=((1, 0),), out_splits=(1, 0))(phys)
-    _HALO_CONV_RUNS += 1
-    return body
+    return jax.jit(comm.shard_map(
+        shard_fn, in_splits=((1, 0), (1, None)), out_splits=(1, 0)
+    ))
 
 
 def convolve(a: DNDarray, v: DNDarray, mode: str = "full", stride: int = 1) -> DNDarray:

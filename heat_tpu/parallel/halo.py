@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core._cache import comm_cached
+
 __all__ = ["halo_exchange", "with_halos"]
 
 
@@ -43,6 +45,12 @@ def with_halos(array: jax.Array, halo_size: int, split_axis: int, comm) -> jax.A
     """Global array → per-shard blocks extended with neighbor halos, returned
     as a global array of shape ``gshape + 2*halo*size`` along ``split_axis``
     (each shard's slab is ``[halo_prev | local | halo_next]``)."""
+    return _with_halos_program(comm, halo_size, split_axis, array.ndim)(array)
+
+
+@comm_cached
+def _with_halos_program(comm, halo_size: int, split_axis: int, nd: int):
+    """Jitted + comm-cached (eager repeat calls reuse the compiled program)."""
     axis = comm.axis
     size = comm.size
 
@@ -50,7 +58,6 @@ def with_halos(array: jax.Array, halo_size: int, split_axis: int, comm) -> jax.A
         prev, nxt = halo_exchange(blk, halo_size, axis, size, split_axis)
         return jnp.concatenate([prev, blk, nxt], axis=split_axis)
 
-    mapped = comm.shard_map(
-        shard_fn, in_splits=((array.ndim, split_axis),), out_splits=(array.ndim, split_axis)
-    )
-    return mapped(array)
+    return jax.jit(comm.shard_map(
+        shard_fn, in_splits=((nd, split_axis),), out_splits=(nd, split_axis)
+    ))

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core._cache import comm_cached
+
 __all__ = ["ring_map"]
 
 
@@ -33,6 +35,21 @@ def ring_map(
     mesh axis; returns the global result with per-step outputs combined
     along ``concat_axis`` (``combine='concat'``) or summed (``'sum'``).
     """
+    return _ring_map_program(
+        comm, fn, combine, concat_axis, stationary.ndim, rotating.ndim
+    )(stationary, rotating)
+
+
+@comm_cached
+def _ring_map_program(comm, fn, combine, concat_axis, nd_stat, nd_rot):
+    """Jitted + comm-cached ring program.  Keyed on the step ``fn``'s
+    identity — pass a stable (module-level) function to reuse the compiled
+    pipeline across calls; a fresh lambda per call still works but
+    recompiles (bounded by the cache's LRU).  NOTE the retention flip side:
+    the cache strongly pins ``fn`` — including anything its closure
+    captures (large arrays!) — plus the compiled executable, until LRU
+    eviction or the comm's death.  Keep per-call closures small, or pass a
+    module-level fn and thread extra operands through ``stationary``."""
     axis = comm.axis
     size = comm.size
 
@@ -56,9 +73,8 @@ def ring_map(
         outs = outs[inv]
         return jnp.concatenate([outs[i] for i in range(size)], axis=concat_axis)
 
-    mapped = comm.shard_map(
+    return jax.jit(comm.shard_map(
         shard_fn,
-        in_splits=((stationary.ndim, 0), (rotating.ndim, 0)),
-        out_splits=(stationary.ndim, 0),
-    )
-    return mapped(stationary, rotating)
+        in_splits=((nd_stat, 0), (nd_rot, 0)),
+        out_splits=(nd_stat, 0),
+    ))

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core._cache import comm_cached
+
 __all__ = ["ring_attention", "ring_self_attention"]
 
 # Eager engagement counters — tests assert the ring path (K/V rotation over
@@ -92,7 +94,24 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
         q = jnp.pad(q, widths)
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
-    masked = causal or pad > 0
+
+    out = _ring_program(comm, causal, scale, S, q.ndim)(q, k, v)
+    if pad:
+        out = lax.slice_in_dim(out, 0, S, axis=seq_axis)
+    return out
+
+
+@comm_cached
+def _ring_program(comm, causal: bool, scale: float, S: int, nd: int):
+    """Jitted + comm-cached ring pipeline (same recompile lesson as TSQR:
+    a fresh shard_map closure per eager call would retrace AND recompile
+    every invocation — MultiheadAttention's ring path calls this eagerly).
+    Keyed on (causal, scale, S, ndim); dtype/leading-shape changes retrace
+    under the cached jit wrapper."""
+    axis, size = comm.axis, comm.size
+    seq_axis = nd - 2
+    blk = -(-S // size)
+    masked = causal or (blk * size != S)
 
     def shard_fn(q_blk, k_blk, v_blk):
         # q_blk: (..., blk, d) — all math broadcasts over the leading axes
@@ -143,16 +162,11 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
         )
         return acc / jnp.maximum(l, 1e-30)[..., None]
 
-    nd = q.ndim
-    mapped = comm.shard_map(
+    return jax.jit(comm.shard_map(
         shard_fn,
         in_splits=((nd, seq_axis),) * 3,
         out_splits=(nd, seq_axis),
-    )
-    out = mapped(q, k, v)
-    if pad:
-        out = lax.slice_in_dim(out, 0, S, axis=seq_axis)
-    return out
+    ))
 
 
 def ring_self_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):

@@ -109,8 +109,14 @@ def cdist_ring(x: DNDarray, y: Optional[DNDarray] = None) -> DNDarray:
     ):
         return cdist(x, y, quadratic_expansion=True)
 
-    def step(x_blk, y_blk, src):
-        return jnp.sqrt(_sq_euclid(x_blk, y_blk))
-
-    d = ring_map(step, x._jarray, y._jarray, comm, combine="concat", concat_axis=1)
+    d = ring_map(
+        _cdist_ring_step, x._jarray, y._jarray, comm,
+        combine="concat", concat_axis=1,
+    )
     return _wrap(d, 0, x)
+
+
+def _cdist_ring_step(x_blk, y_blk, src):
+    # module-level (stable identity) so ring_map's comm-cached program is
+    # reused across cdist_ring calls instead of recompiling per call
+    return jnp.sqrt(_sq_euclid(x_blk, y_blk))
