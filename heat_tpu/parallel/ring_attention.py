@@ -66,6 +66,16 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
     S, d = q.shape[-2:]
     if scale is None:
         scale = 1.0 / (d**0.5)
+    try:
+        # scale is baked into the compiled program (and into the comm cache
+        # key), so it must be a static scalar; concrete jnp scalars coerce
+        scale = float(scale)
+    except Exception as e:
+        raise TypeError(
+            "ring_attention's scale must be a static Python/NumPy scalar — "
+            "it is compiled into the cached ring program; a traced value "
+            "(e.g. a jit argument) is not supported"
+        ) from e
     if k.shape != q.shape or v.shape != q.shape:
         # the sharded ring path has no broadcast semantics (each operand is
         # split with q's spec); demand identical shapes up front
