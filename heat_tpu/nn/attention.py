@@ -30,10 +30,18 @@ class MultiheadAttention(Module):
     ``apply`` runs the sequence-parallel ring path over that communicator's
     mesh.
 
-    ``apply(params, x, kv=None, causal=False)`` performs self-attention on
-    ``x`` (B, S, E), or cross-attention against ``kv`` when given (dense
-    path only — the ring rotates K/V with q's sharding, which requires the
-    sequence axes to agree).
+    ``apply(params, x, kv=None, causal=False, key_padding_mask=None,
+    attn_mask=None)`` performs self-attention on ``x`` (B, S, E), or
+    cross-attention against ``kv`` when given (dense path only — the ring
+    rotates K/V with q's sharding, which requires the sequence axes to
+    agree).
+
+    Masks follow torch semantics: ``key_padding_mask`` (B, S_k) bool with
+    True = ignore that key; ``attn_mask`` (S_q, S_k) bool (True = NOT
+    allowed) or float (added to the scores).  Masked calls run the dense
+    local path — the flash kernel fast-path covers the causal/no-mask
+    cases, and the ring path does not accept per-element masks (shard the
+    sequence and rely on ``causal=``, or mask inputs upstream).
     """
 
     def __init__(
@@ -76,9 +84,44 @@ class MultiheadAttention(Module):
         B, S, _ = t.shape
         return t.reshape(B, S, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
 
-    def apply(self, params, x, *, kv=None, causal: bool = False, train: bool = False, key=None):
+    def _masked_dense(self, qh, kh, vh, causal, key_padding_mask, attn_mask):
+        """Compose torch-convention masks into ONE additive bias and run the
+        framework's single dense softmax path (``_dense_attention`` — which
+        also owns the differentiable fully-masked-row semantics: 0 output,
+        NaN-free gradients; torch returns NaN rows there)."""
+        from ..ops.flash_attention import _dense_attention
+
+        Sk = kh.shape[-2]
+        neg = -jnp.inf
+        bias = jnp.zeros((), jnp.float32)
+        if attn_mask is not None:
+            attn_mask = jnp.asarray(attn_mask)
+            if attn_mask.dtype == jnp.bool_:
+                # torch bool semantics: True = NOT allowed
+                bias = bias + jnp.where(attn_mask, neg, 0.0)
+            else:
+                bias = bias + attn_mask.astype(jnp.float32)
+        if key_padding_mask is not None:
+            kpm = jnp.asarray(key_padding_mask, bool)  # (B, S_k), True=ignore
+            bias = bias + jnp.where(kpm[:, None, None, :], neg, 0.0)
+        return _dense_attention(
+            qh, kh, vh, causal, 1.0 / (self.head_dim**0.5), Sk, bias=bias
+        )
+
+    def apply(self, params, x, *, kv=None, causal: bool = False,
+              key_padding_mask=None, attn_mask=None,
+              train: bool = False, key=None):
         E = self.embed_dim
-        ring = self.comm is not None and kv is None
+        masked = key_padding_mask is not None or attn_mask is not None
+        if masked and kv is None and self.comm is not None and self.comm.size > 1:
+            # cross-attention (kv given) never rides the ring, so masks are
+            # fine there — only the self-attention ring path rejects them
+            raise ValueError(
+                "key_padding_mask/attn_mask are not supported on the "
+                "sequence-parallel ring path — use causal=, or mask the "
+                "inputs before the layer"
+            )
+        ring = self.comm is not None and kv is None and not masked
         if ring:
             # sequence-shard the INPUT: the QKV projections are pointwise
             # along S, so GSPMD keeps them (and the output projection below)
@@ -100,6 +143,8 @@ class MultiheadAttention(Module):
 
         if ring:
             out = ring_attention(qh, kh, vh, self.comm, causal=causal)
+        elif masked:
+            out = self._masked_dense(qh, kh, vh, causal, key_padding_mask, attn_mask)
         elif qh.shape == kh.shape == vh.shape:
             # local self-attention: flash-fused Pallas kernel on TPU (the
             # (S, S) score matrix never reaches HBM), dense-jnp elsewhere

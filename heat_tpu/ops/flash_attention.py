@@ -59,20 +59,34 @@ def _round_up(n: int, m: int) -> int:
 path_counts = {"pallas": 0, "dense": 0}
 
 
-def _dense_attention(q, k, v, causal: bool, scale: float, s_valid: int):
-    """Reference dense path: materializes the (Sq, Sk) scores.  ``s_valid``
-    masks trailing pad *keys* (positions >= s_valid never attend)."""
+def _dense_attention(q, k, v, causal: bool, scale: float, s_valid: int,
+                     bias=None):
+    """THE dense softmax path — every non-flash attention route in the
+    framework composes into this one function so masked-row semantics can
+    never diverge.  ``s_valid`` masks trailing pad *keys* (positions >=
+    s_valid never attend); ``bias`` is an optional additive score bias
+    (broadcastable to (..., Sq, Sk)) carrying user masks — torch-style
+    bool masks should be pre-converted to 0/-inf.
+
+    Fully-masked rows emit 0, and do so DIFFERENTIABLY: the all--inf row is
+    sanitized to zeros *before* the softmax (an after-the-fact ``where``
+    would leak NaN through the backward pass — 0·NaN = NaN in the vjp)."""
     s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     Sq, Sk = s.shape[-2], s.shape[-1]
-    mask = jnp.ones((Sq, Sk), bool)
+    if bias is not None:
+        s = s + bias
+    mask = None
     if s_valid < Sk:
-        mask = mask & (jnp.arange(Sk)[None, :] < s_valid)
+        mask = jnp.zeros((Sq, Sk), bool) | (jnp.arange(Sk)[None, :] < s_valid)
     if causal:
-        mask = mask & (jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :])
-    s = jnp.where(mask, s, -jnp.inf)
+        cm = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+        mask = cm if mask is None else (mask & cm)
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    alive = jnp.isfinite(s).any(axis=-1, keepdims=True)
+    s = jnp.where(alive, s, 0.0)  # sanitize BEFORE softmax (NaN-free vjp)
     p = jax.nn.softmax(s, axis=-1)
-    # rows with every key masked: softmax yields NaN; emit 0 like the ring
-    p = jnp.where(jnp.isfinite(s).any(axis=-1, keepdims=True), p, 0.0)
+    p = jnp.where(alive, p, 0.0)
     return jnp.einsum("...qk,...kd->...qd", p, v)
 
 

@@ -15,8 +15,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# the table is a static-API artifact — never touch an accelerator for it
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the table is a static-API artifact — never touch an accelerator for it.
+# setdefault is NOT enough: the axon environment exports JAX_PLATFORMS=axon
+# and its site injection probes the tunnel anyway, so a wedged relay hangs
+# the script on first device use.  jax.config.update BEFORE any device
+# probe is the only reliable pin (same lesson as tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 

@@ -596,6 +596,108 @@ class TestAttentionModule:
         got = np.asarray(ring.apply(params, x, causal=True))
         np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
 
+    def test_key_padding_mask_matches_torch(self):
+        """Round-4b torch-parity masks: key_padding_mask (True = ignore)."""
+        import jax
+        import torch
+
+        E, H = 16, 2
+        mha = ht.nn.MultiheadAttention(E, H)
+        params = mha.init(jax.random.key(3))
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((3, 9, E)).astype(np.float32)
+        kpm = np.zeros((3, 9), bool)
+        kpm[0, 5:] = True   # batch 0 ignores its tail keys
+        kpm[2, :2] = True
+        ours = np.asarray(mha.apply(params, x, key_padding_mask=kpm))
+        m = self._torch_mha(E, H, params)
+        with torch.no_grad():
+            want, _ = m(*(torch.from_numpy(x),) * 3,
+                        key_padding_mask=torch.from_numpy(kpm))
+        np.testing.assert_allclose(ours, want.numpy(), rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("kind", ["bool", "float"])
+    def test_attn_mask_matches_torch(self, kind):
+        """attn_mask in both torch flavors: bool (True = not allowed) and
+        float (added to the scores)."""
+        import jax
+        import torch
+
+        E, H = 16, 2
+        mha = ht.nn.MultiheadAttention(E, H)
+        params = mha.init(jax.random.key(4))
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((2, 8, E)).astype(np.float32)
+        if kind == "bool":
+            am = rng.random((8, 8)) < 0.3
+            am[:, 0] = False  # keep every row attendable (torch NaNs otherwise)
+        else:
+            am = (rng.standard_normal((8, 8)) * 0.5).astype(np.float32)
+        ours = np.asarray(mha.apply(params, x, attn_mask=am))
+        m = self._torch_mha(E, H, params)
+        with torch.no_grad():
+            want, _ = m(*(torch.from_numpy(x),) * 3,
+                        attn_mask=torch.from_numpy(am))
+        np.testing.assert_allclose(ours, want.numpy(), rtol=2e-4, atol=2e-5)
+
+    def test_fully_masked_rows_grad_is_finite(self):
+        """causal + leading key padding makes some queries attend to ZERO
+        keys; the output row is 0 and — the regression this test pins —
+        gradients stay finite (an after-softmax where() would leak NaN
+        through the vjp)."""
+        import jax
+        import jax.numpy as jnp
+
+        E, H = 16, 2
+        mha = ht.nn.MultiheadAttention(E, H)
+        params = mha.init(jax.random.key(6))
+        x = jnp.asarray(
+            np.random.default_rng(6).standard_normal((2, 8, E)), jnp.float32
+        )
+        kpm = np.zeros((2, 8), bool)
+        kpm[0, :3] = True  # queries 0-2 of batch 0 see no keys under causal
+
+        def loss(p):
+            return jnp.sum(
+                mha.apply(p, x, causal=True, key_padding_mask=kpm) ** 2
+            )
+
+        g = jax.grad(loss)(params)
+        for leaf in jax.tree.leaves(g):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_cross_attention_mask_with_ring_comm_allowed(self):
+        """kv-given calls never ride the ring, so masks + comm= is legal."""
+        import jax
+
+        comm = ht.communication.get_comm()
+        if not comm.is_distributed():
+            pytest.skip("needs a multi-device mesh")
+        E, H = 16, 2
+        mha_ring = ht.nn.MultiheadAttention(E, H, comm=comm)
+        mha_ref = ht.nn.MultiheadAttention(E, H)
+        params = mha_ref.init(jax.random.key(7))
+        rng = np.random.default_rng(7)
+        q = rng.standard_normal((2, 6, E)).astype(np.float32)
+        kv = rng.standard_normal((2, 9, E)).astype(np.float32)
+        kpm = np.zeros((2, 9), bool)
+        kpm[1, 4:] = True
+        got = np.asarray(mha_ring.apply(params, q, kv=kv, key_padding_mask=kpm))
+        want = np.asarray(mha_ref.apply(params, q, kv=kv, key_padding_mask=kpm))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_masks_rejected_on_ring(self):
+        comm = ht.communication.get_comm()
+        if not comm.is_distributed():
+            pytest.skip("needs a multi-device mesh")
+        import jax
+
+        mha = ht.nn.MultiheadAttention(16, 2, comm=comm)
+        params = mha.init(jax.random.key(5))
+        x = np.zeros((2, 8, 16), np.float32)
+        with pytest.raises(ValueError, match="ring"):
+            mha.apply(params, x, key_padding_mask=np.zeros((2, 8), bool))
+
     def test_validation(self):
         with pytest.raises(ValueError):
             ht.nn.MultiheadAttention(30, 4)  # not divisible
