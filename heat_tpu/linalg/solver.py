@@ -7,6 +7,7 @@ reference (SURVEY §2.3).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -44,6 +45,24 @@ def cg(A: DNDarray, b: DNDarray, x0: Optional[DNDarray] = None, out: Optional[DN
     maxit = maxit if maxit is not None else n
     jA, jb = A._jarray, b._jarray
     jx0 = x0._jarray if x0 is not None else jnp.zeros_like(jb)
+    x = _cg_impl(jA, jb, jx0, jnp.asarray(maxit, jnp.int32), jnp.asarray(tol, jnp.float32))
+    res = _wrap(x, b.split, b)
+    if out is not None:
+        out._jarray = res._jarray
+        return out
+    return res
+
+
+@jax.jit
+def _cg_impl(jA, jb, jx0, maxit, tol):
+    # module-level jit: repeat solves at the same shapes reuse ONE compiled
+    # program (an eager while_loop re-traces per call — the round-4b
+    # recompile lesson applied to the Krylov loop).  maxit/tol ride as
+    # DYNAMIC operands — while_loop's cond handles traced bounds, so a
+    # tolerance sweep reuses the same executable instead of recompiling
+    def cond(state):
+        _, _, _, rs, it = state
+        return jnp.logical_and(jnp.sqrt(rs) > tol, it < maxit)
 
     def body(state):
         x, r, p, rs, it = state
@@ -55,18 +74,10 @@ def cg(A: DNDarray, b: DNDarray, x0: Optional[DNDarray] = None, out: Optional[DN
         p = r + (rs_new / rs) * p
         return x, r, p, rs_new, it + 1
 
-    def cond(state):
-        _, _, _, rs, it = state
-        return jnp.logical_and(jnp.sqrt(rs) > tol, it < maxit)
-
     r0 = jb - jA @ jx0
     state = (jx0, r0, r0, jnp.vdot(r0, r0).real, jnp.asarray(0))
     x, *_ = jax.lax.while_loop(cond, body, state)
-    res = _wrap(x, b.split, b)
-    if out is not None:
-        out._jarray = res._jarray
-        return out
-    return res
+    return x
 
 
 def lanczos(
@@ -90,6 +101,23 @@ def lanczos(
         v = v / jnp.linalg.norm(v)
     else:
         v = v0._jarray
+    V, T = _lanczos_impl(jA, v, m)
+    Vd = _wrap(V, 0 if A.split == 0 else None, A)
+    Td = _wrap(T, None, A)
+    if V_out is not None:
+        V_out._jarray = Vd._jarray
+        T_out._jarray = Td._jarray
+        return V_out, T_out
+    return Vd, Td
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _lanczos_impl(jA, v, m: int):
+    """ONE compiled program for the whole recursion (``lax.fori_loop``): the
+    old per-iteration eager loop paid a device round-trip per op — ~100
+    dispatches × the tunnel's ~60 ms latency on TPU — and re-traced every
+    call.  Full reorthogonalization per step, as the reference does."""
+    n = jA.shape[0]
     V = jnp.zeros((n, m), dtype=jA.dtype).at[:, 0].set(v)
     alphas = jnp.zeros(m, dtype=jA.dtype)
     betas = jnp.zeros(m, dtype=jA.dtype)
@@ -98,7 +126,9 @@ def lanczos(
     a0 = jnp.vdot(w, v).real.astype(jA.dtype)
     w = w - a0 * v
     alphas = alphas.at[0].set(a0)
-    for i in range(1, m):
+
+    def body(i, carry):
+        V, alphas, betas, w = carry
         beta = jnp.linalg.norm(w)
         vi = jnp.where(beta > 1e-12, w / jnp.maximum(beta, 1e-30), jnp.zeros_like(w))
         # full reorthogonalization (reference does the same for stability)
@@ -109,17 +139,11 @@ def lanczos(
         w = jA @ vi
         ai = jnp.vdot(w, vi).real.astype(jA.dtype)
         w = w - ai * vi - beta * V[:, i - 1]
-        alphas = alphas.at[i].set(ai)
-        betas = betas.at[i].set(beta)
+        return V, alphas.at[i].set(ai), betas.at[i].set(beta), w
 
+    V, alphas, betas, _ = jax.lax.fori_loop(1, m, body, (V, alphas, betas, w))
     T = jnp.diag(alphas) + jnp.diag(betas[1:], 1) + jnp.diag(betas[1:], -1)
-    Vd = _wrap(V, 0 if A.split == 0 else None, A)
-    Td = _wrap(T, None, A)
-    if V_out is not None:
-        V_out._jarray = Vd._jarray
-        T_out._jarray = Td._jarray
-        return V_out, T_out
-    return Vd, Td
+    return V, T
 
 
 def solve_triangular(A: DNDarray, b: DNDarray, lower: bool = False, blocked=None) -> DNDarray:
