@@ -7,7 +7,11 @@ import jax.numpy as jnp
 
 from ..core.dndarray import DNDarray
 
-__all__ = ["cross_entropy", "nll_loss", "mse_loss", "l1_loss", "binary_cross_entropy", "relu", "softmax", "log_softmax"]
+__all__ = [
+    "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
+    "binary_cross_entropy", "relu", "softmax", "log_softmax",
+    "scaled_dot_product_attention",
+]
 
 
 def _j(x):
@@ -67,6 +71,38 @@ def binary_cross_entropy(pred, target, reduction: str = "mean", eps: float = 1e-
     if reduction == "sum":
         return jnp.sum(b)
     return b
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 is_causal: bool = False, scale=None):
+    """torch ``F.scaled_dot_product_attention`` with the same call shape:
+    ``(..., S, d)`` operands, optional ``attn_mask`` (bool True = attend —
+    NOTE: the OPPOSITE of ``MultiheadAttention``'s mask, matching torch's
+    own inconsistency — or float additive), top-left-aligned causal.
+
+    Unmasked identical-shape calls run the Pallas flash kernel on TPU
+    (fwd + custom-VJP bwd — the (S, S) scores never reach HBM); everything
+    else runs the framework's single dense softmax path, whose fully-masked
+    rows emit 0 with NaN-free gradients (torch emits NaN there).
+    """
+    q, k, v = _j(query), _j(key), _j(value)
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / (d**0.5)
+    from ..ops.flash_attention import _dense_attention, flash_attention
+
+    if attn_mask is None and q.shape == k.shape == v.shape:
+        return flash_attention(q, k, v, causal=is_causal, scale=scale)
+    bias = None
+    if attn_mask is not None:
+        attn_mask = _j(attn_mask)  # DNDarray masks stay device-resident
+        if attn_mask.dtype == jnp.bool_:
+            # torch sdpa bool semantics: True = ALLOWED to attend
+            bias = jnp.where(attn_mask, 0.0, -jnp.inf).astype(q.dtype)
+        else:
+            # q's dtype, like torch (a f32 mask on bf16 scores would
+            # silently promote the whole masked path's output dtype)
+            bias = attn_mask.astype(q.dtype)
+    return _dense_attention(q, k, v, is_causal, scale, k.shape[-2], bias=bias)
 
 
 def relu(x):

@@ -705,6 +705,64 @@ class TestAttentionModule:
             ht.nn.MultiheadAttention(32, 4, batch_first=False)
 
 
+class TestScaledDotProductAttention:
+    """ht.nn.functional.scaled_dot_product_attention vs torch F.sdpa —
+    incl. torch's inverted bool-mask convention (True = allowed here)."""
+
+    @pytest.mark.parametrize("is_causal", [False, True])
+    def test_matches_torch(self, is_causal):
+        import torch
+
+        rng = np.random.default_rng(0)
+        q, k, v = (rng.standard_normal((2, 3, 10, 8)).astype(np.float32)
+                   for _ in range(3))
+        ours = np.asarray(ht.nn.functional.scaled_dot_product_attention(
+            q, k, v, is_causal=is_causal))
+        with torch.no_grad():
+            want = torch.nn.functional.scaled_dot_product_attention(
+                torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+                is_causal=is_causal,
+            ).numpy()
+        np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("kind", ["bool", "float"])
+    def test_mask_matches_torch(self, kind):
+        import torch
+
+        rng = np.random.default_rng(1)
+        q, k, v = (rng.standard_normal((2, 2, 8, 4)).astype(np.float32)
+                   for _ in range(3))
+        if kind == "bool":
+            am = rng.random((8, 8)) < 0.7  # True = ALLOWED (torch sdpa)
+            am[:, 0] = True  # keep rows alive for the torch comparison
+        else:
+            am = (rng.standard_normal((8, 8)) * 0.5).astype(np.float32)
+        ours = np.asarray(ht.nn.functional.scaled_dot_product_attention(
+            q, k, v, attn_mask=am))
+        with torch.no_grad():
+            want = torch.nn.functional.scaled_dot_product_attention(
+                torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+                attn_mask=torch.from_numpy(am),
+            ).numpy()
+        np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+    def test_cross_shapes_and_scale(self):
+        import torch
+
+        rng = np.random.default_rng(2)
+        q = rng.standard_normal((2, 6, 4)).astype(np.float32)
+        k = rng.standard_normal((2, 9, 4)).astype(np.float32)
+        v = rng.standard_normal((2, 9, 4)).astype(np.float32)
+        ours = np.asarray(ht.nn.functional.scaled_dot_product_attention(
+            q, k, v, scale=0.3))
+        with torch.no_grad():
+            want = torch.nn.functional.scaled_dot_product_attention(
+                torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+                scale=0.3,
+            ).numpy()
+        np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+
 class TestRecurrentModules:
     """RNN/LSTM/GRU vs the torch oracle with copied weights."""
 
