@@ -424,54 +424,89 @@ def main(state: dict = None) -> dict:
         snapshot()
 
     # --- flash attention: Pallas kernel vs dense XLA local attention ------ #
-    # (B,H,S,d) = (4,8,4096,64) causal bf16, slope-timed (chained lax.scan
-    # at two lengths so the tunnel dispatch constant cancels)
+    # causal bf16, slope-timed (chained lax.scan at two lengths so the
+    # tunnel dispatch constant cancels).  ONE timing harness serves both
+    # points, and each point records its own error key so a failed/noisy
+    # measurement is visible in the payload, never silently absent.
+    def _attn_slope(f, qkv, lo, hi):
+        """Per-call seconds for f(q,k,v), slope-timed over chained scans."""
+        import jax.numpy as jnp
+
+        from heat_tpu.utils.profiler import timeit_min
+
+        def chain(iters):
+            @jax.jit
+            def run(q, k, v):
+                def body(c, _):
+                    return f(c, k, v), None
+
+                c, _ = jax.lax.scan(body, q, None, length=iters)
+                return c
+
+            return run
+
+        rl, rh = chain(lo), chain(hi)
+        for r in (rl, rh):  # compile + warm
+            float(jnp.abs(r(*qkv)).sum())
+        t_lo = timeit_min(lambda: float(jnp.abs(rl(*qkv)).sum()), reps=2)
+        t_hi = timeit_min(lambda: float(jnp.abs(rh(*qkv)).sum()), reps=2)
+        s = (t_hi - t_lo) / (hi - lo)
+        if s <= 0:
+            raise RuntimeError(
+                f"slope noise-dominated: t_lo={t_lo:.4f}s t_hi={t_hi:.4f}s"
+            )
+        return s
+
+    H, d = 8, 64
     if not skip("flash_attention_ab", 0.1):
         try:
             import jax.numpy as jnp
 
             from heat_tpu.ops.flash_attention import _dense_attention, flash_attention
-            from heat_tpu.utils.profiler import timeit_min
 
-            B, H, S, d = 4, 8, 4096, 64
+            B, S = 4, 4096
             key = jax.random.key(0)
             qkv = [
                 jax.random.normal(jax.random.fold_in(key, i), (B, H, S, d), jnp.bfloat16)
                 for i in range(3)
             ]
-
-            def slope_time(f):
-                def chain(iters):
-                    @jax.jit
-                    def run(q, k, v):
-                        def body(c, _):
-                            return f(c, k, v), None
-
-                        c, _ = jax.lax.scan(body, q, None, length=iters)
-                        return c
-
-                    return run
-
-                lo, hi = 2, 12
-                rl, rh = chain(lo), chain(hi)
-                for r in (rl, rh):  # compile + warm
-                    float(jnp.abs(r(*qkv)).sum())
-                t_lo = timeit_min(lambda: float(jnp.abs(rl(*qkv)).sum()), reps=2)
-                t_hi = timeit_min(lambda: float(jnp.abs(rh(*qkv)).sum()), reps=2)
-                s = (t_hi - t_lo) / (hi - lo)
-                if s <= 0:
-                    raise RuntimeError("slope noise-dominated")
-                return s
-
-            t_flash = slope_time(lambda q, k, v: flash_attention(q, k, v, causal=True))
-            t_dense = slope_time(
-                lambda q, k, v: _dense_attention(q, k, v, True, d**-0.5, S)
+            t_flash = _attn_slope(
+                lambda q, k, v: flash_attention(q, k, v, causal=True), qkv, 2, 12
+            )
+            t_dense = _attn_slope(
+                lambda q, k, v: _dense_attention(q, k, v, True, d**-0.5, S), qkv, 2, 12
             )
             extra["attn_4x8x4096x64_causal_flash_ms"] = round(t_flash * 1e3, 3)
             extra["attn_4x8x4096x64_causal_dense_ms"] = round(t_dense * 1e3, 3)
             extra["flash_attention_speedup"] = round(t_dense / t_flash, 3)
         except Exception as e:
             extra["flash_attention_ab_error"] = str(e)[:120]
+        snapshot()
+
+    # long-context point, flash only (its own try: independent of the A-B
+    # above): at (2, 8, 32768, 64) the dense path's f32 scores alone are
+    # 64 GiB — off the table on a 16 GiB chip; flash streams them via VMEM
+    if not skip("flash_attention_32k", 0.1):
+        try:
+            import jax.numpy as jnp
+
+            from heat_tpu.ops.flash_attention import flash_attention
+
+            B2, S2 = 2, 32768
+            key = jax.random.key(0)
+            qkv2 = [
+                jax.random.normal(jax.random.fold_in(key, 9 + i),
+                                  (B2, H, S2, d), jnp.bfloat16)
+                for i in range(3)
+            ]
+            per = _attn_slope(
+                lambda q, k, v: flash_attention(q, k, v, causal=True), qkv2, 1, 3
+            )
+            fl = 2 * 2 * B2 * H * S2 * S2 * d / 2  # causal
+            extra["attn_2x8x32768x64_causal_flash_ms"] = round(per * 1e3, 2)
+            extra["attn_32k_flash_tflops"] = round(fl / per / 1e12, 2)
+        except Exception as e:
+            extra["flash_attention_32k_error"] = str(e)[:120]
         snapshot()
 
     # --- BASELINE config[2] scale: 1e8×32 with bf16 storage --------------- #
