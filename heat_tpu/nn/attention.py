@@ -84,7 +84,8 @@ class MultiheadAttention(Module):
         B, S, _ = t.shape
         return t.reshape(B, S, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
 
-    def _masked_dense(self, qh, kh, vh, causal, key_padding_mask, attn_mask):
+    def _masked_dense(self, qh, kh, vh, causal, key_padding_mask, attn_mask,
+                      return_probs: bool = False):
         """Compose torch-convention masks into ONE additive bias and run the
         framework's single dense softmax path (``_dense_attention`` — which
         also owns the differentiable fully-masked-row semantics: 0 output,
@@ -105,13 +106,20 @@ class MultiheadAttention(Module):
             kpm = jnp.asarray(key_padding_mask, bool)  # (B, S_k), True=ignore
             bias = bias + jnp.where(kpm[:, None, None, :], neg, 0.0)
         return _dense_attention(
-            qh, kh, vh, causal, 1.0 / (self.head_dim**0.5), Sk, bias=bias
+            qh, kh, vh, causal, 1.0 / (self.head_dim**0.5), Sk, bias=bias,
+            return_probs=return_probs,
         )
 
     def apply(self, params, x, *, kv=None, causal: bool = False,
               key_padding_mask=None, attn_mask=None,
+              need_weights: bool = False, average_attn_weights: bool = True,
               train: bool = False, key=None):
         E = self.embed_dim
+        if need_weights and self.comm is not None and self.comm.size > 1 and kv is None:
+            raise ValueError(
+                "need_weights materializes the (S, S) attention matrix — "
+                "not available on the sequence-parallel ring path"
+            )
         masked = key_padding_mask is not None or attn_mask is not None
         if masked and kv is None and self.comm is not None and self.comm.size > 1:
             # cross-attention (kv given) never rides the ring, so masks are
@@ -121,7 +129,11 @@ class MultiheadAttention(Module):
                 "sequence-parallel ring path — use causal=, or mask the "
                 "inputs before the layer"
             )
-        ring = self.comm is not None and kv is None and not masked
+        # need_weights forces the probability-returning dense path — also
+        # off a SIZE-1 ring (which would otherwise run flash and return no
+        # probabilities); multi-device rings already raised above
+        ring = (self.comm is not None and kv is None and not masked
+                and not need_weights)
         if ring:
             # sequence-shard the INPUT: the QKV projections are pointwise
             # along S, so GSPMD keeps them (and the output projection below)
@@ -141,10 +153,18 @@ class MultiheadAttention(Module):
         qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B, H, S, d)
         from ..parallel.ring_attention import _global_attention, ring_attention
 
+        probs = None
         if ring:
             out = ring_attention(qh, kh, vh, self.comm, causal=causal)
-        elif masked:
-            out = self._masked_dense(qh, kh, vh, causal, key_padding_mask, attn_mask)
+        elif masked or need_weights:
+            # need_weights forces the probability-returning dense path even
+            # when the flash kernel would otherwise serve the call
+            out = self._masked_dense(
+                qh, kh, vh, causal, key_padding_mask, attn_mask,
+                return_probs=need_weights,
+            )
+            if need_weights:
+                out, probs = out
         elif qh.shape == kh.shape == vh.shape:
             # local self-attention: flash-fused Pallas kernel on TPU (the
             # (S, S) score matrix never reaches HBM), dense-jnp elsewhere
@@ -158,4 +178,10 @@ class MultiheadAttention(Module):
         y = merged @ params["out_proj"]["weight"].T
         if self.bias:
             y = y + params["out_proj"]["bias"]
+        if need_weights:
+            # torch contract: (B, S_q, S_k) averaged over heads by default,
+            # (B, H, S_q, S_k) with average_attn_weights=False
+            if average_attn_weights:
+                probs = probs.mean(axis=1)
+            return y, probs
         return y

@@ -74,7 +74,8 @@ def binary_cross_entropy(pred, target, reduction: str = "mean", eps: float = 1e-
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
-                                 is_causal: bool = False, scale=None):
+                                 is_causal: bool = False, scale=None,
+                                 enable_gqa: bool = False):
     """torch ``F.scaled_dot_product_attention`` with the same call shape:
     ``(..., S, d)`` operands, optional ``attn_mask`` (bool True = attend —
     NOTE: the OPPOSITE of ``MultiheadAttention``'s mask, matching torch's
@@ -88,6 +89,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q, k, v = _j(query), _j(key), _j(value)
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / (d**0.5)
+    if enable_gqa and q.ndim >= 3 and k.shape[-3] != q.shape[-3]:
+        # grouped-query attention (torch enable_gqa): repeat each K/V head
+        # for its query-head group.  Materializes the broadcast (H_q/H_kv x
+        # the K/V memory) — acceptable at the local-block sizes this
+        # function serves; a head-mapping flash kernel would avoid it
+        hq, hkv = q.shape[-3], k.shape[-3]
+        if hq % hkv:
+            raise ValueError(
+                f"enable_gqa requires query heads ({hq}) divisible by "
+                f"key/value heads ({hkv})"
+            )
+        k = jnp.repeat(k, hq // hkv, axis=-3)
+        v = jnp.repeat(v, hq // hkv, axis=-3)
     from ..ops.flash_attention import _dense_attention, flash_attention
 
     if attn_mask is None and q.shape == k.shape == v.shape:

@@ -60,7 +60,7 @@ path_counts = {"pallas": 0, "dense": 0}
 
 
 def _dense_attention(q, k, v, causal: bool, scale: float, s_valid: int,
-                     bias=None):
+                     bias=None, return_probs: bool = False):
     """THE dense softmax path — every non-flash attention route in the
     framework composes into this one function so masked-row semantics can
     never diverge.  ``s_valid`` masks trailing pad *keys* (positions >=
@@ -87,7 +87,8 @@ def _dense_attention(q, k, v, causal: bool, scale: float, s_valid: int,
     s = jnp.where(alive, s, 0.0)  # sanitize BEFORE softmax (NaN-free vjp)
     p = jax.nn.softmax(s, axis=-1)
     p = jnp.where(alive, p, 0.0)
-    return jnp.einsum("...qk,...kd->...qd", p, v)
+    out = jnp.einsum("...qk,...kd->...qd", p, v)
+    return (out, p) if return_probs else out
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,

@@ -640,6 +640,28 @@ class TestAttentionModule:
                         attn_mask=torch.from_numpy(am))
         np.testing.assert_allclose(ours, want.numpy(), rtol=2e-4, atol=2e-5)
 
+    def test_need_weights_matches_torch(self):
+        """need_weights returns torch's (out, averaged (B, Sq, Sk) weights);
+        average_attn_weights=False keeps per-head weights."""
+        import jax
+        import torch
+
+        E, H = 16, 2
+        mha = ht.nn.MultiheadAttention(E, H)
+        params = mha.init(jax.random.key(8))
+        x = np.random.default_rng(8).standard_normal((2, 7, E)).astype(np.float32)
+        y, w = mha.apply(params, x, need_weights=True)
+        assert w.shape == (2, 7, 7)
+        m = self._torch_mha(E, H, params)
+        with torch.no_grad():
+            ty, tw = m(*(torch.from_numpy(x),) * 3, need_weights=True)
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(w), tw.numpy(), rtol=2e-4, atol=2e-5)
+        _, wh = mha.apply(params, x, need_weights=True, average_attn_weights=False)
+        assert wh.shape == (2, H, 7, 7)
+        np.testing.assert_allclose(np.asarray(wh.mean(axis=1)), np.asarray(w),
+                                   rtol=1e-6, atol=1e-7)
+
     def test_fully_masked_rows_grad_is_finite(self):
         """causal + leading key padding makes some queries attend to ZERO
         keys; the output row is 0 and — the regression this test pins —
@@ -743,6 +765,22 @@ class TestScaledDotProductAttention:
             want = torch.nn.functional.scaled_dot_product_attention(
                 torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
                 attn_mask=torch.from_numpy(am),
+            ).numpy()
+        np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+    def test_enable_gqa_matches_torch(self):
+        import torch
+
+        rng = np.random.default_rng(3)
+        q = rng.standard_normal((2, 8, 10, 4)).astype(np.float32)
+        k = rng.standard_normal((2, 2, 10, 4)).astype(np.float32)  # 2 kv heads
+        v = rng.standard_normal((2, 2, 10, 4)).astype(np.float32)
+        ours = np.asarray(ht.nn.functional.scaled_dot_product_attention(
+            q, k, v, is_causal=True, enable_gqa=True))
+        with torch.no_grad():
+            want = torch.nn.functional.scaled_dot_product_attention(
+                torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+                is_causal=True, enable_gqa=True,
             ).numpy()
         np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
 
