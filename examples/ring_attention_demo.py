@@ -4,7 +4,10 @@ Runs batched multi-head causal ring attention with the sequence axis sharded
 over the device mesh: each chip holds S/p of the sequence, K/V blocks rotate
 over the ICI ring (``lax.ppermute``) and a flash-style online softmax
 accumulates — the (S, S) score matrix never exists, so context length scales
-with the number of chips.
+with the number of chips.  On TPU each ring step additionally runs the
+Pallas flash kernel over its visiting block (``kernel='auto'``), so even
+the per-chip (S/p, S/p) score block never materializes — per-chip memory is
+one kernel tile.
 
 Run (virtual 8-device CPU mesh):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -41,7 +41,7 @@ try:  # pragma: no cover - import guard mirrors kmeans_kernels
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_block"]
 
 # 512x512 measured best-in-family on v5e at (B,H,S,d)=(4,8,4096,64) causal
 # bf16: ~2.1 ms/iter slope-timed vs ~5.2 at 256x256 and ~9.5 for the dense
@@ -91,6 +91,39 @@ def _dense_attention(q, k, v, causal: bool, scale: float, s_valid: int,
     return (out, p) if return_probs else out
 
 
+def _online_update(s, v_ref, m_scr, l_scr, acc_scr):
+    """One step of the online-softmax recurrence against the VMEM scratch —
+    shared by the static-offset and positions-carrying forward kernels so
+    the numerics cannot diverge.  GEMM operands stay in the storage dtype
+    (bf16 rides the MXU's native input type); accumulation is f32."""
+    m_prev = m_scr[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # fully-masked-so-far rows keep m=-inf; exp against a safe 0 stays 0
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[:, None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+    l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
+    # p is cast to v's storage dtype for the PV GEMM (bf16 probabilities
+    # against bf16 values — the standard TPU flash layout); f32 accum
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] = acc_scr[:] * corr[:, None] + pv
+    m_scr[:, 0] = m_new
+
+
+def _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
+    out = acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+    # logsumexp per row, for the backward recompute (finite even for
+    # fully-masked rows: log(1e-30) ≈ -69, where exp(s - lse) = 0)
+    lse_ref[0] = jnp.where(
+        jnp.isfinite(m_scr[:, 0]), m_scr[:, 0], 0.0
+    ) + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                   *, scale: float, causal: bool, s_valid: int,
                   blk_q: int, blk_k: int, nk: int, masked: bool):
@@ -114,39 +147,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _():
-        # GEMM operands stay in the storage dtype (bf16 rides the MXU's
-        # native input type); accumulation is f32 via preferred_element_type.
         # s: (blk_q, blk_k) f32 — in VMEM only
         s = _masked_scores(
             q_ref[0], k_ref[0], scale=scale, causal=causal, masked=masked,
             s_valid=s_valid, q_lo=q_lo, k_lo=k_lo, blk_q=blk_q, blk_k=blk_k,
         )
-        m_prev = m_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        # fully-masked-so-far rows keep m=-inf; exp against a safe 0 stays 0
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - safe_m[:, None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
-        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
-        # p is cast to v's storage dtype for the PV GEMM (bf16 probabilities
-        # against bf16 values — the standard TPU flash layout); f32 accum
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0],
-            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-        )
-        acc_scr[:] = acc_scr[:] * corr[:, None] + pv
-        m_scr[:, 0] = m_new
+        _online_update(s, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(ik == nk - 1)
     def _():
-        out = acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
-        o_ref[0] = out.astype(o_ref.dtype)
-        # logsumexp per row, for the backward recompute (finite even for
-        # fully-masked rows: log(1e-30) ≈ -69, where exp(s - lse) = 0)
-        lse_ref[0] = jnp.where(
-            jnp.isfinite(m_scr[:, 0]), m_scr[:, 0], 0.0
-        ) + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
 def _masked_scores(q, k, *, scale, causal, masked, s_valid,
@@ -172,6 +182,153 @@ def _recompute_p(q, k, lse_row, **kw):
     s = _masked_scores(q, k, **kw)
     p = jnp.exp(s - lse_row[:, None])
     return jnp.where(jnp.isfinite(s), p, 0.0)
+
+
+# --------------------------------------------------------------------- #
+# positions-carrying block kernels (the ring-attention building block)
+#
+# The ring rotates K/V blocks between chips, so a block's global key
+# positions are DYNAMIC (they depend on lax.axis_index and the ring step)
+# — the static q_lo/k_lo offsets of the local kernel above cannot express
+# the mask.  These variants take explicit per-row/per-key position vectors
+# (q_pos as a (blk,1) column, k_pos as a (1,blk) row — 2-D so Mosaic never
+# sees a 1-D iota/relayout) and return (out, lse): normalized block output
+# plus the row logsumexp, which is exactly what the cross-block
+# merge needs (out = Σ_b out_b · exp(lse_b − lse), lse = logaddexp_b).
+# --------------------------------------------------------------------- #
+
+
+def _masked_scores_pos(q, k, qpos_col, kpos_row, *, scale, causal, masked,
+                       s_valid):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if masked:
+        mask = jnp.broadcast_to(kpos_row < s_valid, s.shape)
+        if causal:
+            mask = mask & (qpos_col >= kpos_row)
+        s = jnp.where(mask, s, -jnp.inf)
+    return s
+
+
+def _recompute_p_pos(q, k, lse_row, **kw):
+    s = _masked_scores_pos(q, k, **kw)
+    p = jnp.exp(s - lse_row[:, None])
+    return jnp.where(jnp.isfinite(s), p, 0.0)
+
+
+def _block_live(kpos_row, qpos_col, causal: bool, s_valid: int):
+    """Dynamic analogue of the static k_lo/q_lo skip: a tile whose every key
+    is pad (>= s_valid) or — under causal — strictly in the future of every
+    query row here contributes nothing; skip both GEMMs."""
+    live = jnp.min(kpos_row) < s_valid
+    if causal:
+        live = live & (jnp.min(kpos_row) <= jnp.max(qpos_col))
+    return live
+
+
+def _flash_pos_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr,
+                      *, scale: float, causal: bool, s_valid: int,
+                      nk: int, masked: bool):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qpos = qpos_ref[...]  # (blk_q, 1) i32
+    kpos = kpos_ref[...]  # (1, blk_k) i32
+    live = _block_live(kpos, qpos, causal, s_valid) if masked else jnp.bool_(True)
+
+    @pl.when(live)
+    def _():
+        s = _masked_scores_pos(
+            q_ref[0], k_ref[0], qpos, kpos,
+            scale=scale, causal=causal, masked=masked, s_valid=s_valid,
+        )
+        _online_update(s, v_ref, m_scr, l_scr, acc_scr)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _flash_pos_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                             qpos_ref, kpos_ref, dq_ref, dq_scr,
+                             *, scale, causal, s_valid, nk, masked):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    qpos = qpos_ref[...]
+    kpos = kpos_ref[...]
+    live = _block_live(kpos, qpos, causal, s_valid) if masked else jnp.bool_(True)
+
+    @pl.when(live)
+    def _():
+        p = _recompute_p_pos(
+            q_ref[0], k_ref[0], lse_ref[0], qpos_col=qpos, kpos_row=kpos,
+            scale=scale, causal=causal, masked=masked, s_valid=s_valid,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_pos_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                              qpos_ref, kpos_ref, dk_ref, dv_ref,
+                              dk_scr, dv_scr,
+                              *, scale, causal, s_valid, nq, masked):
+    iq = pl.program_id(2)  # sweeping Q blocks; K/V block fixed per middle idx
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    qpos = qpos_ref[...]
+    kpos = kpos_ref[...]
+    live = _block_live(kpos, qpos, causal, s_valid) if masked else jnp.bool_(True)
+
+    @pl.when(live)
+    def _():
+        p = _recompute_p_pos(
+            q_ref[0], k_ref[0], lse_ref[0], qpos_col=qpos, kpos_row=kpos,
+            scale=scale, causal=causal, masked=masked, s_valid=s_valid,
+        )
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0][:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
@@ -255,9 +412,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 
 def _blocks(Sp: int):
-    blk_q = min(_BLK_Q, _round_up(Sp, 128))
-    blk_k = min(_BLK_K, _round_up(Sp, 128))
-    return blk_q, blk_k, pl.cdiv(Sp, blk_q), pl.cdiv(Sp, blk_k)
+    return _blocks_rect(Sp, Sp)
+
+
+def _blocks_rect(Sq: int, Sk: int):
+    blk_q = min(_BLK_Q, _round_up(Sq, 128))
+    blk_k = min(_BLK_K, _round_up(Sk, 128))
+    return blk_q, blk_k, pl.cdiv(Sq, blk_q), pl.cdiv(Sk, blk_k)
 
 
 @functools.partial(
@@ -304,6 +465,7 @@ def _flash_fwd_impl(q, k, v, causal: bool, scale: float, s_valid: int,
 def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
                     s_valid: int, interpret: bool):
     B, Sp, d = q.shape
+    Sq = Sk = Sp  # square local block (q/k/v share S)
     blk_q, blk_k, nq, nk = _blocks(Sp)
     masked = causal or (Sp != s_valid)
     # D_i = Σ_d dOᵢ ⊙ Oᵢ — one cheap fused elementwise pass, fine in XLA
@@ -320,7 +482,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
         grid=(B, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((B, Sp, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, dd)
@@ -338,8 +500,8 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Sp, d), k.dtype),
-            jax.ShapeDtypeStruct((B, Sp, d), v.dtype),
+            jax.ShapeDtypeStruct((B, Sk, d), k.dtype),
+            jax.ShapeDtypeStruct((B, Sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, d), jnp.float32),
@@ -369,6 +531,226 @@ def _flash_bwd_rule(causal, scale, s_valid, interpret, res, do):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# --------------------------------------------------------------------- #
+# positions-carrying block primitive: pallas_call plumbing + custom VJP
+# --------------------------------------------------------------------- #
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "s_valid", "masked",
+                              "interpret")
+)
+def _flash_pos_fwd_impl(q, k, v, qpos, kpos, causal: bool, scale: float,
+                        s_valid: int, masked: bool, interpret: bool):
+    B, Sq, d = q.shape
+    Sk = k.shape[1]
+    blk_q, blk_k, nq, nk = _blocks_rect(Sq, Sk)
+    kernel = functools.partial(
+        _flash_pos_kernel, scale=scale, causal=causal, s_valid=s_valid,
+        nk=nk, masked=masked,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((blk_q, 1), lambda b, iq, ik: (iq, 0)),
+            pl.BlockSpec((1, blk_k), lambda b, iq, ik: (0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, iq, ik: (b, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Sq, d), q.dtype),
+            jax.ShapeDtypeStruct((B, Sq), jnp.float32),  # logsumexp
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, qpos, kpos)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "s_valid", "masked",
+                              "interpret")
+)
+def _flash_pos_bwd_impl(q, k, v, qpos, kpos, out, lse, do, glse,
+                        causal: bool, scale: float, s_valid: int,
+                        masked: bool, interpret: bool):
+    B, Sq, d = q.shape
+    Sk = k.shape[1]
+    blk_q, blk_k, nq, nk = _blocks_rect(Sq, Sk)
+    # D_i = Σ_d dOᵢ ⊙ Oᵢ − g_lseᵢ: the lse cotangent folds into the same
+    # row term (∂lse/∂s = p, so ds += p·g ≡ ds = p·(dp − (dd − g)))
+    dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dd = dd - glse.astype(jnp.float32)
+
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))
+    qpspec = pl.BlockSpec((blk_q, 1), lambda b, i, j: (i, 0))
+    kpspec = pl.BlockSpec((1, blk_k), lambda b, i, j: (0, j))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_pos_bwd_dq_kernel, scale=scale, causal=causal,
+            s_valid=s_valid, nk=nk, masked=masked,
+        ),
+        grid=(B, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec,
+                  qpspec, kpspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, Sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd, qpos, kpos)
+
+    # dk/dv sweep: K/V block fixed per middle grid index, Q blocks stream
+    qspec2 = pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i))
+    qpspec2 = pl.BlockSpec((blk_q, 1), lambda b, j, i: (i, 0))
+    kpspec2 = pl.BlockSpec((1, blk_k), lambda b, j, i: (0, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_pos_bwd_dkv_kernel, scale=scale, causal=causal,
+            s_valid=s_valid, nq=nq, masked=masked,
+        ),
+        grid=(B, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2,
+                  qpspec2, kpspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Sk, d), k.dtype),
+            jax.ShapeDtypeStruct((B, Sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd, qpos, kpos)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_pos(q, k, v, qpos, kpos, causal: bool, scale: float, s_valid: int,
+               masked: bool, interpret: bool):
+    return _flash_pos_fwd_impl(q, k, v, qpos, kpos, causal, scale, s_valid,
+                               masked, interpret)
+
+
+def _flash_pos_fwd_rule(q, k, v, qpos, kpos, causal, scale, s_valid, masked,
+                        interpret):
+    out, lse = _flash_pos_fwd_impl(q, k, v, qpos, kpos, causal, scale,
+                                   s_valid, masked, interpret)
+    return (out, lse), (q, k, v, qpos, kpos, out, lse)
+
+
+def _flash_pos_bwd_rule(causal, scale, s_valid, masked, interpret, res, ct):
+    q, k, v, qpos, kpos, out, lse = res
+    do, glse = ct
+    dq, dk, dv = _flash_pos_bwd_impl(q, k, v, qpos, kpos, out, lse, do, glse,
+                                     causal, scale, s_valid, masked,
+                                     interpret)
+    import numpy as _np
+
+    f0 = lambda x: _np.zeros(x.shape, jax.dtypes.float0)  # int positions
+    return dq, dk, dv, f0(qpos), f0(kpos)
+
+
+_flash_pos.defvjp(_flash_pos_fwd_rule, _flash_pos_bwd_rule)
+
+
+def _dense_block_pos(q, k, v, q_pos, k_pos, causal: bool, scale: float,
+                     s_valid: int, masked: bool):
+    """jnp reference/fallback for the positions block: same masking
+    convention and the same finite-lse sentinel for fully-masked rows
+    (log(1e-30) ≈ −69 with a zero output row), so the cross-block merge
+    treats kernel and fallback results identically.  Differentiable via
+    plain autodiff (the −inf rows are sanitized before the softmax)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if masked:
+        mask = jnp.broadcast_to(k_pos[None, :] < s_valid, s.shape)
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+    out = out / jnp.maximum(l, 1e-30)[..., None].astype(out.dtype)
+    lse = safe_m + jnp.log(jnp.maximum(l, 1e-30))
+    return out.astype(q.dtype), lse
+
+
+def flash_attention_block(q, k, v, q_pos, k_pos, *, causal: bool,
+                          scale: float, s_valid: int, impl: str):
+    """One attention block with explicit global positions → ``(out, lse)``.
+
+    ``q``: ``(..., blk_q, d)``; ``k, v``: ``(..., blk_k, d)`` (rectangular
+    blocks allowed — cross-attention callers); ``q_pos``/``k_pos``: int32
+    ``(blk_q,)``/``(blk_k,)`` GLOBAL positions of the rows/keys.  Keys at
+    positions ``>= s_valid`` are pad and never attend; under ``causal`` a
+    query at position i attends keys at positions ``<= i``.  Returns the
+    normalized block output (q's dtype) and the per-row logsumexp (f32,
+    finite even for fully-masked rows — their output row is 0).  ``impl``:
+    ``'pallas'`` (TPU kernel), ``'interpret'`` (kernel under the CPU
+    interpreter, test scale), ``'dense'`` (jnp fallback).  This is ring
+    attention's per-step building block; blocks over disjoint key sets
+    merge exactly via ``lse = logaddexp(lse_a, lse_b)``,
+    ``out = Σ out_b·exp(lse_b − lse)``.
+    """
+    blk_q, d = q.shape[-2:]
+    blk_k = k.shape[-2]
+    # positions at/above the pad sentinel (2**30) must never attend, even
+    # under the "no pad keys" s_valid of 2**31-1 — cap the comparison point
+    s_valid = min(int(s_valid), 2**30)
+    masked = bool(causal) or bool(s_valid < 2**30)
+    if impl == "dense":
+        return _dense_block_pos(q, k, v, q_pos, k_pos, causal, scale,
+                                s_valid, masked)
+    lead = q.shape[:-2]
+    B = 1
+    for a in lead:
+        B *= int(a)
+    # pad each side to a multiple of the kernel TILE the grid will use, not
+    # just the 128 lane quantum: a 640-row block would otherwise tile at
+    # 512 and the second tile would read out-of-bounds rows whose garbage
+    # positions the mask cannot reliably kill
+    q_p = _round_up(blk_q, min(_BLK_Q, _round_up(blk_q, 128)))
+    k_p = _round_up(blk_k, min(_BLK_K, _round_up(blk_k, 128)))
+    qf = q.reshape((B, blk_q, d))
+    kf = k.reshape((B, blk_k, d))
+    vf = v.reshape((B, blk_k, d))
+    qpos = q_pos.astype(jnp.int32)
+    kpos = k_pos.astype(jnp.int32)
+    if q_p != blk_q:
+        qf = jnp.pad(qf, ((0, 0), (0, q_p - blk_q), (0, 0)))
+        qpos = jnp.pad(qpos, (0, q_p - blk_q), constant_values=2**30)
+    if k_p != blk_k:
+        # pad keys get a beyond-any-sequence sentinel so the mask kills them
+        kf = jnp.pad(kf, ((0, 0), (0, k_p - blk_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, k_p - blk_k), (0, 0)))
+        kpos = jnp.pad(kpos, (0, k_p - blk_k), constant_values=2**30)
+        masked = True
+    out, lse = _flash_pos(
+        qf, kf, vf, qpos.reshape(q_p, 1), kpos.reshape(1, k_p),
+        causal, scale, s_valid, masked, impl == "interpret",
+    )
+    if q_p != blk_q:
+        out = out[:, :blk_q]
+        lse = lse[:, :blk_q]
+    return out.reshape(q.shape), lse.reshape(q.shape[:-1])
 
 
 def flash_attention(q, k, v, causal: bool = False,

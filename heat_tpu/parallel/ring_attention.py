@@ -52,7 +52,28 @@ def _global_attention(q, k, v, causal, scale):
     return _dense_attention(q, k, v, causal, scale, k.shape[-2])
 
 
-def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None):
+def _block_impl(comm, kernel: str) -> str:
+    """Resolve the per-ring-step attention implementation (static — baked
+    into the cached ring program).  ``kernel='auto'`` uses the Pallas flash
+    kernel when the comm's devices are TPUs and falls back to the dense jnp
+    block elsewhere; ``'flash'`` forces the kernel (interpreter off-TPU —
+    test scale only); ``'dense'`` forces the jnp block."""
+    from ..ops.flash_attention import _HAS_PALLAS
+
+    platform = next(iter(comm.mesh.devices.flat)).platform
+    if kernel == "auto":
+        return "pallas" if (_HAS_PALLAS and platform == "tpu") else "dense"
+    if kernel == "flash":
+        if not _HAS_PALLAS:
+            raise RuntimeError("kernel='flash' requires pallas")
+        return "pallas" if platform == "tpu" else "interpret"
+    if kernel == "dense":
+        return "dense"
+    raise ValueError(f"kernel must be 'auto'|'flash'|'dense', got {kernel!r}")
+
+
+def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] = None,
+                   kernel: str = "auto"):
     """Exact softmax attention, sequence-parallel over the mesh ring.
 
     ``q, k, v`` have shape ``(..., S, d)`` — any leading batch/head axes —
@@ -62,6 +83,12 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
     (S, S) score matrix never materializes and peak memory is one block
     pair per chip.  Any S is sequence-parallel — non-divisible lengths are
     zero-padded and the pad keys masked (see module docstring).
+
+    On TPU each ring step runs the Pallas flash kernel over its local
+    (S/p, S/p) block (``ops.flash_attention_block``), so per-chip score
+    memory is one kernel tile — O(blk·512) — rather than the whole
+    (S/p)² block; blocks merge exactly across steps via their logsumexp.
+    ``kernel`` picks the per-step implementation (see :func:`_block_impl`).
     """
     S, d = q.shape[-2:]
     if scale is None:
@@ -105,72 +132,86 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
 
-    out = _ring_program(comm, causal, scale, S, q.ndim)(q, k, v)
+    out = _ring_program(comm, causal, scale, S, q.ndim,
+                        _block_impl(comm, kernel))(q, k, v)
     if pad:
         out = lax.slice_in_dim(out, 0, S, axis=seq_axis)
     return out
 
 
 @comm_cached
-def _ring_program(comm, causal: bool, scale: float, S: int, nd: int):
+def _ring_program(comm, causal: bool, scale: float, S: int, nd: int,
+                  impl: str):
     """Jitted + comm-cached ring pipeline (same recompile lesson as TSQR:
     a fresh shard_map closure per eager call would retrace AND recompile
     every invocation — MultiheadAttention's ring path calls this eagerly).
-    Keyed on (causal, scale, S, ndim); dtype/leading-shape changes retrace
-    under the cached jit wrapper."""
+    Keyed on (causal, scale, S, ndim, impl); dtype/leading-shape changes
+    retrace under the cached jit wrapper.
+
+    Each ring step attends the resident Q block against the visiting K/V
+    block with ``ops.flash_attention_block`` — the Pallas flash kernel on
+    TPU (``impl='pallas'``), its interpreter (tests), or the shared dense
+    jnp block — which returns the normalized block output plus the row
+    logsumexp.  Blocks over disjoint key sets merge EXACTLY:
+    ``lse' = logaddexp(lse, lse_b)``; ``o' = o·e^{lse−lse'} + o_b·e^{lse_b−lse'}``.
+    Key positions rotate with their K/V block (int32 vector through the
+    same ppermute), so causal/pad masking follows the data, not the step
+    index — the kernel's per-tile live predicate skips fully-future and
+    pad-only tiles (the causal FLOP saving), replacing the old outer cond."""
+    from ..ops.flash_attention import flash_attention_block
+
     axis, size = comm.axis, comm.size
     seq_axis = nd - 2
     blk = -(-S // size)
-    masked = causal or (blk * size != S)
 
     def shard_fn(q_blk, k_blk, v_blk):
         # q_blk: (..., blk, d) — all math broadcasts over the leading axes
         my = lax.axis_index(axis)
-        q_pos = my * blk + jnp.arange(blk)
+        q_pos = (my * blk + jnp.arange(blk)).astype(jnp.int32)
 
-        def step(carry, i):
-            k_rot, v_rot, m, l, acc = carry
-            src = (my + i) % size
+        def block(k_rot, v_rot, kpos_rot):
+            return flash_attention_block(
+                q_blk, k_rot, v_rot, q_pos, kpos_rot,
+                causal=causal, scale=scale, s_valid=S, impl=impl,
+            )
 
-            def attend(operands):
-                m, l, acc = operands
-                s = jnp.einsum("...qd,...kd->...qk", q_blk, k_rot) * scale
-                if masked:
-                    kv_pos = src * blk + jnp.arange(blk)
-                    mask = kv_pos[None, :] < S  # pad keys never attend
-                    if causal:
-                        mask = mask & (q_pos[:, None] >= kv_pos[None, :])
-                    s = jnp.where(mask, s, -jnp.inf)
-                m_step = jnp.max(s, axis=-1)
-                m_new = jnp.maximum(m, m_step)
-                # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) → 0
-                safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-                p = jnp.exp(s - safe_m[..., None])
-                p = jnp.where(jnp.isfinite(s), p, 0.0)
-                corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-                l_new = l * corr + jnp.sum(p, axis=-1)
-                acc_new = acc * corr[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_rot)
-                return m_new, l_new, acc_new
-
-            if causal:
+        def step(carry, _):
+            k_rot, v_rot, kpos_rot, o, lse = carry
+            if causal and impl == "dense":
                 # skip the two GEMMs entirely when the whole K/V block is in
-                # the future of every query here (~2x causal FLOP saving)
-                fully_future = src * blk > my * blk + (blk - 1)
-                m, l, acc = lax.cond(fully_future, lambda o: o, attend, (m, l, acc))
+                # the future of every query here (~2x causal FLOP saving);
+                # the pallas kernel does this per-tile via its live predicate
+                fully_future = jnp.min(kpos_rot) > jnp.max(q_pos)
+                ob, lb = lax.cond(
+                    fully_future,
+                    lambda k_, v_, p_: (
+                        jnp.zeros(q_blk.shape, q_blk.dtype),
+                        jnp.full(q_blk.shape[:-1], -1e30, jnp.float32),
+                    ),
+                    block,
+                    k_rot, v_rot, kpos_rot,
+                )
             else:
-                m, l, acc = attend((m, l, acc))
+                ob, lb = block(k_rot, v_rot, kpos_rot)
+            lse_new = jnp.logaddexp(lse, lb)
+            w_old = jnp.exp(lse - lse_new)
+            w_new = jnp.exp(lb - lse_new)
+            o = o * w_old[..., None] + ob.astype(o.dtype) * w_new[..., None]
             perm = [((j + 1) % size, j) for j in range(size)]
             k_next = lax.ppermute(k_rot, axis, perm)
             v_next = lax.ppermute(v_rot, axis, perm)
-            return (k_next, v_next, m, l, acc), None
+            kpos_next = lax.ppermute(kpos_rot, axis, perm)
+            return (k_next, v_next, kpos_next, o, lse_new), None
 
-        m0 = jnp.full(q_blk.shape[:-1], -jnp.inf, q_blk.dtype)
-        l0 = jnp.zeros(q_blk.shape[:-1], q_blk.dtype)
-        acc0 = jnp.zeros(q_blk.shape, q_blk.dtype)
-        (k_f, v_f, m, l, acc), _ = lax.scan(
-            step, (k_blk, v_blk, m0, l0, acc0), jnp.arange(size)
+        o0 = jnp.zeros(q_blk.shape, jnp.float32)
+        # −1e30, not −inf: the first merge computes exp(lse0 − lse'), and
+        # −inf − finite is fine but −inf − (−inf) (all-masked first block
+        # sentinel) would NaN; 1e30 underflows identically
+        lse0 = jnp.full(q_blk.shape[:-1], -1e30, jnp.float32)
+        (k_f, v_f, p_f, o, lse), _ = lax.scan(
+            step, (k_blk, v_blk, q_pos, o0, lse0), None, length=size
         )
-        return acc / jnp.maximum(l, 1e-30)[..., None]
+        return o.astype(q_blk.dtype)
 
     return jax.jit(comm.shard_map(
         shard_fn,
