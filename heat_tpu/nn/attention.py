@@ -32,9 +32,9 @@ class MultiheadAttention(Module):
 
     ``apply(params, x, kv=None, causal=False, key_padding_mask=None,
     attn_mask=None)`` performs self-attention on ``x`` (B, S, E), or
-    cross-attention against ``kv`` when given (dense path only — the ring
-    rotates K/V with q's sharding, which requires the sequence axes to
-    agree).
+    cross-attention against ``kv`` (B, S_kv, E) when given — with ``comm``
+    set both ride the sequence-parallel ring (each chip keeps its resident
+    query block while the kv blocks rotate; S and S_kv may differ).
 
     Masks follow torch semantics: ``key_padding_mask`` (B, S_k) bool with
     True = ignore that key; ``attn_mask`` (S_q, S_k) bool (True = NOT
@@ -121,26 +121,32 @@ class MultiheadAttention(Module):
                 "not available on the sequence-parallel ring path"
             )
         masked = key_padding_mask is not None or attn_mask is not None
-        if masked and kv is None and self.comm is not None and self.comm.size > 1:
-            # cross-attention (kv given) never rides the ring, so masks are
-            # fine there — only the self-attention ring path rejects them
-            raise ValueError(
-                "key_padding_mask/attn_mask are not supported on the "
-                "sequence-parallel ring path — use causal=, or mask the "
-                "inputs before the layer"
-            )
+        if masked and self.comm is not None and self.comm.size > 1:
+            # masked calls fall back to the (unsharded) dense path — on a
+            # multi-device comm the self-attention ring would silently lose
+            # parallelism, so reject there; masked CROSS-attention is
+            # accepted (dense) since kv usually is short (encoder memory)
+            if kv is None:
+                raise ValueError(
+                    "key_padding_mask/attn_mask are not supported on the "
+                    "sequence-parallel ring path — use causal=, or mask the "
+                    "inputs before the layer"
+                )
         # need_weights forces the probability-returning dense path — also
         # off a SIZE-1 ring (which would otherwise run flash and return no
-        # probabilities); multi-device rings already raised above
-        ring = (self.comm is not None and kv is None and not masked
-                and not need_weights)
+        # probabilities); multi-device rings already raised above.  Both
+        # SELF- and CROSS-attention ride the ring (the kv sequence rotates
+        # against resident query blocks; lengths may differ)
+        ring = (self.comm is not None and not masked and not need_weights)
         if ring:
-            # sequence-shard the INPUT: the QKV projections are pointwise
+            # sequence-shard the INPUT(s): the QKV projections are pointwise
             # along S, so GSPMD keeps them (and the output projection below)
             # partitioned — per-chip activations and GEMM FLOPs are S/p,
             # not a replicated full-sequence copy (ragged S keeps XLA's
             # placement and the ring pads internally)
             x = self.comm.shard(x, 1)
+            if kv is not None:
+                kv = self.comm.shard(kv, 1)
         w = params["in_proj_weight"]
         b = params.get("in_proj_bias")
         if kv is None:

@@ -117,11 +117,16 @@ def _online_update(s, v_ref, m_scr, l_scr, acc_scr):
 def _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
     out = acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
-    # logsumexp per row, for the backward recompute (finite even for
-    # fully-masked rows: log(1e-30) ≈ -69, where exp(s - lse) = 0)
-    lse_ref[0] = jnp.where(
+    # logsumexp per row, for the backward recompute and the cross-block
+    # merge.  Zero-mass (fully-masked) rows emit -1e30, NOT log(1e-30):
+    # a ~-69 sentinel would act as real probability mass in the ring's
+    # logaddexp merge and crush rows whose true logsumexp is below ~-62;
+    # exp(s - (-1e30)) still recomputes p = 0 (s is -inf there), and
+    # exp(-1e30 - lse') underflows to an exact 0 merge weight
+    lse = jnp.where(
         jnp.isfinite(m_scr[:, 0]), m_scr[:, 0], 0.0
     ) + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+    lse_ref[0] = jnp.where(l_scr[:, 0] > 0.0, lse, -1e30)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -465,7 +470,6 @@ def _flash_fwd_impl(q, k, v, causal: bool, scale: float, s_valid: int,
 def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
                     s_valid: int, interpret: bool):
     B, Sp, d = q.shape
-    Sq = Sk = Sp  # square local block (q/k/v share S)
     blk_q, blk_k, nq, nk = _blocks(Sp)
     masked = causal or (Sp != s_valid)
     # D_i = Σ_d dOᵢ ⊙ Oᵢ — one cheap fused elementwise pass, fine in XLA
@@ -482,7 +486,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
         grid=(B, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((B, Sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Sp, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, dd)
@@ -500,8 +504,8 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Sk, d), k.dtype),
-            jax.ShapeDtypeStruct((B, Sk, d), v.dtype),
+            jax.ShapeDtypeStruct((B, Sp, d), k.dtype),
+            jax.ShapeDtypeStruct((B, Sp, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, d), jnp.float32),
@@ -689,7 +693,8 @@ def _dense_block_pos(q, k, v, q_pos, k_pos, causal: bool, scale: float,
     l = jnp.sum(p, axis=-1)
     out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
     out = out / jnp.maximum(l, 1e-30)[..., None].astype(out.dtype)
-    lse = safe_m + jnp.log(jnp.maximum(l, 1e-30))
+    # zero-mass rows emit the -1e30 no-mass sentinel (see _finalize)
+    lse = jnp.where(l > 0.0, safe_m + jnp.log(jnp.maximum(l, 1e-30)), -1e30)
     return out.astype(q.dtype), lse
 
 

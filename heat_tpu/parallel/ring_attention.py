@@ -89,8 +89,17 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
     memory is one kernel tile — O(blk·512) — rather than the whole
     (S/p)² block; blocks merge exactly across steps via their logsumexp.
     ``kernel`` picks the per-step implementation (see :func:`_block_impl`).
+
+    CROSS-attention is sequence-parallel too: ``k``/``v`` may carry a
+    different sequence length than ``q`` (leading axes and ``d`` must
+    match) — each chip keeps its resident S_q/p query block while the
+    S_kv/p key/value blocks rotate, so encoder-decoder attention scales
+    with the mesh exactly like self-attention.  ``causal`` with
+    rectangular shapes keeps the top-left-aligned convention (query at
+    global position i attends key positions <= i).
     """
     S, d = q.shape[-2:]
+    S_kv = k.shape[-2]
     if scale is None:
         scale = 1.0 / (d**0.5)
     try:
@@ -103,50 +112,60 @@ def ring_attention(q, k, v, comm, causal: bool = False, scale: Optional[float] =
             "it is compiled into the cached ring program; a traced value "
             "(e.g. a jit argument) is not supported"
         ) from e
-    if k.shape != q.shape or v.shape != q.shape:
+    if k.shape != v.shape or k.shape[:-2] != q.shape[:-2] or k.shape[-1] != d:
         # the sharded ring path has no broadcast semantics (each operand is
-        # split with q's spec); demand identical shapes up front
+        # split with its own seq axis; only the kv sequence length may
+        # differ from q's) — demand congruent shapes up front
         raise ValueError(
-            f"ring_attention requires identically-shaped q/k/v, got "
-            f"{q.shape}, {k.shape}, {v.shape} — broadcast/repeat shared K/V "
-            f"(e.g. MQA) to q's shape before the call"
+            f"ring_attention requires k.shape == v.shape and q/k agreeing "
+            f"in every axis but the sequence, got {q.shape}, {k.shape}, "
+            f"{v.shape} — broadcast/repeat shared K/V (e.g. MQA) to q's "
+            f"leading shape before the call"
         )
     axis, size = comm.axis, comm.size
     if size == 1:
         # degenerate ring: one chip holds the whole sequence — run the
         # flash-fused local kernel (Pallas on TPU, dense fallback elsewhere)
-        from ..ops.flash_attention import flash_attention
-
         path_counts["global"] += 1
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        if k.shape == q.shape:
+            from ..ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        return _global_attention(q, k, v, causal, scale)
     path_counts["ring"] += 1
 
     seq_axis = q.ndim - 2
-    blk = -(-S // size)  # ceil-div block; last block(s) carry pad rows
-    Sp = blk * size
-    pad = Sp - S
-    if pad:
-        widths = [(0, 0)] * q.ndim
-        widths[seq_axis] = (0, pad)
-        q = jnp.pad(q, widths)
-        k = jnp.pad(k, widths)
-        v = jnp.pad(v, widths)
+    blk_q = -(-S // size)  # ceil-div blocks; last block(s) carry pad rows
+    blk_k = -(-S_kv // size)
+    pad_q = blk_q * size - S
+    pad_k = blk_k * size - S_kv
 
-    out = _ring_program(comm, causal, scale, S, q.ndim,
+    def _pad_seq(t, pad):
+        widths = [(0, 0)] * t.ndim
+        widths[seq_axis] = (0, pad)
+        return jnp.pad(t, widths)
+
+    if pad_q:
+        q = _pad_seq(q, pad_q)
+    if pad_k:
+        k = _pad_seq(k, pad_k)
+        v = _pad_seq(v, pad_k)
+
+    out = _ring_program(comm, causal, scale, S, S_kv, q.ndim,
                         _block_impl(comm, kernel))(q, k, v)
-    if pad:
+    if pad_q:
         out = lax.slice_in_dim(out, 0, S, axis=seq_axis)
     return out
 
 
 @comm_cached
-def _ring_program(comm, causal: bool, scale: float, S: int, nd: int,
-                  impl: str):
+def _ring_program(comm, causal: bool, scale: float, S: int, S_kv: int,
+                  nd: int, impl: str):
     """Jitted + comm-cached ring pipeline (same recompile lesson as TSQR:
     a fresh shard_map closure per eager call would retrace AND recompile
     every invocation — MultiheadAttention's ring path calls this eagerly).
-    Keyed on (causal, scale, S, ndim, impl); dtype/leading-shape changes
-    retrace under the cached jit wrapper.
+    Keyed on (causal, scale, S, S_kv, ndim, impl); dtype/leading-shape
+    changes retrace under the cached jit wrapper.
 
     Each ring step attends the resident Q block against the visiting K/V
     block with ``ops.flash_attention_block`` — the Pallas flash kernel on
@@ -163,16 +182,25 @@ def _ring_program(comm, causal: bool, scale: float, S: int, nd: int,
     axis, size = comm.axis, comm.size
     seq_axis = nd - 2
     blk = -(-S // size)
+    blk_k = -(-S_kv // size)
 
     def shard_fn(q_blk, k_blk, v_blk):
-        # q_blk: (..., blk, d) — all math broadcasts over the leading axes
+        # q_blk: (..., blk, d); k/v: (..., blk_k, d) — cross-attention may
+        # carry a different kv length; all math broadcasts over the leading
+        # axes
         my = lax.axis_index(axis)
         q_pos = (my * blk + jnp.arange(blk)).astype(jnp.int32)
+        kv_pos0 = (my * blk_k + jnp.arange(blk_k)).astype(jnp.int32)
+
+        # an evenly-divisible non-causal ring has no pad keys and no causal
+        # constraint: pass the no-pad sentinel so the block skips mask
+        # construction entirely (the pre-kernel code's masked fast path)
+        s_valid = S_kv if (causal or blk_k * size != S_kv) else 2**31 - 1
 
         def block(k_rot, v_rot, kpos_rot):
             return flash_attention_block(
                 q_blk, k_rot, v_rot, q_pos, kpos_rot,
-                causal=causal, scale=scale, s_valid=S, impl=impl,
+                causal=causal, scale=scale, s_valid=s_valid, impl=impl,
             )
 
         def step(carry, _):
@@ -209,7 +237,7 @@ def _ring_program(comm, causal: bool, scale: float, S: int, nd: int,
         # sentinel) would NaN; 1e30 underflows identically
         lse0 = jnp.full(q_blk.shape[:-1], -1e30, jnp.float32)
         (k_f, v_f, p_f, o, lse), _ = lax.scan(
-            step, (k_blk, v_blk, q_pos, o0, lse0), None, length=size
+            step, (k_blk, v_blk, kv_pos0, o0, lse0), None, length=size
         )
         return o.astype(q_blk.dtype)
 

@@ -170,6 +170,28 @@ class TestFlashRing:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("kern", ["dense", "flash"])
+    def test_very_negative_scores_survive_merge(self, kern):
+        """Regression: rows whose TRUE logsumexp is below ~-62 must not be
+        crushed by masked blocks' no-mass sentinel in the cross-step merge
+        (softmax is shift-invariant — the output is a well-defined average
+        regardless of the absolute score level)."""
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.ring_attention import ring_attention
+
+        comm = ht.communication.get_comm()
+        rng = np.random.default_rng(11)
+        S, d = 24, 8
+        # anticorrelated q/k: every score ≈ -a² · scale ≈ -90
+        a = 30.0
+        q = jnp.full((1, S, d), a / np.sqrt(d), jnp.float32)
+        k = -q
+        v = jnp.asarray(rng.normal(size=(1, S, d)), jnp.float32)
+        out = ring_attention(q, k, v, comm, causal=True, kernel=kern)
+        ref = np.stack([_oracle(*map(np.asarray, (q[0], k[0], v[0])), True)])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
     def test_block_merge_identity(self):
         """flash_attention_block's contract: attending two disjoint key sets
         and merging via logsumexp equals attending their union."""
@@ -196,6 +218,99 @@ class TestFlashRing:
                   + o2 * jnp.exp(l2 - lse)[..., None])
         np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
                                    atol=2e-6)
+
+
+class TestCrossRingAttention:
+    """Sequence-parallel CROSS-attention: q keeps its resident block while a
+    differently-sized kv sequence rotates.  Rectangular causal keeps the
+    top-left-aligned convention (query at global i attends keys <= i)."""
+
+    def _ref(self, q, k, v, causal):
+        Sq, Sk = q.shape[-2], k.shape[-2]
+        s = np.einsum("...qd,...kd->...qk", q, k) / np.sqrt(q.shape[-1])
+        if causal:
+            mask = np.arange(Sq)[:, None] >= np.arange(Sk)[None, :]
+            s = np.where(mask, s, -np.inf)
+        alive = np.isfinite(s).any(-1, keepdims=True)
+        s = np.where(alive, s, 0.0)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        p = np.where(alive, p, 0.0)
+        return np.einsum("...qk,...kd->...qd", p, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shapes", [(40, 24), (37, 53)])  # ragged both ways
+    def test_matches_dense(self, shapes, causal):
+        import importlib
+
+        import jax.numpy as jnp
+
+        ra = importlib.import_module("heat_tpu.parallel.ring_attention")
+        comm = ht.communication.get_comm()
+        Sq, Sk = shapes
+        rng = np.random.default_rng(Sq)
+        q = jnp.asarray(rng.normal(size=(2, Sq, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, Sk, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, Sk, 8)), jnp.float32)
+        before = dict(ra.path_counts)
+        out = ra.ring_attention(q, k, v, comm, causal=causal)
+        if comm.is_distributed():
+            assert ra.path_counts["ring"] == before["ring"] + 1
+        np.testing.assert_allclose(
+            np.asarray(out),
+            self._ref(*map(np.asarray, (q, k, v)), causal),
+            atol=2e-5,
+        )
+
+    def test_flash_kernel_and_grads(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.ring_attention import (
+            _global_attention, ring_attention,
+        )
+
+        comm = ht.communication.get_comm()
+        rng = np.random.default_rng(5)
+        Sq, Sk, d = 24, 16, 8
+        q = jnp.asarray(rng.normal(size=(2, Sq, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, Sk, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, Sk, d)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(2, Sq, d)), jnp.float32)
+        out = ring_attention(q, k, v, comm, kernel="flash")
+        ref = _global_attention(q, k, v, False, d**-0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                ring_attention(q, k, v, comm, kernel="flash") * w),
+            (0, 1, 2))(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(_global_attention(q, k, v, False, d**-0.5) * w),
+            (0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_mha_cross_rides_the_ring(self):
+        import importlib
+
+        import jax
+        import jax.numpy as jnp
+
+        ra = importlib.import_module("heat_tpu.parallel.ring_attention")
+        comm = ht.communication.get_comm()
+        mha = ht.nn.MultiheadAttention(16, 2, comm=comm)
+        params = mha.init(jax.random.key(0))
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(2, 40, 16)), jnp.float32)
+        kv = jnp.asarray(rng.normal(size=(2, 24, 16)), jnp.float32)
+        before = dict(ra.path_counts)
+        y = mha.apply(params, x, kv=kv)
+        counted = ra.path_counts["ring" if comm.is_distributed() else "global"]
+        assert counted == before["ring" if comm.is_distributed() else "global"] + 1
+        assert len(y.sharding.device_set) == comm.size
+        y0 = ht.nn.MultiheadAttention(16, 2).apply(params, x, kv=kv)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y0), atol=2e-5)
 
 
 class TestBatchedRingAttention:
