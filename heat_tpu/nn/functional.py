@@ -90,16 +90,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / (d**0.5)
     if enable_gqa and q.ndim >= 3 and k.shape[-3] != q.shape[-3]:
-        # grouped-query attention (torch enable_gqa): repeat each K/V head
-        # for its query-head group.  Materializes the broadcast (H_q/H_kv x
-        # the K/V memory) — acceptable at the local-block sizes this
-        # function serves; a head-mapping flash kernel would avoid it
+        # grouped-query attention (torch enable_gqa): the head-mapping flash
+        # kernel attends each query head against its group's shared K/V
+        # head directly — the H_q/H_kv-fold K/V repeat never reaches HBM
+        # (forward or backward); off-TPU it falls back to the dense path
+        # over a materialized repeat internally
         hq, hkv = q.shape[-3], k.shape[-3]
         if hq % hkv:
             raise ValueError(
                 f"enable_gqa requires query heads ({hq}) divisible by "
                 f"key/value heads ({hkv})"
             )
+        if attn_mask is None and k.shape == v.shape \
+                and q.shape[-2:] == k.shape[-2:] \
+                and q.shape[:-3] == k.shape[:-3]:
+            # (unequal-but-broadcastable leading axes keep the repeat +
+            # dense einsum path below, as before the kernel existed)
+            from ..ops.flash_attention import flash_attention_gqa
+
+            return flash_attention_gqa(q, k, v, causal=is_causal, scale=scale)
         k = jnp.repeat(k, hq // hkv, axis=-3)
         v = jnp.repeat(v, hq // hkv, axis=-3)
     from ..ops.flash_attention import _dense_attention, flash_attention

@@ -41,7 +41,7 @@ try:  # pragma: no cover - import guard mirrors kmeans_kernels
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["flash_attention", "flash_attention_block"]
+__all__ = ["flash_attention", "flash_attention_block", "flash_attention_gqa"]
 
 # 512x512 measured best-in-family on v5e at (B,H,S,d)=(4,8,4096,64) causal
 # bf16: ~2.1 ms/iter slope-timed vs ~5.2 at 256x256 and ~9.5 for the dense
@@ -375,11 +375,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr,
-                          *, scale, causal, s_valid, blk_q, blk_k, nq, masked):
+                          *, scale, causal, s_valid, blk_q, blk_k, nq, masked,
+                          nq_inner: int = 0):
+    """dk/dv accumulation sweep.  ``nq`` is the TOTAL innermost sweep length
+    (init at 0, write at nq-1); ``nq_inner`` (default: nq) is the number of
+    Q blocks PER head — under GQA the sweep interleaves the g query heads of
+    this K/V head's group, so the block offset is the sweep index modulo
+    nq_inner while the accumulator runs through all g·nq_inner steps."""
     ik = pl.program_id(1)  # fixed K/V block
-    iq = pl.program_id(2)  # sweeping Q blocks
+    raw = pl.program_id(2)  # sweeping Q blocks (x group heads under GQA)
+    iq = raw % (nq_inner or nq)
 
-    @pl.when(iq == 0)
+    @pl.when(raw == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -410,7 +417,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(iq == nq - 1)
+    @pl.when(raw == nq - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -418,6 +425,25 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 def _blocks(Sp: int):
     return _blocks_rect(Sp, Sp)
+
+
+def _pallas_gate(S: int, d: int):
+    """THE kernel-dispatch gate, shared by every flash entry point so the
+    platform policy and VMEM budget cannot drift between them.  CPU runs
+    the interpreter (slow): test scale only, like the kmeans kernels'
+    16384-row gate.  The VMEM estimate covers Q/K/V/O blocks + scores +
+    accumulator in f32 (conservative, as in kmeans_kernels; Mosaic
+    failures under an outer jit cannot be caught at call time, so oversize
+    shapes bail here).  Returns ``(use_pallas, blk, platform)``."""
+    platform = jax.devices()[0].platform
+    use_pallas = _HAS_PALLAS and (
+        platform == "tpu" or (platform == "cpu" and S <= 512)
+    )
+    blk = min(_BLK_Q, _BLK_K, _round_up(S, 128))
+    if use_pallas:
+        vmem = 4 * (3 * blk * d + 2 * blk * d + blk * blk + 2 * blk)
+        use_pallas = vmem <= 12 * 2**20
+    return use_pallas, blk, platform
 
 
 def _blocks_rect(Sq: int, Sk: int):
@@ -778,19 +804,7 @@ def flash_attention(q, k, v, causal: bool = False,
         scale = 1.0 / (d**0.5)
     scale = float(scale)
 
-    platform = jax.devices()[0].platform
-    # CPU runs the interpreter (slow): only at test scale, like the kmeans
-    # kernels' 16384-row gate
-    use_pallas = _HAS_PALLAS and (
-        platform == "tpu" or (platform == "cpu" and S <= 512)
-    )
-    # VMEM gate: Q/K/V/O blocks + scores + accumulator, f32 (same
-    # conservative scheme as kmeans_kernels; Mosaic failures under an outer
-    # jit cannot be caught below, so oversize shapes bail here)
-    blk = min(_BLK_Q, _BLK_K, _round_up(S, 128))
-    if use_pallas:
-        vmem = 4 * (3 * blk * d + 2 * blk * d + blk * blk + 2 * blk)
-        use_pallas = vmem <= 12 * 2**20
+    use_pallas, blk, platform = _pallas_gate(S, d)
     if not use_pallas:
         path_counts["dense"] += 1
         return _dense_attention(q, k, v, causal, scale, S)
@@ -814,6 +828,212 @@ def flash_attention(q, k, v, causal: bool = False,
     except Exception:
         path_counts["dense"] += 1
         return _dense_attention(q, k, v, causal, scale, S)
+    path_counts["pallas"] += 1
+    if Sp != S:
+        out = out[:, :S]
+    return out.reshape(q.shape)
+
+
+# --------------------------------------------------------------------- #
+# grouped-query attention (GQA/MQA): head-mapping kernels
+#
+# K/V carry H_kv heads serving H_q = g·H_kv query heads.  The kernels are
+# the SAME bodies as the square local flash above — only the BlockSpec
+# index maps change: each flattened (batch·head) query row b reads K/V row
+# (b // hq)·hk + (b % hq) // g, so the g-fold K/V repeat that
+# ``jnp.repeat`` would materialize in HBM never exists.  The dk/dv sweep
+# runs the g query heads of a K/V head's group through one accumulator
+# (grid (B·hk, nk, g·nq), block offset = sweep index mod nq).
+# --------------------------------------------------------------------- #
+
+
+def _gqa_kv_row(b, hq: int, hk: int):
+    g = hq // hk
+    return (b // hq) * hk + (b % hq) // g
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "s_valid", "hq", "hk", "interpret"),
+)
+def _flash_gqa_fwd_impl(q, k, v, causal: bool, scale: float, s_valid: int,
+                        hq: int, hk: int, interpret: bool):
+    BHq, Sp, d = q.shape
+    blk_q, blk_k, nq, nk = _blocks(Sp)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, s_valid=s_valid,
+        blk_q=blk_q, blk_k=blk_k, nk=nk,
+        masked=causal or (Sp != s_valid),
+    )
+    kvrow = functools.partial(_gqa_kv_row, hq=hq, hk=hk)
+    return pl.pallas_call(
+        kernel,
+        grid=(BHq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, iq, ik: (kvrow(b), ik, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, iq, ik: (kvrow(b), ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, iq, ik: (b, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BHq, Sp, d), q.dtype),
+            jax.ShapeDtypeStruct((BHq, Sp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "s_valid", "hq", "hk", "interpret"),
+)
+def _flash_gqa_bwd_impl(q, k, v, out, lse, do, causal: bool, scale: float,
+                        s_valid: int, hq: int, hk: int, interpret: bool):
+    BHq, Sp, d = q.shape
+    BHk = k.shape[0]
+    g = hq // hk
+    blk_q, blk_k, nq, nk = _blocks(Sp)
+    masked = causal or (Sp != s_valid)
+    dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    kvrow = functools.partial(_gqa_kv_row, hq=hq, hk=hk)
+
+    # dq sweep: identical to the square kernel, K/V rows mapped per group
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (kvrow(b), j, 0))
+    rowspec = pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, s_valid=s_valid,
+            blk_q=blk_q, blk_k=blk_k, nk=nk, masked=masked,
+        ),
+        grid=(BHq, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((BHq, Sp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd)
+
+    # dk/dv sweep: one K/V head accumulates its whole group — the innermost
+    # grid interleaves the g query heads x nq blocks through ONE scratch
+    def qrow(b, i):
+        return (b // hk) * hq + (b % hk) * g + i // nq
+
+    qspec2 = pl.BlockSpec((1, blk_q, d), lambda b, j, i: (qrow(b, i), i % nq, 0))
+    kspec2 = pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, blk_q), lambda b, j, i: (qrow(b, i), i % nq))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            s_valid=s_valid, blk_q=blk_q, blk_k=blk_k, nq=g * nq,
+            nq_inner=nq, masked=masked,
+        ),
+        grid=(BHk, nk, g * nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((BHk, Sp, d), k.dtype),
+            jax.ShapeDtypeStruct((BHk, Sp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_gqa(q, k, v, causal: bool, scale: float, s_valid: int,
+               hq: int, hk: int, interpret: bool):
+    out, _ = _flash_gqa_fwd_impl(q, k, v, causal, scale, s_valid, hq, hk,
+                                 interpret)
+    return out
+
+
+def _flash_gqa_fwd_rule(q, k, v, causal, scale, s_valid, hq, hk, interpret):
+    out, lse = _flash_gqa_fwd_impl(q, k, v, causal, scale, s_valid, hq, hk,
+                                   interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_gqa_bwd_rule(causal, scale, s_valid, hq, hk, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_gqa_bwd_impl(q, k, v, out, lse, do, causal, scale, s_valid,
+                               hq, hk, interpret)
+
+
+_flash_gqa.defvjp(_flash_gqa_fwd_rule, _flash_gqa_bwd_rule)
+
+
+def flash_attention_gqa(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Grouped-query attention, flash-fused on TPU without repeating K/V.
+
+    ``q``: ``(..., H_q, S, d)``; ``k, v``: ``(..., H_kv, S, d)`` with
+    ``H_q % H_kv == 0`` and identical leading axes.  Each query head
+    attends its group's shared K/V head straight from the kernel's index
+    map — the ``H_q/H_kv``-fold K/V broadcast that ``jnp.repeat`` would
+    write to HBM never materializes, forward or backward.  Returns
+    ``(..., H_q, S, d)`` in q's dtype; same causal/masked-row semantics as
+    :func:`flash_attention`.  Falls back to the dense path over repeated
+    K/V off-TPU / past the VMEM gate.
+    """
+    if q.ndim < 3 or k.shape != v.shape or q.shape[:-3] != k.shape[:-3] \
+            or q.shape[-2:] != k.shape[-2:]:
+        raise ValueError(
+            f"flash_attention_gqa requires (..., H_q, S, d) q and "
+            f"(..., H_kv, S, d) k == v, got {q.shape}, {k.shape}, {v.shape}"
+        )
+    hq, hk = q.shape[-3], k.shape[-3]
+    if hq % hk:
+        raise ValueError(
+            f"query heads ({hq}) must be a multiple of key/value heads ({hk})"
+        )
+    S, d = q.shape[-2:]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    scale = float(scale)
+    if hq == hk:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    def _dense_fallback():
+        g = hq // hk
+        path_counts["dense"] += 1
+        return _dense_attention(
+            q, jnp.repeat(k, g, axis=-3), jnp.repeat(v, g, axis=-3),
+            causal, scale, S,
+        )
+
+    use_pallas, blk, platform = _pallas_gate(S, d)
+    if not use_pallas:
+        return _dense_fallback()
+
+    lead = q.shape[:-3]
+    B = 1
+    for a in lead:
+        B *= int(a)
+    Sp = -(-S // blk) * blk
+    qf = q.reshape((B * hq, S, d))
+    kf = k.reshape((B * hk, S, d))
+    vf = v.reshape((B * hk, S, d))
+    if Sp != S:
+        pad = ((0, 0), (0, Sp - S), (0, 0))
+        qf, kf, vf = (jnp.pad(t, pad) for t in (qf, kf, vf))
+    try:
+        out = _flash_gqa(qf, kf, vf, causal, scale, S, hq, hk,
+                         platform == "cpu")
+    except Exception:
+        return _dense_fallback()
     path_counts["pallas"] += 1
     if Sp != S:
         out = out[:, :S]

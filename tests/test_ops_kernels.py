@@ -1,6 +1,7 @@
 """Pallas kernel tests (interpret mode on the CPU mesh)."""
 
 import numpy as np
+import pytest
 
 import heat_tpu as ht
 
@@ -208,3 +209,119 @@ class TestFlashAttention:
         ref = self._dense(q, k, v, True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestFlashGQA:
+    """Grouped-query attention kernel: each query head reads its group's
+    K/V head straight from the grid index map — no repeated K/V in HBM,
+    forward or backward (the dk/dv sweep accumulates a whole group through
+    one scratch).  Oracle: dense attention over an explicit repeat."""
+
+    def _ref(self, q, k, v, causal):
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.flash_attention import _dense_attention
+
+        g = q.shape[-3] // k.shape[-3]
+        return _dense_attention(
+            q, jnp.repeat(k, g, axis=-3), jnp.repeat(v, g, axis=-3),
+            causal, q.shape[-1] ** -0.5, q.shape[-2],
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("heads", [(4, 2), (4, 1)])  # GQA and MQA
+    def test_matches_repeat_oracle(self, heads, causal):
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.flash_attention import (
+            flash_attention_gqa, path_counts,
+        )
+
+        hq, hk = heads
+        rng = np.random.default_rng(hq * 10 + hk)
+        B, S, d = 2, 40, 8
+        q = jnp.asarray(rng.normal(size=(B, hq, S, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, hk, S, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, hk, S, d)), jnp.float32)
+        before = path_counts["pallas"]
+        out = flash_attention_gqa(q, k, v, causal=causal)
+        assert path_counts["pallas"] == before + 1  # kernel, not fallback
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(q, k, v, causal)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_grads_match_repeat_oracle(self):
+        """dk/dv arrive in K/V-head shape (the group-summed gradient) and
+        match differentiating the dense repeat."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.flash_attention import flash_attention_gqa
+
+        rng = np.random.default_rng(3)
+        B, hq, hk, S, d = 2, 4, 2, 37, 8  # ragged S exercises pad keys
+        q = jnp.asarray(rng.normal(size=(B, hq, S, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, hk, S, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, hk, S, d)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(B, hq, S, d)), jnp.float32)
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention_gqa(q, k, v, causal=True) * w),
+            (0, 1, 2))(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(self._ref(q, k, v, True) * w),
+            (0, 1, 2))(q, k, v)
+        assert g[1].shape == k.shape and g[2].shape == v.shape
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_routes_gqa_to_kernel(self):
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+        from heat_tpu.ops.flash_attention import path_counts
+
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.normal(size=(2, 4, 24, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, 1, 24, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 1, 24, 8)), jnp.float32)
+        before = path_counts["pallas"]
+        y = ht.nn.functional.scaled_dot_product_attention(
+            q, k, v, is_causal=True, enable_gqa=True)
+        assert path_counts["pallas"] == before + 1
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(self._ref(q, k, v, True)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_shape_validation(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.ops.flash_attention import flash_attention_gqa
+
+        q = jnp.zeros((2, 3, 8, 4))
+        kv = jnp.zeros((2, 2, 8, 4))
+        with pytest.raises(ValueError, match="multiple"):
+            flash_attention_gqa(q, kv, kv)
+
+    def test_sdpa_gqa_broadcastable_batch_still_works(self):
+        """Unequal-but-broadcastable leading axes must keep the repeat +
+        dense einsum path (regression: the kernel route briefly rejected
+        them)."""
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+
+        rng = np.random.default_rng(6)
+        q = jnp.asarray(rng.normal(size=(2, 4, 24, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, 24, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 1, 24, 8)), jnp.float32)
+        y = ht.nn.functional.scaled_dot_product_attention(
+            q, k, v, is_causal=True, enable_gqa=True)
+        kb = jnp.broadcast_to(k, (2, 1, 24, 8))
+        vb = jnp.broadcast_to(v, (2, 1, 24, 8))
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(self._ref(q, kb, vb, True)),
+            rtol=1e-5, atol=1e-5,
+        )
