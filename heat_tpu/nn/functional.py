@@ -93,8 +93,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         # grouped-query attention (torch enable_gqa): the head-mapping flash
         # kernel attends each query head against its group's shared K/V
         # head directly — the H_q/H_kv-fold K/V repeat never reaches HBM
-        # (forward or backward); off-TPU it falls back to the dense path
-        # over a materialized repeat internally
+        # (forward or backward); past flash_attention_gqa's dispatch gate
+        # (non-TPU/non-interpreter platforms, VMEM-oversize shapes) it
+        # falls back to the dense path over a materialized repeat
         hq, hkv = q.shape[-3], k.shape[-3]
         if hq % hkv:
             raise ValueError(

@@ -427,6 +427,27 @@ def _blocks(Sp: int):
     return _blocks_rect(Sp, Sp)
 
 
+def _run_flash_padded(flat_ops, S: int, blk: int, call, dense_fallback):
+    """THE kernel-dispatch tail shared by the flash entry points: pad the
+    sequence axis of the flattened (B, S, d) operands to a block multiple,
+    run ``call`` (falling back to ``dense_fallback`` if the kernel path
+    raises), keep the path counters, and slice the pad rows back off.
+    ``dense_fallback`` must NOT touch the counters — this helper does."""
+    Sp = -(-S // blk) * blk
+    if Sp != S:
+        pad = ((0, 0), (0, Sp - S), (0, 0))
+        flat_ops = tuple(jnp.pad(t, pad) for t in flat_ops)
+    try:
+        out = call(*flat_ops)
+    except Exception:
+        path_counts["dense"] += 1
+        return dense_fallback()
+    path_counts["pallas"] += 1
+    if Sp != S:
+        out = out[:, :S]
+    return out
+
+
 def _pallas_gate(S: int, d: int):
     """THE kernel-dispatch gate, shared by every flash entry point so the
     platform policy and VMEM budget cannot drift between them.  CPU runs
@@ -813,24 +834,15 @@ def flash_attention(q, k, v, causal: bool = False,
     B = 1
     for a in lead:
         B *= int(a)
-    Sp = -(-S // blk) * blk  # pad S to a block multiple; pad keys masked
-    qf = q.reshape((B, S, d))
-    kf = k.reshape((B, S, d))
-    vf = v.reshape((B, S, d))
-    if Sp != S:
-        pad = ((0, 0), (0, Sp - S), (0, 0))
-        qf, kf, vf = (jnp.pad(t, pad) for t in (qf, kf, vf))
-    try:
-        # custom_vjp: jax.grad runs the Pallas backward kernels (dq sweep +
-        # dk/dv sweep) instead of failing out of pallas_call's missing
-        # autodiff rule — training keeps the flash memory profile
-        out = _flash(qf, kf, vf, causal, scale, S, platform == "cpu")
-    except Exception:
-        path_counts["dense"] += 1
-        return _dense_attention(q, k, v, causal, scale, S)
-    path_counts["pallas"] += 1
-    if Sp != S:
-        out = out[:, :S]
+    # custom_vjp: jax.grad runs the Pallas backward kernels (dq sweep +
+    # dk/dv sweep) instead of failing out of pallas_call's missing
+    # autodiff rule — training keeps the flash memory profile
+    out = _run_flash_padded(
+        (q.reshape((B, S, d)), k.reshape((B, S, d)), v.reshape((B, S, d))),
+        S, blk,
+        lambda a, b, c: _flash(a, b, c, causal, scale, S, platform == "cpu"),
+        lambda: _dense_attention(q, k, v, causal, scale, S),
+    )
     return out.reshape(q.shape)
 
 
@@ -985,8 +997,10 @@ def flash_attention_gqa(q, k, v, causal: bool = False,
     map — the ``H_q/H_kv``-fold K/V broadcast that ``jnp.repeat`` would
     write to HBM never materializes, forward or backward.  Returns
     ``(..., H_q, S, d)`` in q's dtype; same causal/masked-row semantics as
-    :func:`flash_attention`.  Falls back to the dense path over repeated
-    K/V off-TPU / past the VMEM gate.
+    :func:`flash_attention`.  Dispatch follows ``_pallas_gate`` exactly
+    like :func:`flash_attention` (TPU kernel; CPU interpreter at test
+    scale; dense path over a repeated K/V everywhere else, incl. past the
+    VMEM gate).
     """
     if q.ndim < 3 or k.shape != v.shape or q.shape[:-3] != k.shape[:-3] \
             or q.shape[-2:] != k.shape[-2:]:
@@ -1008,7 +1022,6 @@ def flash_attention_gqa(q, k, v, causal: bool = False,
 
     def _dense_fallback():
         g = hq // hk
-        path_counts["dense"] += 1
         return _dense_attention(
             q, jnp.repeat(k, g, axis=-3), jnp.repeat(v, g, axis=-3),
             causal, scale, S,
@@ -1016,25 +1029,19 @@ def flash_attention_gqa(q, k, v, causal: bool = False,
 
     use_pallas, blk, platform = _pallas_gate(S, d)
     if not use_pallas:
+        path_counts["dense"] += 1
         return _dense_fallback()
 
     lead = q.shape[:-3]
     B = 1
     for a in lead:
         B *= int(a)
-    Sp = -(-S // blk) * blk
-    qf = q.reshape((B * hq, S, d))
-    kf = k.reshape((B * hk, S, d))
-    vf = v.reshape((B * hk, S, d))
-    if Sp != S:
-        pad = ((0, 0), (0, Sp - S), (0, 0))
-        qf, kf, vf = (jnp.pad(t, pad) for t in (qf, kf, vf))
-    try:
-        out = _flash_gqa(qf, kf, vf, causal, scale, S, hq, hk,
-                         platform == "cpu")
-    except Exception:
-        return _dense_fallback()
-    path_counts["pallas"] += 1
-    if Sp != S:
-        out = out[:, :S]
+    out = _run_flash_padded(
+        (q.reshape((B * hq, S, d)), k.reshape((B * hk, S, d)),
+         v.reshape((B * hk, S, d))),
+        S, blk,
+        lambda a, b, c: _flash_gqa(a, b, c, causal, scale, S, hq, hk,
+                                   platform == "cpu"),
+        _dense_fallback,
+    )
     return out.reshape(q.shape)

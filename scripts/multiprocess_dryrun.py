@@ -172,6 +172,28 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     assert np.all(digests == digests[0]), digests
     print(f"[{pid}] DataParallel step: OK (loss={float(loss):.4f})", flush=True)
 
+    # ---- ring attention across the process boundary ------------------ #
+    # the ring's ppermute crosses the 2-process seam every rotation — this
+    # is the long-context path running over real inter-process transport
+    # (gloo standing in for DCN), not just intra-process device lanes
+    import jax.numpy as jnp
+
+    from heat_tpu.parallel.ring_attention import _global_attention, ring_attention
+
+    rng2 = np.random.default_rng(7)  # same operands on every process (SPMD)
+    S, d = 37, 8  # ragged on 8 shards
+    q = jnp.asarray(rng2.standard_normal((2, S, d)), jnp.float32)
+    k = jnp.asarray(rng2.standard_normal((2, S, d)), jnp.float32)
+    v = jnp.asarray(rng2.standard_normal((2, S, d)), jnp.float32)
+    out = ring_attention(
+        comm.shard(q, 1), comm.shard(k, 1), comm.shard(v, 1), comm, causal=True
+    )
+    assert not out.is_fully_addressable  # spans both processes
+    got = comm.host_fetch(out)
+    ref = np.asarray(_global_attention(q, k, v, True, d**-0.5))
+    np.testing.assert_allclose(got, ref, atol=2e-5)
+    print(f"[{pid}] ring attention (cross-process ppermute): OK", flush=True)
+
     print(f"[{pid}] {MARKER}", flush=True)
     ht.core.bootstrap.finalize_distributed()
 
