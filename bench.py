@@ -483,6 +483,43 @@ def main(state: dict = None) -> dict:
             extra["flash_attention_ab_error"] = str(e)[:120]
         snapshot()
 
+    # --- GQA: head-mapping kernel vs dense over a repeated K/V ------------ #
+    # 8 query heads sharing 2 K/V heads (g=4): the kernel reads each group's
+    # K/V head from its index map; the control arm materializes the 4x
+    # repeat in HBM and runs the dense path (what sdpa did before round 4c)
+    if not skip("gqa_attention_ab", 0.1):
+        try:
+            import jax.numpy as jnp
+
+            from heat_tpu.ops.flash_attention import (
+                _dense_attention, flash_attention_gqa,
+            )
+
+            Bg, Hkv, Sg = 4, 2, 4096
+            key = jax.random.key(1)
+            qg = jax.random.normal(key, (Bg, H, Sg, d), jnp.bfloat16)
+            kg, vg = (
+                jax.random.normal(jax.random.fold_in(key, i), (Bg, Hkv, Sg, d),
+                                  jnp.bfloat16)
+                for i in (1, 2)
+            )
+            t_gqa = _attn_slope(
+                lambda q, k, v: flash_attention_gqa(q, k, v, causal=True),
+                [qg, kg, vg], 2, 12,
+            )
+            t_rep = _attn_slope(
+                lambda q, k, v: _dense_attention(
+                    q, jnp.repeat(k, H // Hkv, axis=-3),
+                    jnp.repeat(v, H // Hkv, axis=-3), True, d**-0.5, Sg),
+                [qg, kg, vg], 2, 12,
+            )
+            extra["gqa_4x8over2x4096x64_kernel_ms"] = round(t_gqa * 1e3, 3)
+            extra["gqa_4x8over2x4096x64_dense_repeat_ms"] = round(t_rep * 1e3, 3)
+            extra["gqa_kernel_speedup"] = round(t_rep / t_gqa, 3)
+        except Exception as e:
+            extra["gqa_attention_ab_error"] = str(e)[:120]
+        snapshot()
+
     # long-context point, flash only (its own try: independent of the A-B
     # above): at (2, 8, 32768, 64) the dense path's f32 scores alone are
     # 64 GiB — off the table on a 16 GiB chip; flash streams them via VMEM

@@ -13,7 +13,7 @@ from typing import Sequence
 
 from . import modules as nn
 
-__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder"]
+__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder", "transformer_decoder"]
 
 
 def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
@@ -123,6 +123,32 @@ def mlp(sizes: Sequence[int] = (784, 256, 128, 10)) -> nn.Module:
     return nn.Sequential(*layers)
 
 
+def _ffn(embed_dim: int, mlp_ratio: int) -> nn.Module:
+    """THE transformer FFN sub-stack — encoder and decoder blocks share it."""
+    return nn.Sequential(
+        nn.Linear(embed_dim, mlp_ratio * embed_dim),
+        nn.GELU(),
+        nn.Linear(mlp_ratio * embed_dim, embed_dim),
+    )
+
+
+def _remat_jit(cache: dict, train: bool, block_fn):
+    """Per-train-flag jit(checkpoint(block)) cache — encoder and decoder
+    blocks share it.  Rematerializes the block under grad: activations are
+    recomputed in the backward pass instead of living in HBM for the whole
+    forward — the standard TPU trade of FLOPs for HBM that makes depth x
+    sequence-length checkpointing work.  The jit around jax.checkpoint is
+    REQUIRED (checkpoint's closed_call cannot evaluate eagerly inside the
+    ring path's shard_map) and cached per train flag so repeat applies
+    reuse one traced wrapper."""
+    fn = cache.get(train)
+    if fn is None:
+        import jax
+
+        fn = cache[train] = jax.jit(jax.checkpoint(block_fn))
+    return fn
+
+
 class _TransformerBlock(nn.Module):
     """Pre-norm transformer encoder block: x + MHA(LN(x)), then
     x + FFN(LN(x)).  ``comm`` routes the attention over the sequence-
@@ -135,11 +161,7 @@ class _TransformerBlock(nn.Module):
         self.ln1 = nn.LayerNorm(embed_dim)
         self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm)
         self.ln2 = nn.LayerNorm(embed_dim)
-        self.ff = nn.Sequential(
-            nn.Linear(embed_dim, mlp_ratio * embed_dim),
-            nn.GELU(),
-            nn.Linear(mlp_ratio * embed_dim, embed_dim),
-        )
+        self.ff = _ffn(embed_dim, mlp_ratio)
         self.causal = causal
         self.remat = remat
         self._remat_fns = {}  # train -> jitted checkpointed block
@@ -171,22 +193,10 @@ class _TransformerBlock(nn.Module):
             k1, k2 = jax.random.split(key)
 
         if self.remat:
-            # rematerialize the block under grad: activations are recomputed
-            # in the backward pass instead of living in HBM for the whole
-            # forward — the standard TPU trade of FLOPs for HBM that makes
-            # depth x sequence-length checkpointing work.  jax.checkpoint is
-            # the mechanism; the jit around it is REQUIRED (checkpoint's
-            # closed_call cannot evaluate eagerly inside the ring path's
-            # shard_map) and is cached per train flag so repeat applies
-            # reuse one compiled/traced wrapper instead of re-tracing.
-            import jax
-
-            fn = self._remat_fns.get(train)
-            if fn is None:
-                fn = self._remat_fns[train] = jax.jit(jax.checkpoint(
-                    lambda p, xx, a, b: self._block(p, xx, a, b, train)
-                ))
-            return fn(params, x, k1, k2)
+            return _remat_jit(
+                self._remat_fns, train,
+                lambda p, xx, a, b: self._block(p, xx, a, b, train),
+            )(params, x, k1, k2)
         return self._block(params, x, k1, k2, train)
 
 
@@ -219,3 +229,111 @@ def transformer_encoder(
                             remat=remat)
           for _ in range(depth)]
     )
+
+
+class _TransformerDecoderBlock(nn.Module):
+    """Pre-norm transformer DECODER block: x + SelfMHA(LN(x), causal),
+    then x + CrossMHA(LN(x), kv=memory), then x + FFN(LN(x)).  With
+    ``comm`` both attentions run on the sequence-parallel ring — the
+    causal self-attention over the decoder sequence AND the rectangular
+    cross-attention against the (differently-sized) encoder memory."""
+
+    def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
+                 comm=None, remat: bool = False):
+        from .attention import MultiheadAttention
+
+        self.ln1 = nn.LayerNorm(embed_dim)
+        self.self_attn = MultiheadAttention(embed_dim, num_heads, comm=comm)
+        self.ln2 = nn.LayerNorm(embed_dim)
+        self.cross_attn = MultiheadAttention(embed_dim, num_heads, comm=comm)
+        self.ln3 = nn.LayerNorm(embed_dim)
+        self.ff = _ffn(embed_dim, mlp_ratio)
+        self.remat = remat
+        self._remat_fns = {}
+
+    def init(self, key):
+        import jax
+
+        ks = jax.random.split(key, 6)
+        return {
+            "ln1": self.ln1.init(ks[0]), "self_attn": self.self_attn.init(ks[1]),
+            "ln2": self.ln2.init(ks[2]), "cross_attn": self.cross_attn.init(ks[3]),
+            "ln3": self.ln3.init(ks[4]), "ff": self.ff.init(ks[5]),
+        }
+
+    def _block(self, params, x, memory, k1, k2, train):
+        h = x + self.self_attn.apply(
+            params["self_attn"], self.ln1.apply(params["ln1"], x),
+            causal=True, train=train, key=k1,
+        )
+        h = h + self.cross_attn.apply(
+            params["cross_attn"], self.ln2.apply(params["ln2"], h),
+            kv=memory, train=train,
+        )
+        return h + self.ff.apply(
+            params["ff"], self.ln3.apply(params["ln3"], h),
+            train=train, key=k2,
+        )
+
+    def apply(self, params, x, memory, *, train: bool = False, key=None):
+        k1 = k2 = None
+        if key is not None:
+            import jax
+
+            k1, k2 = jax.random.split(key)
+        if self.remat:
+            return _remat_jit(
+                self._remat_fns, train,
+                lambda p, xx, mm, a, b: self._block(p, xx, mm, a, b, train),
+            )(params, x, memory, k1, k2)
+        return self._block(params, x, memory, k1, k2, train)
+
+
+class _TransformerDecoder(nn.Module):
+    """Stack of decoder blocks sharing one encoder ``memory``."""
+
+    def __init__(self, blocks):
+        self.blocks = blocks
+
+    def init(self, key):
+        import jax
+
+        keys = jax.random.split(key, max(len(self.blocks), 1))
+        return [b.init(k) for b, k in zip(self.blocks, keys)]
+
+    def apply(self, params, x, memory, *, train: bool = False, key=None):
+        import jax
+
+        for b, p in zip(self.blocks, params):
+            sub = None
+            if key is not None:
+                key, sub = jax.random.split(key)
+            x = b.apply(p, x, memory, train=train, key=sub)
+        return x
+
+
+def transformer_decoder(
+    embed_dim: int = 256,
+    num_heads: int = 8,
+    depth: int = 4,
+    mlp_ratio: int = 4,
+    comm=None,
+    remat: bool = False,
+) -> nn.Module:
+    """A stack of pre-norm transformer DECODER blocks: causal
+    self-attention + cross-attention against an encoder ``memory``.
+
+    ``apply(params, x, memory)`` with ``x`` (B, S_dec, E) and ``memory``
+    (B, S_enc, E) — the two sequence lengths are independent.  With
+    ``comm`` every block's attentions run sequence-parallel on the mesh
+    ring (the cross-attention rotates the encoder memory's K/V blocks
+    against resident decoder query blocks), so BOTH context lengths scale
+    with the chip count; ``remat=True`` checkpoints each block.  Beyond-
+    reference model family, same provenance note as
+    :func:`transformer_encoder`.
+    """
+    return _TransformerDecoder([
+        _TransformerDecoderBlock(embed_dim, num_heads, mlp_ratio, comm,
+                                 remat=remat)
+        for _ in range(depth)
+    ])
