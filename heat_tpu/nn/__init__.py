@@ -3,6 +3,8 @@
 from .modules import *
 from . import modules
 from .attention import MultiheadAttention
+from .moe import MoE
+from .pipelined import Pipelined
 from .recurrent import GRU, LSTM, RNN
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from . import functional

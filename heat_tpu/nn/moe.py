@@ -1,0 +1,214 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Beyond-reference model family (the reference has no MoE or expert
+parallelism — SURVEY §2.8 lists EP as absent), built the TPU way: the
+token→expert dispatch and combine are dense einsums over a capacity-bounded
+``(experts, capacity, d)`` buffer (static shapes, so the whole layer jits
+and rides the MXU), and with ``comm=`` the experts are sharded over the
+mesh while tokens travel through TWO ``all_to_all`` collectives — the
+canonical expert-parallel data movement on ICI.
+
+Routing is token-choice top-k with slot-priority capacity assignment: all
+first choices claim capacity before any second choice, tokens in order
+within a slot.  Selected gate weights are renormalized by their sum, and
+tokens that overflow an expert's capacity are dropped from that expert
+(contributing zero — the standard GShard/Switch overflow semantics).
+Routing is deterministic: no jitter noise, so eval == train and results
+are reproducible across device counts.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from .modules import Module
+from ..core._cache import comm_cached
+
+__all__ = ["MoE"]
+
+
+@comm_cached
+def _ep_program(comm, moe):
+    """Compiled expert-parallel forward, cached ON the comm (identity-keyed
+    on the layer instance — same convention as the other collective
+    pipelines; jit's own cache handles shape/dtype variation)."""
+    fn = comm.shard_map(
+        moe._ep_fn,
+        in_splits=(
+            {"router": (2, None), "w1": (3, 0), "b1": (2, 0), "w2": (3, 0), "b2": (2, 0)},
+            (2, 0),
+            (1, 0),
+        ),
+        out_splits=(2, 0),
+    )
+    return jax.jit(fn)
+
+
+def _routing(gates, top_k: int, capacity: int):
+    """Dispatch/combine tensors for token-choice top-k routing.
+
+    gates: (n, E) softmax router probabilities.
+    Returns ``dispatch`` (n, E, C) in {0,1} and ``combine`` (n, E, C)
+    carrying the renormalized gate weight at each token's claimed slot.
+
+    Capacity positions are claimed slot-major — every token's first choice
+    is ranked before any token's second choice — so dropping under pressure
+    removes the *weakest* assignments first.
+    """
+    n, E = gates.shape
+    val, idx = jax.lax.top_k(gates, top_k)  # (n, k)
+    val = val / (val.sum(axis=-1, keepdims=True) + 1e-9)
+
+    # slot-major priority: position of (token i, slot j) in its expert's
+    # capacity queue counts all slot-<j claims plus earlier tokens' slot-j
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # (n, k, E)
+    # claims in priority order: reshape (k, n, E) then cumulative count.
+    # zero-gate selections (masked pad tokens) must not occupy queue
+    # positions, or a pad's phantom slot-0 claim evicts real tokens under
+    # capacity pressure — mask them out of the queue entirely
+    claims = jnp.moveaxis(onehot, 1, 0)  # (k, n, E)
+    claims = claims * (jnp.moveaxis(val, 1, 0) > 0)[..., None]
+    flat = claims.reshape(top_k * n, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # claims STRICTLY before ours
+    pos = (pos_flat * flat).sum(axis=1).reshape(top_k, n)  # (k, n) queue position
+    keep = (pos < capacity) & (jnp.moveaxis(val, 1, 0) > 0)
+
+    pos = jnp.where(keep, pos, capacity)  # parked on an out-of-range slot
+    slot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # (k, n, C)
+    expert = jnp.moveaxis(onehot, 1, 0).astype(gates.dtype)  # (k, n, E)
+    # (k,n,E,C) products collapsed over slots
+    dispatch = jnp.einsum("kne,knc->nec", expert, slot)
+    combine = jnp.einsum("kn,kne,knc->nec", jnp.moveaxis(val, 1, 0), expert, slot)
+    return dispatch, combine
+
+
+class MoE(Module):
+    """Token-choice top-k mixture of FFN experts.
+
+    ``apply(params, x)`` with x (B, S, D) or (N, D).  Each expert is a
+    two-layer GELU FFN (D → hidden → D) with its own weights; a linear
+    router picks ``top_k`` experts per token.
+
+    With ``comm=`` the expert dimension is sharded over the communicator's
+    mesh axis (``num_experts % comm.size == 0``): each device routes its
+    resident tokens, ships the per-expert buffers to the expert owners with
+    one ``all_to_all``, applies its local experts, and ships results back
+    with a second ``all_to_all`` — expert parallelism exactly as run on TPU
+    pods, composing with the framework's data/sequence parallelism.  Tokens
+    are sharded over the batch axis; a ragged batch is pad-and-masked (pad
+    tokens carry zero gate weight, so they are never dispatched).
+
+    ``capacity_factor`` scales each expert's token budget
+    ``ceil(top_k * n_tokens / num_experts)``; overflow tokens contribute
+    zero for that expert.  Under ``comm=`` the budget applies per source
+    shard (the standard EP formulation — capacity is a *local* guarantee so
+    the all_to_all buffers stay static-shaped).
+    """
+
+    def __init__(
+        self,
+        embed_dim: int,
+        num_experts: int,
+        hidden_dim: int | None = None,
+        top_k: int = 2,
+        capacity_factor: float = 1.5,
+        comm=None,
+    ):
+        if top_k < 1 or top_k > num_experts:
+            raise ValueError(f"top_k {top_k} must be in [1, num_experts={num_experts}]")
+        self.embed_dim = embed_dim
+        self.num_experts = num_experts
+        self.hidden_dim = hidden_dim or 4 * embed_dim
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.comm = comm
+
+    def init(self, key):
+        D, H, E = self.embed_dim, self.hidden_dim, self.num_experts
+        kr, k1, k2 = jax.random.split(key, 3)
+        bound1 = 1.0 / jnp.sqrt(D)
+        bound2 = 1.0 / jnp.sqrt(H)
+        return {
+            "router": jax.random.uniform(kr, (D, E), minval=-bound1, maxval=bound1),
+            "w1": jax.random.uniform(k1, (E, D, H), minval=-bound1, maxval=bound1),
+            "b1": jnp.zeros((E, H)),
+            "w2": jax.random.uniform(k2, (E, H, D), minval=-bound2, maxval=bound2),
+            "b2": jnp.zeros((E, D)),
+        }
+
+    # ------------------------------------------------------------------ #
+
+    def _capacity(self, n_tokens: int) -> int:
+        import math
+
+        return max(1, math.ceil(self.top_k * n_tokens / self.num_experts * self.capacity_factor))
+
+    def _experts(self, params, buf):
+        """Apply the (possibly local-shard) stacked experts to (e, C, D)."""
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", buf, params["w1"]) + params["b1"][:, None, :])
+        return jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params["b2"][:, None, :]
+
+    def _dense(self, params, x2d):
+        gates = jax.nn.softmax(x2d @ params["router"])
+        dispatch, combine = _routing(gates, self.top_k, self._capacity(x2d.shape[0]))
+        buf = jnp.einsum("nec,nd->ecd", dispatch, x2d)
+        out = self._experts(params, buf)
+        return jnp.einsum("nec,ecd->nd", combine, out)
+
+    def _ep_fn(self, params, x_loc, mask_loc):
+        """Per-shard body: local routing, all_to_all to expert owners,
+        local expert FFNs, all_to_all back, local combine."""
+        comm = self.comm
+        n_loc = x_loc.shape[0]
+        gates = jax.nn.softmax(x_loc @ params["router"]) * mask_loc[:, None]
+        dispatch, combine = _routing(gates, self.top_k, self._capacity(n_loc))
+        buf = jnp.einsum("nec,nd->ecd", dispatch, x_loc)  # (E, C, D)
+        # ship: each owner receives its experts' buffers from every shard
+        buf = comm.Alltoall(buf, split_axis=0, concat_axis=1)  # (E/p, C*p, D)
+        out = self._experts(params, buf)
+        out = comm.Alltoall(out, split_axis=1, concat_axis=0)  # (E, C, D)
+        return jnp.einsum("nec,ecd->nd", combine, out)
+
+    def apply(self, params, x, **kw):
+        orig_shape = x.shape
+        x2d = x.reshape(-1, self.embed_dim)
+        comm = self.comm
+        if comm is None or comm.size == 1:
+            return self._dense(params, x2d).reshape(orig_shape)
+        if self.num_experts % comm.size:
+            warnings.warn(
+                f"MoE: num_experts={self.num_experts} not divisible by mesh size "
+                f"{comm.size}; running the dense (replicated-expert) path",
+                stacklevel=2,
+            )
+            return self._dense(params, x2d).reshape(orig_shape)
+
+        p = comm.size
+        n = x2d.shape[0]
+        pad = (-n) % p
+        mask = jnp.ones((n,), x2d.dtype)
+        if pad:
+            x2d = jnp.concatenate([x2d, jnp.zeros((pad, self.embed_dim), x2d.dtype)])
+            mask = jnp.concatenate([mask, jnp.zeros((pad,), x2d.dtype)])
+
+        y = _ep_program(comm, self)(params, x2d, mask)
+        if pad:
+            y = y[:n]
+        return y.reshape(orig_shape)
+
+    # ------------------------------------------------------------------ #
+
+    def load_balance_loss(self, params, x):
+        """Switch-transformer auxiliary loss: ``E * Σ_e f_e · P_e`` where
+        ``f_e`` is the fraction of tokens whose TOP choice is expert e and
+        ``P_e`` the mean router probability — minimized (=1) by a uniform
+        router.  Add ``coef * load_balance_loss`` to the training loss."""
+        x2d = x.reshape(-1, self.embed_dim)
+        gates = jax.nn.softmax(x2d @ params["router"])
+        top1 = jnp.argmax(gates, axis=-1)
+        f = jnp.mean(jax.nn.one_hot(top1, self.num_experts, dtype=gates.dtype), axis=0)
+        P = jnp.mean(gates, axis=0)
+        return self.num_experts * jnp.sum(f * P)

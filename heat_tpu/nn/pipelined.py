@@ -1,0 +1,72 @@
+"""Pipeline-parallel execution of a homogeneous block stack.
+
+``Pipelined(block, depth, comm)`` holds ``depth`` independently-initialized
+copies of one block's parameters, stacked on a leading axis, and executes
+them as ``comm.size`` pipeline stages of ``depth // comm.size`` blocks each
+via :func:`heat_tpu.parallel.pipeline.pipeline_apply` — each device stores
+ONLY its stage's slice of the parameters, so model depth scales with the
+mesh (the memory axis data parallelism cannot shard).
+
+The block must map (mb, ...) inputs to same-shaped outputs (transformer
+blocks, residual MLP towers).  Execution is deterministic — per-microbatch
+dropout keys are not threaded through the schedule; train-mode stochastic
+layers run in their eval behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from .modules import Module
+from ..parallel.pipeline import pipeline_apply
+
+__all__ = ["Pipelined"]
+
+
+class Pipelined(Module):
+    """A ``depth``-deep stack of ``block``, run pipeline-parallel over ``comm``.
+
+    ``init`` returns the stacked parameters (leaves shaped (depth, ...));
+    ``apply(params, x)`` microbatches ``x`` along its leading (batch) axis —
+    batch size divisible by ``n_microbatches`` (default ``comm.size``).
+    ``remat=True`` checkpoints each block so backward recomputes activations
+    (composes: pipeline shards depth, remat bounds per-stage live memory).
+    """
+
+    def __init__(self, block: Module, depth: int, comm, n_microbatches: int | None = None,
+                 remat: bool = False):
+        if comm is not None and depth % comm.size:
+            raise ValueError(f"depth {depth} not divisible by pipeline stages {comm.size}")
+        self.block = block
+        self.depth = depth
+        self.comm = comm
+        self.n_microbatches = n_microbatches
+        self.remat = remat
+
+    def init(self, key):
+        keys = jax.random.split(key, self.depth)
+        return jax.vmap(self.block.init)(keys)
+
+    def _stage(self, params_stage, x):
+        """One pipeline stage: scan this stage's depth//p blocks."""
+        apply = self.block.apply
+        if self.remat:
+            apply = jax.checkpoint(apply)
+
+        def bl(h, pb):
+            return apply(pb, h), None
+
+        h, _ = lax.scan(bl, x, params_stage)
+        return h
+
+    def apply(self, params, x, **kw):
+        comm = self.comm
+        if comm is None or comm.size == 1:
+            return self._stage(params, x)
+        p = comm.size
+        staged = jax.tree.map(
+            lambda a: a.reshape(p, self.depth // p, *a.shape[1:]), params
+        )
+        return pipeline_apply(self._stage, staged, x, comm,
+                              n_microbatches=self.n_microbatches)

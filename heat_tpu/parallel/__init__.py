@@ -13,8 +13,10 @@ from .ring import ring_map
 from .halo import halo_exchange, with_halos
 from .ring_attention import ring_attention, ring_self_attention
 from .sample_sort import order_statistics_1d, sample_sort_1d
+from .pipeline import pipeline_apply
 
 __all__ = [
+    "pipeline_apply",
     "ring_map",
     "halo_exchange",
     "with_halos",

@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+Beyond-reference strategy (SURVEY §2.8 lists PP as absent from the
+reference), built the TPU way: the stage dimension is sharded over the
+mesh — every device holds ONE stage's parameters — and activations flow
+stage-to-stage through ``lax.ppermute`` ring shifts inside a single
+``shard_map``-ed ``lax.scan`` over microbatch ticks.  The whole schedule
+is ONE compiled XLA program (no host round-trips between ticks), and the
+*backward* pipeline falls out of autodiff: the transpose of ``ppermute``
+is the reverse shift, so ``jax.grad`` of :func:`pipeline_apply` runs the
+textbook reverse schedule without any hand-written machinery.
+
+Schedule shape: with ``p`` stages and ``M`` microbatches the program runs
+``M + p - 1`` ticks; the bubble fraction ``(p-1)/(M+p-1)`` shrinks as
+``M`` grows — pick ``n_microbatches`` a few multiples of ``p``.
+
+Constraint (inherent to SPMD pipelining, not a shortcut): every stage must
+map microbatches to outputs of the SAME shape/dtype, since all devices run
+one traced program and the carried activation buffer has one shape.
+Homogeneous-block models (transformer stacks, MLP towers) fit naturally —
+see :class:`heat_tpu.nn.Pipelined`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core._cache import comm_cached
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    comm,
+    n_microbatches: int | None = None,
+):
+    """Apply ``p`` pipelined stages to ``x``, microbatched GPipe-style.
+
+    ``stage_fn(params_i, x_mb) -> y_mb`` is the per-stage computation;
+    ``stage_params`` is a pytree whose leaves are stacked on a leading axis
+    of size ``comm.size`` (stage ``i`` consumes slice ``i`` — the leading
+    axis is sharded so each device holds only its own stage's weights).
+    ``x`` has shape (N, ...); it is split into ``n_microbatches`` equal
+    microbatches along axis 0 (default ``comm.size``, which must divide N).
+    Returns the final stage's output, replicated (the usual input to a
+    loss), shaped like ``x``.
+
+    Keyed on ``stage_fn``'s identity via the per-comm program cache — pass
+    a stable (module-level or instance-held) callable so repeat calls reuse
+    one compiled schedule.
+    """
+    p = comm.size
+    M = int(n_microbatches) if n_microbatches else p
+    n = x.shape[0]
+    if n % M:
+        raise ValueError(f"leading dim {n} not divisible by n_microbatches={M}")
+    if p == 1:
+        one = jax.tree.map(lambda a: a[0], stage_params)
+        return stage_fn(one, x)
+    return _pipeline_program(comm, stage_fn, M, x.ndim)(stage_params, x)
+
+
+@comm_cached
+def _pipeline_program(comm, stage_fn, M: int, x_ndim: int):
+    p, axis = comm.size, comm.axis
+
+    def body(params_st, x):
+        idx = lax.axis_index(axis)
+        params_loc = jax.tree.map(lambda a: a[0], params_st)  # this stage's slice
+        xm = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+        perm = [(i, i + 1) for i in range(p - 1)]
+
+        def tick(carry, t):
+            state, out = carry
+            mb = jnp.clip(t, 0, M - 1)
+            inp = jnp.where(idx == 0, xm[mb], state)
+            y = stage_fn(params_loc, inp)
+            # the last stage commits microbatch t-(p-1) as it drains
+            ot = jnp.clip(t - (p - 1), 0, M - 1)
+            write = (idx == p - 1) & (t >= p - 1)
+            out = out.at[ot].set(jnp.where(write, y, out[ot]))
+            # everyone else hands its activation to the next stage
+            state = lax.ppermute(y, axis, perm)
+            return (state, out), None
+
+        init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+        (_, out), _ = lax.scan(tick, init, jnp.arange(M + p - 1))
+        # replicate the last stage's buffer (masked psum — one payload on the wire)
+        out = lax.psum(jnp.where(idx == p - 1, out, jnp.zeros_like(out)), axis)
+        return out.reshape(x.shape)
+
+    from jax.sharding import PartitionSpec as P
+
+    # a single PartitionSpec is a valid tree-prefix for the whole params
+    # pytree: every leaf is stage-stacked on its leading axis
+    return jax.jit(
+        comm.shard_map(
+            body,
+            in_splits=(P(axis), (x_ndim, None)),
+            out_splits=(x_ndim, None),
+        )
+    )

@@ -81,3 +81,33 @@ class TestProgramCaches:
         assert n1 >= 1
         ht.linalg.qr(a)
         assert len(_table(comm, _tsqr_program)) == n1
+
+    def test_moe_ep_program_reused(self):
+        import jax
+
+        from heat_tpu.nn.moe import _ep_program
+
+        comm = ht.communication.get_comm()
+        moe = ht.nn.MoE(8, 2 * comm.size, hidden_dim=8, top_k=1, comm=comm)
+        params = moe.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2 * comm.size, 3, 8))
+        n0 = len(_table(comm, _ep_program))
+        moe.apply(params, x)
+        n1 = len(_table(comm, _ep_program))
+        assert n1 == n0 + 1  # one program per layer instance
+        moe.apply(params, x)
+        assert len(_table(comm, _ep_program)) == n1
+
+    def test_pipeline_program_reused(self):
+        import jax
+
+        from heat_tpu.parallel.pipeline import _pipeline_program
+
+        comm = ht.communication.get_comm()
+        pp = ht.nn.Pipelined(ht.nn.Linear(8, 8), comm.size, comm)
+        params = pp.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (comm.size, 8))
+        pp.apply(params, x)
+        n1 = len(_table(comm, _pipeline_program))
+        pp.apply(params, x)
+        assert len(_table(comm, _pipeline_program)) == n1
