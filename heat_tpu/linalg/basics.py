@@ -136,9 +136,11 @@ def matmul_summa(a: DNDarray, b: DNDarray) -> DNDarray:
     shard accumulates its partial GEMM — the reference's K-block circulation
     made explicit.  Measured against the GSPMD path it re-implements
     (``BENCH summa_vs_gspmd``): with the ring program comm-cached (round
-    4b), GSPMD wins only ~1.1× at p=8 on the CPU mesh — rounds 2-4's
-    recorded 2.5-5.5× deficit was per-call retrace+recompile, not the
-    algorithm.  It remains a teaching path because GSPMD's collective-
+    4b) the two are at parity on the p=8 CPU mesh (measured 1.1× for GSPMD
+    in 4b, 0.71× — SUMMA ahead — in 4d; run-to-run spread on a 1-core
+    host) — rounds 2-4's recorded 2.5-5.5× deficit was per-call
+    retrace+recompile, not the algorithm.  It remains a teaching path
+    because GSPMD's collective-
     matmul fusion is what production code should lean on (``ht.matmul``),
     and the bench re-measures the pair every round so the comparison
     stays honest.

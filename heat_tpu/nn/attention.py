@@ -110,6 +110,62 @@ class MultiheadAttention(Module):
             return_probs=return_probs,
         )
 
+    # ------------------------------------------------------------------ #
+    # autoregressive decoding (KV cache)
+    # ------------------------------------------------------------------ #
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Static-shape KV cache for :meth:`decode_step` — the TPU decode
+        idiom: a fixed (B, H, max_len, d) buffer updated in place by
+        ``dynamic_update_slice`` so the whole generation loop is one
+        compiled ``lax.scan`` (no growing shapes, no retracing)."""
+        shape = (batch, self.num_heads, max_len, self.head_dim)
+        return {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
+            "index": jnp.zeros((), jnp.int32),
+        }
+
+    def decode_step(self, params, x, cache):
+        """One autoregressive step: ``x`` (B, 1, E) is the new position's
+        activations; its K/V are written at ``cache['index']`` and the
+        query attends to every cached position ≤ index.  Returns
+        ``(y, new_cache)``; numerically identical to the corresponding row
+        of a full causal :meth:`apply` over the prefix.
+
+        The caller owns the length budget: stepping past the cache's
+        ``max_len`` would clamp the write onto the last slot (silent
+        corruption), so out-of-range indices raise when concrete; inside a
+        scan the index is traced and the LOOP bound must guarantee it
+        (``TransformerLM.generate`` sizes cache == loop length).
+        """
+        E = self.embed_dim
+        idx = cache["index"]
+        if not isinstance(idx, jax.core.Tracer) and int(idx) >= cache["k"].shape[2]:
+            raise ValueError(
+                f"decode_step past cache capacity: index {int(idx)} >= "
+                f"max_len {cache['k'].shape[2]}"
+            )
+        w = params["in_proj_weight"]
+        b = params.get("in_proj_bias")
+        proj = x @ w.T + (b if b is not None else 0.0)
+        q, k, v = jnp.split(proj, 3, axis=-1)
+        qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B,H,1,d)
+        i = cache["index"]
+        kc = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh.astype(cache["k"].dtype), i, axis=2)
+        vc = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh.astype(cache["v"].dtype), i, axis=2)
+        L = kc.shape[2]
+        s = jnp.einsum("bhqd,bhld->bhql", qh, kc) / (self.head_dim**0.5)
+        s = jnp.where(jnp.arange(L) <= i, s, -jnp.inf)  # future slots are dead
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhql,bhld->bhqd", p, vc)
+        B = out.shape[0]
+        merged = out.transpose(0, 2, 1, 3).reshape(B, 1, E)
+        y = merged @ params["out_proj"]["weight"].T
+        if self.bias:
+            y = y + params["out_proj"]["bias"]
+        return y, {"k": kc, "v": vc, "index": i + 1}
+
     def apply(self, params, x, *, kv=None, causal: bool = False,
               key_padding_mask=None, attn_mask=None,
               need_weights: bool = False, average_attn_weights: bool = True,

@@ -13,7 +13,7 @@ from typing import Sequence
 
 from . import modules as nn
 
-__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder", "transformer_decoder"]
+__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder", "transformer_decoder", "TransformerLM"]
 
 
 def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
@@ -199,6 +199,20 @@ class _TransformerBlock(nn.Module):
             )(params, x, k1, k2)
         return self._block(params, x, k1, k2, train)
 
+    def init_cache(self, batch: int, max_len: int, dtype=None):
+        import jax.numpy as jnp
+
+        return self.mha.init_cache(batch, max_len, dtype or jnp.float32)
+
+    def decode_step(self, params, x, cache):
+        """One-token block step against the KV cache: numerically the last
+        row of :meth:`apply` over the prefix (causal)."""
+        a, cache = self.mha.decode_step(
+            params["mha"], self.ln1.apply(params["ln1"], x), cache
+        )
+        h = x + a
+        return h + self.ff.apply(params["ff"], self.ln2.apply(params["ln2"], h)), cache
+
 
 def transformer_encoder(
     embed_dim: int = 256,
@@ -229,6 +243,157 @@ def transformer_encoder(
                             remat=remat)
           for _ in range(depth)]
     )
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal language model: token embedding + learned positions
+    + causal transformer blocks + final LayerNorm + untied LM head, with a
+    compiled KV-cache ``generate`` loop.
+
+    Beyond-reference model family (same provenance note as
+    :func:`transformer_encoder`), completing the inference half of the
+    transformer story: ``apply`` is the teacher-forced training forward;
+    :meth:`generate` is TPU-idiom autoregressive decoding — a static
+    (B, H, max_len, d) KV cache per block updated by dynamic slices inside
+    ONE ``lax.scan`` program, so a whole generation is a single XLA
+    dispatch (no per-token host round-trips, no shape growth, no
+    retracing).  ``comm``/``remat`` thread through to the blocks for
+    sequence-parallel / checkpointed TRAINING; decoding is single-mesh
+    (the (1, L) per-step attention has no sequence axis to shard).
+    """
+
+    def __init__(self, vocab_size: int, embed_dim: int = 256, num_heads: int = 8,
+                 depth: int = 4, mlp_ratio: int = 4, max_len: int = 1024,
+                 comm=None, remat: bool = False):
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.max_len = max_len
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.blocks = [
+            _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=True,
+                              comm=comm, remat=remat)
+            for _ in range(depth)
+        ]
+        self.ln_f = nn.LayerNorm(embed_dim)
+        self.head = nn.Linear(embed_dim, vocab_size, bias=False)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(key, len(self.blocks) + 4)
+        scale = 1.0 / (self.embed_dim**0.5)
+        return {
+            "embed": jax.tree.map(lambda a: a * scale, self.embed.init(keys[0])),
+            "pos": scale * jax.random.normal(keys[1], (self.max_len, self.embed_dim)),
+            "blocks": [b.init(k) for b, k in zip(self.blocks, keys[2:])],
+            "ln_f": self.ln_f.init(keys[-2]),
+            "head": self.head.init(keys[-1]),
+        }
+
+    def apply(self, params, tokens, *, train: bool = False, key=None):
+        """Teacher-forced forward: tokens (B, S) int → logits (B, S, vocab)."""
+        import jax
+
+        S = tokens.shape[1]
+        if S > self.max_len:
+            raise ValueError(f"sequence length {S} exceeds max_len {self.max_len}")
+        h = self.embed.apply(params["embed"], tokens) + params["pos"][:S]
+        for b, p in zip(self.blocks, params["blocks"]):
+            sub = None
+            if key is not None:
+                key, sub = jax.random.split(key)
+            h = b.apply(p, h, train=train, key=sub)
+        return self.head.apply(params["head"], self.ln_f.apply(params["ln_f"], h))
+
+    def decode_step(self, params, tok, pos, caches):
+        """Logits for one position given the caches: tok (B,) int at
+        position ``pos``.  Returns (logits (B, vocab), new_caches)."""
+        h = self.embed.apply(params["embed"], tok[:, None]) + params["pos"][pos]
+        new = []
+        for b, p, c in zip(self.blocks, params["blocks"], caches):
+            h, c = b.decode_step(p, h, c)
+            new.append(c)
+        logits = self.head.apply(params["head"], self.ln_f.apply(params["ln_f"], h))
+        return logits[:, 0, :], new
+
+    def generate(self, params, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, key=None):
+        """Autoregressive continuation of ``prompt`` (B, S0) int tokens.
+
+        ``temperature=0`` decodes greedily; otherwise softmax sampling at
+        the given temperature (requires ``key``).  The prompt is consumed
+        through the same cached step as generation — the whole thing is ONE
+        jitted ``lax.scan`` program, LRU-cached on the model instance and
+        keyed only on (batch, total length, sampled?): the prompt length
+        and temperature ride in as DYNAMIC arguments, so a serving loop
+        with naturally varying prompt lengths reuses one executable.
+        Returns (B, S0 + max_new_tokens) tokens beginning with the prompt.
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        sampled = bool(temperature)
+        if sampled and key is None:
+            raise ValueError("sampling (temperature > 0) requires key=")
+        B, S0 = prompt.shape
+        n_new = int(max_new_tokens)
+        total = S0 + n_new
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds max_len {self.max_len}"
+            )
+        from collections import OrderedDict
+
+        progs = self.__dict__.setdefault("_gen_programs", OrderedDict())
+        cache_key = (B, total, sampled)
+        fn = progs.get(cache_key)
+        if fn is None:
+            fn = progs[cache_key] = jax.jit(functools.partial(
+                self._generate_scan, total=total, sampled=sampled
+            ))
+            if len(progs) > 16:  # executables accumulate per distinct total
+                progs.popitem(last=False)
+        else:
+            progs.move_to_end(cache_key)
+        ys0 = jnp.concatenate(
+            [prompt.astype(jnp.int32), jnp.zeros((B, n_new), jnp.int32)], axis=1
+        )
+        return fn(
+            params,
+            ys0,
+            jnp.asarray(S0, jnp.int32),
+            jnp.asarray(temperature if sampled else 1.0, jnp.float32),
+            key if key is not None else jax.random.key(0),
+        )
+
+    def _generate_scan(self, params, ys, S0, temp, key, *, total, sampled):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        B = ys.shape[0]
+        caches = [b.init_cache(B, total) for b in self.blocks]
+
+        def step(carry, t):
+            ys, caches, k = carry
+            logits, caches = self.decode_step(params, ys[:, t], t, caches)
+            if sampled:
+                k, sub = jax.random.split(k)
+                nxt = jax.random.categorical(sub, logits / temp, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # prompt positions keep their given token; generation begins
+            # at index S0 (fed by the prediction from position S0-1)
+            cur = lax.dynamic_slice_in_dim(ys, t + 1, 1, axis=1)[:, 0]
+            nxt = jnp.where(t + 1 < S0, cur, nxt.astype(jnp.int32))
+            ys = lax.dynamic_update_slice_in_dim(ys, nxt[:, None], t + 1, axis=1)
+            return (ys, caches, k), None
+
+        (ys, _, _), _ = lax.scan(step, (ys, caches, key), jnp.arange(total - 1))
+        return ys
 
 
 class _TransformerDecoderBlock(nn.Module):
