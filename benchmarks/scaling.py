@@ -60,6 +60,24 @@ if n_dev >= 2:
     _ring(_rqs)  # compile
     results["ring_attn_2x4x4096x32"] = timed(lambda: _ring(_rqs))
 
+    # round-4d: expert parallelism (experts sharded, tokens through two
+    # all_to_alls) and pipeline parallelism (GPipe microbatch schedule on
+    # the ppermute ring) — per-step wall-clock as the mesh widens
+    _moe = ht.nn.MoE(64, 2 * n_dev, hidden_dim=128, top_k=2, comm=comm)
+    _mp = _moe.init(jax.random.key(0))
+    _xm = _rjnp.asarray(_np.random.default_rng(6).normal(size=(8 * n_dev, 16, 64)), _rjnp.float32)
+    _moe.apply(_mp, _xm)  # compile
+    results["moe_ep_%dtok_e%d" % (_xm.shape[0] * 16, 2 * n_dev)] = timed(
+        lambda: _moe.apply(_mp, _xm)
+    )
+    from heat_tpu.nn.models import _TransformerBlock as _TB
+    _pp = ht.nn.Pipelined(_TB(64, 4, mlp_ratio=2, causal=True), depth=n_dev,
+                          comm=comm, n_microbatches=min(4, n_dev))
+    _ppp = _pp.init(jax.random.key(1))
+    _xp = _rjnp.asarray(_np.random.default_rng(7).normal(size=(8, 32, 64)), _rjnp.float32)
+    _pp.apply(_ppp, _xp)  # compile
+    results["pipeline_%dstage_tfblock" % n_dev] = timed(lambda: _pp.apply(_ppp, _xp))
+
     # the static-shape sample sort (SURVEY hard part #3) vs the global sort:
     # same input, distributed path keeps O(n/p) memory per shard
     results["sample_sort_1M"] = timed(lambda: ht.sort(v, method="sample")[0])
@@ -160,12 +178,20 @@ def main() -> None:
         "per shard — improves with mesh width); percentile_bisect_1M = "
         "exact order statistics, no sort. dp_mlp_step_256 = sync "
         "DataParallel step; daso_mlp_step_256 = hierarchical DASO step on "
-        "an (n/2)x2 mesh. Recorded round 4, 2026-07-30; round-4 rows: "
-        "descending sample sort, distributed unique/searchsorted/large-k "
-        "topk; round-4b rows: tsqr_262k_64_r (CholeskyQR2 local "
-        "factorization, comm-cached program) and ring_attn_2x4x4096x32 "
-        "(sequence-parallel exact attention, S/p per device — improves "
-        "with mesh width even on the shared-memory mesh). TPU single-chip "
+        "an (n/2)x2 mesh. Full sweep re-recorded round 4d, 2026-07-31; "
+        "round-4 rows: descending sample sort, distributed "
+        "unique/searchsorted/large-k topk; round-4b rows: tsqr_262k_64_r "
+        "(CholeskyQR2 local factorization, comm-cached program) and "
+        "ring_attn_2x4x4096x32 (sequence-parallel exact attention, S/p per "
+        "device — improves with mesh width even on the shared-memory "
+        "mesh); round-4d rows: moe_ep_* (expert-parallel MoE forward, "
+        "experts sharded, tokens through two all_to_alls; token count "
+        "grows with the mesh so per-token work is constant) and "
+        "pipeline_*stage_tfblock (GPipe schedule over n_dev transformer-"
+        "block stages, fixed batch 8 x 32 x 64, n_microbatches "
+        "min(4, n_dev) — wall-clock grows with depth=n_dev since the "
+        "MODEL grows with the mesh; divide by stages for per-block cost). "
+        "TPU single-chip "
         "numbers live in BENCH_r03.json (BENCH_r04.json once the driver records this round); multi-chip ICI "
         "scaling requires a pod (unavailable: one tunneled v5e chip)."
     )}))

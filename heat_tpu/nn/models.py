@@ -152,16 +152,19 @@ def _remat_jit(cache: dict, train: bool, block_fn):
 class _TransformerBlock(nn.Module):
     """Pre-norm transformer encoder block: x + MHA(LN(x)), then
     x + FFN(LN(x)).  ``comm`` routes the attention over the sequence-
-    parallel ring (long contexts scale with the mesh)."""
+    parallel ring (long contexts scale with the mesh).  ``ffn`` swaps the
+    dense FFN for any same-shape module — e.g. an expert-parallel
+    :class:`~heat_tpu.nn.MoE` (the Switch-transformer block)."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
-                 causal: bool = False, comm=None, remat: bool = False):
+                 causal: bool = False, comm=None, remat: bool = False,
+                 ffn: nn.Module = None):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
         self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm)
         self.ln2 = nn.LayerNorm(embed_dim)
-        self.ff = _ffn(embed_dim, mlp_ratio)
+        self.ff = ffn if ffn is not None else _ffn(embed_dim, mlp_ratio)
         self.causal = causal
         self.remat = remat
         self._remat_fns = {}  # train -> jitted checkpointed block
@@ -206,12 +209,28 @@ class _TransformerBlock(nn.Module):
 
     def decode_step(self, params, x, cache):
         """One-token block step against the KV cache: numerically the last
-        row of :meth:`apply` over the prefix (causal)."""
+        row of :meth:`apply` over the prefix (causal).  An MoE FFN decodes
+        through its drop-free ``decode_apply`` path, so the equality holds
+        whenever training-time capacity was not binding (see
+        :meth:`MoE.decode_apply`)."""
         a, cache = self.mha.decode_step(
             params["mha"], self.ln1.apply(params["ln1"], x), cache
         )
         h = x + a
-        return h + self.ff.apply(params["ff"], self.ln2.apply(params["ln2"], h)), cache
+        ff = getattr(self.ff, "decode_apply", self.ff.apply)
+        return h + ff(params["ff"], self.ln2.apply(params["ln2"], h)), cache
+
+
+def _block_ffn(embed_dim: int, mlp_ratio: int, num_experts, moe_top_k: int,
+               comm, capacity_factor: float = 1.5):
+    """Dense FFN, or an expert-parallel MoE of the same hidden width when
+    ``num_experts`` is set (the Switch-transformer block)."""
+    if not num_experts:
+        return None  # _TransformerBlock builds the dense FFN
+    from .moe import MoE
+
+    return MoE(embed_dim, num_experts, hidden_dim=mlp_ratio * embed_dim,
+               top_k=moe_top_k, capacity_factor=capacity_factor, comm=comm)
 
 
 def transformer_encoder(
@@ -222,6 +241,9 @@ def transformer_encoder(
     causal: bool = False,
     comm=None,
     remat: bool = False,
+    num_experts: int = None,
+    moe_top_k: int = 2,
+    moe_capacity_factor: float = 1.5,
 ) -> nn.Module:
     """A stack of pre-norm transformer blocks over (B, S, embed_dim) input.
 
@@ -236,11 +258,16 @@ def transformer_encoder(
     training recomputes block activations in the backward pass instead of
     holding depth × (B, S, E) of them in HBM — combine with the flash
     local kernel (which already never materializes (S, S)) for the full
-    long-context memory story.
+    long-context memory story.  ``num_experts`` swaps every block's FFN
+    for an expert-parallel :class:`~heat_tpu.nn.MoE` of the same hidden
+    width (Switch-transformer style; ``comm`` shards the experts too).
     """
     return nn.Sequential(
         *[_TransformerBlock(embed_dim, num_heads, mlp_ratio, causal, comm,
-                            remat=remat)
+                            remat=remat,
+                            ffn=_block_ffn(embed_dim, mlp_ratio, num_experts,
+                                           moe_top_k, comm,
+                                           moe_capacity_factor))
           for _ in range(depth)]
     )
 
@@ -264,14 +291,18 @@ class TransformerLM(nn.Module):
 
     def __init__(self, vocab_size: int, embed_dim: int = 256, num_heads: int = 8,
                  depth: int = 4, mlp_ratio: int = 4, max_len: int = 1024,
-                 comm=None, remat: bool = False):
+                 comm=None, remat: bool = False, num_experts: int = None,
+                 moe_top_k: int = 2, moe_capacity_factor: float = 1.5):
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.max_len = max_len
         self.embed = nn.Embedding(vocab_size, embed_dim)
         self.blocks = [
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=True,
-                              comm=comm, remat=remat)
+                              comm=comm, remat=remat,
+                              ffn=_block_ffn(embed_dim, mlp_ratio, num_experts,
+                                             moe_top_k, comm,
+                                             moe_capacity_factor))
             for _ in range(depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)
@@ -375,7 +406,9 @@ class TransformerLM(nn.Module):
         from jax import lax
 
         B = ys.shape[0]
-        caches = [b.init_cache(B, total) for b in self.blocks]
+        # cache in the model's compute dtype (bf16 params -> bf16 K/V
+        # buffers and attention einsums, halving the decode working set)
+        caches = [b.init_cache(B, total, params["pos"].dtype) for b in self.blocks]
 
         def step(carry, t):
             ys, caches, k = carry

@@ -199,6 +199,29 @@ class MoE(Module):
             y = y[:n]
         return y.reshape(orig_shape)
 
+    def decode_apply(self, params, x):
+        """Drop-free per-token path for autoregressive decoding.
+
+        Gathers each token's top-k experts' weights and applies them
+        directly — no capacity buffer, so no token is ever dropped.  The
+        capacity-bounded :meth:`apply` pools B·S training tokens while a
+        decode step sees only B; under capacity pressure the two would
+        disagree arbitrarily, so decoding uses this exact path instead
+        (== :meth:`apply` whenever apply's capacity was not binding — the
+        usual serving regime).  Cost is k gathered FFNs per token; with
+        decode batches this is small and stays on the MXU.
+        """
+        orig_shape = x.shape
+        x2d = x.reshape(-1, self.embed_dim)
+        gates = jax.nn.softmax(x2d @ params["router"])
+        val, idx = jax.lax.top_k(gates, self.top_k)  # (n, k)
+        val = val / (val.sum(axis=-1, keepdims=True) + 1e-9)
+        w1, b1 = params["w1"][idx], params["b1"][idx]  # (n, k, D, H), (n, k, H)
+        w2, b2 = params["w2"][idx], params["b2"][idx]
+        h = jax.nn.gelu(jnp.einsum("nd,nkdh->nkh", x2d, w1) + b1)
+        y = jnp.einsum("nkh,nkhd->nkd", h, w2) + b2
+        return jnp.einsum("nk,nkd->nd", val, y).reshape(orig_shape)
+
     # ------------------------------------------------------------------ #
 
     def load_balance_loss(self, params, x):

@@ -117,6 +117,28 @@ class TestMoE:
             y = ep.apply(params, x)
         assert y.shape == x.shape
 
+    def test_decode_apply_matches_dense(self):
+        """The drop-free decode path == the capacity path when capacity is
+        not binding (the serving contract — see MoE.decode_apply)."""
+        import jax
+        import jax.numpy as jnp
+
+        moe = ht.nn.MoE(8, 4, hidden_dim=16, top_k=2, capacity_factor=64.0)
+        params = moe.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (6, 8))
+        np.testing.assert_allclose(
+            np.asarray(moe.decode_apply(params, x)),
+            np.asarray(moe.apply(params, x)),
+            rtol=2e-4, atol=2e-5,
+        )
+        # and it NEVER drops: under capacity pressure the capacity path
+        # zeroes overflow tokens while decode_apply still serves them
+        tight = ht.nn.MoE(8, 2, hidden_dim=16, top_k=1, capacity_factor=1e-6)
+        tp = tight.init(jax.random.key(2))
+        xt = jax.random.normal(jax.random.key(3), (16, 8))
+        served = np.asarray(jnp.abs(tight.decode_apply(tp, xt)).sum(1) > 0)
+        assert served.all()
+
     def test_pad_tokens_do_not_consume_capacity(self):
         """Zero-gate (masked pad) tokens must not occupy queue positions:
         a pad's phantom slot-0 claim would evict a real token's claim under

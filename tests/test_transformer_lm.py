@@ -117,6 +117,38 @@ class TestTransformerLM:
         a2 = lm.generate(params, prompt, 8, temperature=1.5, key=jax.random.key(2))
         np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
 
+    def test_moe_ffn_variant(self):
+        """num_experts= swaps every block's FFN for the expert-parallel MoE
+        (Switch-transformer block) — train and generate must both work."""
+        import jax
+        import jax.numpy as jnp
+
+        comm = ht.communication.get_comm()
+        lm = TransformerLM(vocab_size=17, embed_dim=16, num_heads=2, depth=2,
+                           max_len=16, comm=comm if comm.size > 1 else None,
+                           num_experts=2 * comm.size,
+                           moe_capacity_factor=64.0)  # non-binding: decode == apply
+        params = lm.init(jax.random.key(0))
+        assert "w1" in params["blocks"][0]["ff"]  # MoE params, not dense FFN
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 17)
+        logits = lm.apply(params, toks)
+        assert logits.shape == (2, 8, 17) and bool(jnp.isfinite(logits).all())
+        g = jax.grad(
+            lambda p: jnp.sum(lm.apply(p, toks) ** 2)
+        )(params)
+        assert bool(jnp.isfinite(g["blocks"][0]["ff"]["w1"]).all())
+        out = lm.generate(params, toks[:, :3], 5)
+        assert out.shape == (2, 8) and bool((out[:, :3] == toks[:, :3]).all())
+        # decode == teacher-forced forward also for MoE blocks (drop-free
+        # decode path; training capacity is not binding at these sizes)
+        full = lm.apply(params, toks)
+        caches = [b.init_cache(2, 8) for b in lm.blocks]
+        for t in range(8):
+            lg, caches = lm.decode_step(params, toks[:, t], t, caches)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+
     def test_training_reduces_loss(self):
         """The full family loop: teacher-forced next-token loss + optimizer."""
         import jax
