@@ -262,12 +262,14 @@ def transformer_encoder(
     for an expert-parallel :class:`~heat_tpu.nn.MoE` of the same hidden
     width (Switch-transformer style; ``comm`` shards the experts too).
     """
+    # ONE shared (stateless) MoE instance for all blocks: params are still
+    # per-block via each block's init key, but the identity-keyed compiled
+    # EP program is built once instead of depth times
+    moe_ffn = _block_ffn(embed_dim, mlp_ratio, num_experts, moe_top_k, comm,
+                         moe_capacity_factor)
     return nn.Sequential(
         *[_TransformerBlock(embed_dim, num_heads, mlp_ratio, causal, comm,
-                            remat=remat,
-                            ffn=_block_ffn(embed_dim, mlp_ratio, num_experts,
-                                           moe_top_k, comm,
-                                           moe_capacity_factor))
+                            remat=remat, ffn=moe_ffn)
           for _ in range(depth)]
     )
 
@@ -297,12 +299,12 @@ class TransformerLM(nn.Module):
         self.embed_dim = embed_dim
         self.max_len = max_len
         self.embed = nn.Embedding(vocab_size, embed_dim)
+        # one shared MoE instance (stateless) -> one compiled EP program
+        moe_ffn = _block_ffn(embed_dim, mlp_ratio, num_experts, moe_top_k,
+                             comm, moe_capacity_factor)
         self.blocks = [
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=True,
-                              comm=comm, remat=remat,
-                              ffn=_block_ffn(embed_dim, mlp_ratio, num_experts,
-                                             moe_top_k, comm,
-                                             moe_capacity_factor))
+                              comm=comm, remat=remat, ffn=moe_ffn)
             for _ in range(depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)

@@ -47,6 +47,15 @@ def _ep_program(comm, moe):
     return jax.jit(fn)
 
 
+def _topk_gates(gates, top_k: int):
+    """Top-k expert selection with sum-renormalized gate weights — THE
+    routing rule, shared by the capacity path (:func:`_routing`) and the
+    drop-free decode path (:meth:`MoE.decode_apply`) so the
+    decode == teacher-forced contract can never drift between them."""
+    val, idx = jax.lax.top_k(gates, top_k)  # (n, k)
+    return val / (val.sum(axis=-1, keepdims=True) + 1e-9), idx
+
+
 def _routing(gates, top_k: int, capacity: int):
     """Dispatch/combine tensors for token-choice top-k routing.
 
@@ -59,8 +68,7 @@ def _routing(gates, top_k: int, capacity: int):
     removes the *weakest* assignments first.
     """
     n, E = gates.shape
-    val, idx = jax.lax.top_k(gates, top_k)  # (n, k)
-    val = val / (val.sum(axis=-1, keepdims=True) + 1e-9)
+    val, idx = _topk_gates(gates, top_k)
 
     # slot-major priority: position of (token i, slot j) in its expert's
     # capacity queue counts all slot-<j claims plus earlier tokens' slot-j
@@ -214,8 +222,7 @@ class MoE(Module):
         orig_shape = x.shape
         x2d = x.reshape(-1, self.embed_dim)
         gates = jax.nn.softmax(x2d @ params["router"])
-        val, idx = jax.lax.top_k(gates, self.top_k)  # (n, k)
-        val = val / (val.sum(axis=-1, keepdims=True) + 1e-9)
+        val, idx = _topk_gates(gates, self.top_k)  # (n, k)
         w1, b1 = params["w1"][idx], params["b1"][idx]  # (n, k, D, H), (n, k, H)
         w2, b2 = params["w2"][idx], params["b2"][idx]
         h = jax.nn.gelu(jnp.einsum("nd,nkdh->nkh", x2d, w1) + b1)

@@ -35,7 +35,7 @@ class Pipelined(Module):
     """
 
     def __init__(self, block: Module, depth: int, comm, n_microbatches: int | None = None,
-                 remat: bool = False):
+                 remat: bool = False, batch_axis: str | None = None):
         if comm is not None and depth % comm.size:
             raise ValueError(f"depth {depth} not divisible by pipeline stages {comm.size}")
         self.block = block
@@ -43,6 +43,7 @@ class Pipelined(Module):
         self.comm = comm
         self.n_microbatches = n_microbatches
         self.remat = remat
+        self.batch_axis = batch_axis  # dp axis of a 2-D mesh (see pipeline_apply)
 
     def init(self, key):
         keys = jax.random.split(key, self.depth)
@@ -62,11 +63,12 @@ class Pipelined(Module):
 
     def apply(self, params, x, **kw):
         comm = self.comm
-        if comm is None or comm.size == 1:
+        if comm is None or (comm.size == 1 and self.batch_axis is None):
             return self._stage(params, x)
         p = comm.size
         staged = jax.tree.map(
             lambda a: a.reshape(p, self.depth // p, *a.shape[1:]), params
         )
         return pipeline_apply(self._stage, staged, x, comm,
-                              n_microbatches=self.n_microbatches)
+                              n_microbatches=self.n_microbatches,
+                              batch_axis=self.batch_axis)

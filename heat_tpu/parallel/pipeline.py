@@ -40,6 +40,7 @@ def pipeline_apply(
     x: jax.Array,
     comm,
     n_microbatches: int | None = None,
+    batch_axis: str | None = None,
 ):
     """Apply ``p`` pipelined stages to ``x``, microbatched GPipe-style.
 
@@ -55,20 +56,40 @@ def pipeline_apply(
     Keyed on ``stage_fn``'s identity via the per-comm program cache — pass
     a stable (module-level or instance-held) callable so repeat calls reuse
     one compiled schedule.
+
+    ``batch_axis`` composes the pipeline with data parallelism: name a
+    SECOND axis of ``comm``'s mesh (e.g. ``'dp'`` of a ``('dp','pp')``
+    mesh with ``comm = Communication(mesh, axis='pp')``) and ``x`` is
+    batch-sharded over it — each dp slice runs the same pipeline schedule
+    over its batch shard while the stage weights stay sharded over the pp
+    axis, so one compiled program is dp×pp-parallel.  ``n_microbatches``
+    must divide the per-dp-shard batch.
     """
     p = comm.size
     M = int(n_microbatches) if n_microbatches else p
     n = x.shape[0]
+    if batch_axis is not None:
+        if batch_axis not in comm.mesh.axis_names or batch_axis == comm.axis:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} must name a mesh axis other than "
+                f"the pipeline axis {comm.axis!r}"
+            )
+        dp = comm.mesh.shape[batch_axis]
+        if n % dp:
+            raise ValueError(f"leading dim {n} not divisible by {batch_axis} size {dp}")
+        n = n // dp
     if n % M:
         raise ValueError(f"leading dim {n} not divisible by n_microbatches={M}")
-    if p == 1:
+    if p == 1 and batch_axis is None:
+        # a (dp, pp=1) mesh still runs the program so the batch sharding
+        # and axis validation hold; only the truly-unsharded case shortcuts
         one = jax.tree.map(lambda a: a[0], stage_params)
         return stage_fn(one, x)
-    return _pipeline_program(comm, stage_fn, M, x.ndim)(stage_params, x)
+    return _pipeline_program(comm, stage_fn, M, x.ndim, batch_axis)(stage_params, x)
 
 
 @comm_cached
-def _pipeline_program(comm, stage_fn, M: int, x_ndim: int):
+def _pipeline_program(comm, stage_fn, M: int, x_ndim: int, batch_axis=None):
     p, axis = comm.size, comm.axis
 
     def body(params_st, x):
@@ -99,11 +120,14 @@ def _pipeline_program(comm, stage_fn, M: int, x_ndim: int):
     from jax.sharding import PartitionSpec as P
 
     # a single PartitionSpec is a valid tree-prefix for the whole params
-    # pytree: every leaf is stage-stacked on its leading axis
+    # pytree: every leaf is stage-stacked on its leading axis; with a
+    # batch_axis the activations are additionally batch-sharded over it
+    # (each dp slice runs the schedule on its shard — same traced body)
+    x_spec = P(batch_axis) if batch_axis else P()
     return jax.jit(
         comm.shard_map(
             body,
-            in_splits=(P(axis), (x_ndim, None)),
-            out_splits=(x_ndim, None),
+            in_splits=(P(axis), x_spec),
+            out_splits=x_spec,
         )
     )

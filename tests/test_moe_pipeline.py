@@ -252,6 +252,78 @@ class TestPipelined:
         y = pp.apply(sharded, x)
         assert y.shape == x.shape
 
+    def test_dp_pp_composition(self):
+        """Pipeline over 'pp' with the batch sharded over 'dp' of one 2-D
+        mesh: one compiled program is dp x pp parallel; output stays
+        dp-sharded."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        n = len(jax.devices())
+        if n < 4 or n % 2:
+            pytest.skip("needs an even mesh of >= 4 devices")
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, n // 2), ("dp", "pp"))
+        comm_pp = ht.communication.Communication(mesh, axis="pp")
+        blk = _ResBlock(8)
+        pp = ht.nn.Pipelined(blk, depth=n // 2, comm=comm_pp,
+                             n_microbatches=2, batch_axis="dp")
+        seq = ht.nn.Pipelined(blk, depth=n // 2, comm=None)
+        params = pp.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, 8))
+        np.testing.assert_allclose(
+            np.asarray(pp.apply(params, x)), np.asarray(seq.apply(params, x)),
+            rtol=1e-5, atol=1e-5,
+        )
+        g = jax.grad(lambda p: jnp.sum(pp.apply(p, x) ** 2))(params)
+        gs = jax.grad(lambda p: jnp.sum(seq.apply(p, x) ** 2))(params)
+        for k in g:
+            np.testing.assert_allclose(np.asarray(g[k]), np.asarray(gs[k]),
+                                       rtol=1e-3, atol=1e-4)
+        y = pp.apply(params, jax.device_put(x, NamedSharding(mesh, P("dp"))))
+        assert y.sharding.spec == P("dp")
+
+    def test_dp_with_single_stage(self):
+        """(dp, pp=1) mesh: batch_axis must still shard the batch and
+        validate, not silently fall back to the unsharded path."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        n = len(jax.devices())
+        if n < 2:
+            pytest.skip("needs a multi-device mesh")
+        mesh = Mesh(np.asarray(jax.devices()).reshape(n, 1), ("dp", "pp"))
+        comm_pp = ht.communication.Communication(mesh, axis="pp")
+        blk = _ResBlock(8)
+        pp = ht.nn.Pipelined(blk, depth=1, comm=comm_pp, n_microbatches=1,
+                             batch_axis="dp")
+        seq = ht.nn.Pipelined(blk, depth=1, comm=None)
+        params = pp.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2 * n, 8))
+        y = pp.apply(params, jax.device_put(x, NamedSharding(mesh, P("dp"))))
+        assert y.sharding.spec == P("dp")
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(seq.apply(params, x)), rtol=1e-5, atol=1e-5
+        )
+        bad = ht.nn.Pipelined(blk, depth=1, comm=comm_pp, n_microbatches=1,
+                              batch_axis="nope")
+        with pytest.raises(ValueError, match="batch_axis"):
+            bad.apply(params, x)
+
+    def test_bad_batch_axis_raises(self):
+        import jax
+
+        comm = ht.communication.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        blk = _ResBlock(8)
+        pp = ht.nn.Pipelined(blk, comm.size, comm, batch_axis=comm.axis)
+        params = pp.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (comm.size, 8))
+        with pytest.raises(ValueError, match="batch_axis"):
+            pp.apply(params, x)
+
     def test_indivisible_depth_raises(self):
         comm = ht.communication.get_comm()
         if comm.size == 1:
