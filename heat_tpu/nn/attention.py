@@ -155,16 +155,56 @@ class MultiheadAttention(Module):
         kc = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh.astype(cache["k"].dtype), i, axis=2)
         vc = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh.astype(cache["v"].dtype), i, axis=2)
         L = kc.shape[2]
-        s = jnp.einsum("bhqd,bhld->bhql", qh, kc) / (self.head_dim**0.5)
-        s = jnp.where(jnp.arange(L) <= i, s, -jnp.inf)  # future slots are dead
+        y = self._attend_merge_project(
+            params, qh, kc, vc, dead_mask=jnp.arange(L) <= i  # future slots dead
+        )
+        return y, {"k": kc, "v": vc, "index": i + 1}
+
+    def _project_kv(self, params, kv):
+        """K/V head projection from the packed weight — the cross branch of
+        :meth:`apply`, :meth:`precompute_kv` and :meth:`decode_step` share
+        this layout."""
+        E = self.embed_dim
+        w = params["in_proj_weight"]
+        b = params.get("in_proj_bias")
+        k = kv @ w[E : 2 * E].T + (b[E : 2 * E] if b is not None else 0.0)
+        v = kv @ w[2 * E :].T + (b[2 * E :] if b is not None else 0.0)
+        return self._heads(k), self._heads(v)
+
+    def _attend_merge_project(self, params, qh, kh, vh, dead_mask=None):
+        """THE one-query decode tail: scaled scores (optionally masking
+        ``dead_mask`` key slots), softmax, value contraction, head merge,
+        output projection.  Shared by :meth:`decode_step` (masks unwritten
+        cache slots) and :meth:`cross_step` (no mask) so the decode
+        numerics can never drift between the two."""
+        s = jnp.einsum("bhqd,bhld->bhql", qh, kh) / (self.head_dim**0.5)
+        if dead_mask is not None:
+            s = jnp.where(dead_mask, s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhql,bhld->bhqd", p, vc)
+        out = jnp.einsum("bhql,bhld->bhqd", p, vh)
         B = out.shape[0]
-        merged = out.transpose(0, 2, 1, 3).reshape(B, 1, E)
+        merged = out.transpose(0, 2, 1, 3).reshape(B, 1, self.embed_dim)
         y = merged @ params["out_proj"]["weight"].T
         if self.bias:
             y = y + params["out_proj"]["bias"]
-        return y, {"k": kc, "v": vc, "index": i + 1}
+        return y
+
+    def precompute_kv(self, params, kv):
+        """Project an encoder memory ONCE into per-head K/V for
+        :meth:`cross_step` — seq2seq decoding recomputes the query each
+        step but never the memory's keys/values."""
+        return self._project_kv(params, kv)  # (B, H, S_enc, d)
+
+    def cross_step(self, params, x, kh, vh):
+        """One-query cross-attention against precomputed memory K/V
+        (:meth:`precompute_kv`): x (B, 1, E) → (B, 1, E).  Numerically the
+        corresponding row of a full cross :meth:`apply` against the same
+        memory."""
+        E = self.embed_dim
+        w = params["in_proj_weight"]
+        b = params.get("in_proj_bias")
+        q = x @ w[:E].T + (b[:E] if b is not None else 0.0)
+        return self._attend_merge_project(params, self._heads(q), kh, vh)
 
     def apply(self, params, x, *, kv=None, causal: bool = False,
               key_padding_mask=None, attn_mask=None,
@@ -208,11 +248,11 @@ class MultiheadAttention(Module):
         if kv is None:
             proj = x @ w.T + (b if b is not None else 0.0)
             q, k, v = jnp.split(proj, 3, axis=-1)
+            qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B, H, S, d)
         else:
             q = x @ w[:E].T + (b[:E] if b is not None else 0.0)
-            k = kv @ w[E : 2 * E].T + (b[E : 2 * E] if b is not None else 0.0)
-            v = kv @ w[2 * E :].T + (b[2 * E :] if b is not None else 0.0)
-        qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B, H, S, d)
+            qh = self._heads(q)
+            kh, vh = self._project_kv(params, kv)
         from ..parallel.ring_attention import _global_attention, ring_attention
 
         probs = None

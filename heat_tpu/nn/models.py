@@ -13,7 +13,7 @@ from typing import Sequence
 
 from . import modules as nn
 
-__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder", "transformer_decoder", "TransformerLM"]
+__all__ = ["resnet", "resnet18", "resnet34", "resnet50", "resnet50_ish", "mlp", "transformer_encoder", "transformer_decoder", "TransformerLM", "Seq2SeqTransformer"]
 
 
 def _basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
@@ -274,6 +274,36 @@ def transformer_encoder(
     )
 
 
+def _gen_program(model, cache_key, build):
+    """Per-instance LRU of compiled generation programs — ONE policy for
+    every decoding model (LM and seq2seq): keyed on static shapes only,
+    bounded because each distinct total length compiles its own scan
+    executable."""
+    from collections import OrderedDict
+
+    progs = model.__dict__.setdefault("_gen_programs", OrderedDict())
+    fn = progs.get(cache_key)
+    if fn is None:
+        fn = progs[cache_key] = build()
+        if len(progs) > 16:
+            progs.popitem(last=False)
+    else:
+        progs.move_to_end(cache_key)
+    return fn
+
+
+def _next_token(logits, sampled, temp, k):
+    """Greedy-or-sampled next token — the one sampling rule both decode
+    scans share."""
+    import jax
+    import jax.numpy as jnp
+
+    if sampled:
+        k, sub = jax.random.split(k)
+        return jax.random.categorical(sub, logits / temp, axis=-1).astype(jnp.int32), k
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k
+
+
 class TransformerLM(nn.Module):
     """GPT-style causal language model: token embedding + learned positions
     + causal transformer blocks + final LayerNorm + untied LM head, with a
@@ -378,19 +408,9 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds max_len {self.max_len}"
             )
-        from collections import OrderedDict
-
-        progs = self.__dict__.setdefault("_gen_programs", OrderedDict())
-        cache_key = (B, total, sampled)
-        fn = progs.get(cache_key)
-        if fn is None:
-            fn = progs[cache_key] = jax.jit(functools.partial(
-                self._generate_scan, total=total, sampled=sampled
-            ))
-            if len(progs) > 16:  # executables accumulate per distinct total
-                progs.popitem(last=False)
-        else:
-            progs.move_to_end(cache_key)
+        fn = _gen_program(self, (B, total, sampled), lambda: jax.jit(
+            functools.partial(self._generate_scan, total=total, sampled=sampled)
+        ))
         ys0 = jnp.concatenate(
             [prompt.astype(jnp.int32), jnp.zeros((B, n_new), jnp.int32)], axis=1
         )
@@ -415,15 +435,11 @@ class TransformerLM(nn.Module):
         def step(carry, t):
             ys, caches, k = carry
             logits, caches = self.decode_step(params, ys[:, t], t, caches)
-            if sampled:
-                k, sub = jax.random.split(k)
-                nxt = jax.random.categorical(sub, logits / temp, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt, k = _next_token(logits, sampled, temp, k)
             # prompt positions keep their given token; generation begins
             # at index S0 (fed by the prediction from position S0-1)
             cur = lax.dynamic_slice_in_dim(ys, t + 1, 1, axis=1)[:, 0]
-            nxt = jnp.where(t + 1 < S0, cur, nxt.astype(jnp.int32))
+            nxt = jnp.where(t + 1 < S0, cur, nxt)
             ys = lax.dynamic_update_slice_in_dim(ys, nxt[:, None], t + 1, axis=1)
             return (ys, caches, k), None
 
@@ -488,6 +504,34 @@ class _TransformerDecoderBlock(nn.Module):
             )(params, x, memory, k1, k2)
         return self._block(params, x, memory, k1, k2, train)
 
+    def decode_state(self, params, memory, batch: int, max_len: int, dtype=None):
+        """Per-block decoding state: an empty self-attention KV cache plus
+        the memory's cross-attention K/V, projected ONCE."""
+        import jax.numpy as jnp
+
+        kh, vh = self.cross_attn.precompute_kv(params["cross_attn"], memory)
+        return {
+            "self": self.self_attn.init_cache(batch, max_len, dtype or jnp.float32),
+            "mem_k": kh,
+            "mem_v": vh,
+        }
+
+    def decode_step(self, params, x, state):
+        """One-token decoder block step: cached causal self-attention, then
+        cross-attention against the precomputed memory K/V, then the FFN —
+        numerically the last row of :meth:`apply` over the prefix."""
+        a, self_cache = self.self_attn.decode_step(
+            params["self_attn"], self.ln1.apply(params["ln1"], x), state["self"]
+        )
+        h = x + a
+        h = h + self.cross_attn.cross_step(
+            params["cross_attn"], self.ln2.apply(params["ln2"], h),
+            state["mem_k"], state["mem_v"],
+        )
+        ff = getattr(self.ff, "decode_apply", self.ff.apply)
+        out = h + ff(params["ff"], self.ln3.apply(params["ln3"], h))
+        return out, {**state, "self": self_cache}
+
 
 class _TransformerDecoder(nn.Module):
     """Stack of decoder blocks sharing one encoder ``memory``."""
@@ -537,3 +581,161 @@ def transformer_decoder(
                                  remat=remat)
         for _ in range(depth)
     ])
+
+
+class Seq2SeqTransformer(nn.Module):
+    """Encoder-decoder transformer (the torch ``nn.Transformer`` shape):
+    source embedding + bidirectional encoder, target embedding + causal
+    decoder with cross-attention, LM head — plus cached seq2seq
+    ``generate``.
+
+    Beyond-reference model family (same provenance note as
+    :func:`transformer_encoder`).  ``apply(params, src, tgt)`` is the
+    teacher-forced forward over token ids; :meth:`generate` encodes the
+    source ONCE, projects each decoder block's cross-attention K/V from
+    the memory ONCE, and then runs the whole autoregressive loop as one
+    jitted ``lax.scan`` over static self-attention caches — the same TPU
+    decode idiom as :class:`TransformerLM`.
+    """
+
+    def __init__(self, src_vocab: int, tgt_vocab: int, embed_dim: int = 256,
+                 num_heads: int = 8, enc_depth: int = 4, dec_depth: int = 4,
+                 mlp_ratio: int = 4, max_len: int = 1024, comm=None,
+                 remat: bool = False):
+        self.src_vocab = src_vocab
+        self.tgt_vocab = tgt_vocab
+        self.embed_dim = embed_dim
+        self.max_len = max_len
+        self.src_embed = nn.Embedding(src_vocab, embed_dim)
+        self.tgt_embed = nn.Embedding(tgt_vocab, embed_dim)
+        self.encoder = [
+            _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=False,
+                              comm=comm, remat=remat)
+            for _ in range(enc_depth)
+        ]
+        self.decoder = [
+            _TransformerDecoderBlock(embed_dim, num_heads, mlp_ratio, comm,
+                                     remat=remat)
+            for _ in range(dec_depth)
+        ]
+        self.ln_f = nn.LayerNorm(embed_dim)
+        self.head = nn.Linear(embed_dim, tgt_vocab, bias=False)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self.encoder) + len(self.decoder)
+        keys = jax.random.split(key, n + 5)
+        scale = 1.0 / (self.embed_dim**0.5)
+        ne = len(self.encoder)
+        return {
+            "src_embed": jax.tree.map(lambda a: a * scale, self.src_embed.init(keys[0])),
+            "tgt_embed": jax.tree.map(lambda a: a * scale, self.tgt_embed.init(keys[1])),
+            "pos": scale * jax.random.normal(keys[2], (self.max_len, self.embed_dim)),
+            "encoder": [b.init(k) for b, k in zip(self.encoder, keys[3 : 3 + ne])],
+            "decoder": [b.init(k) for b, k in zip(self.decoder, keys[3 + ne : 3 + n])],
+            "ln_f": self.ln_f.init(keys[-2]),
+            "head": self.head.init(keys[-1]),
+        }
+
+    def encode(self, params, src, *, train: bool = False, key=None):
+        """src (B, S_enc) int → memory (B, S_enc, E)."""
+        import jax
+
+        S = src.shape[1]
+        if S > self.max_len:
+            raise ValueError(f"source length {S} exceeds max_len {self.max_len}")
+        h = self.src_embed.apply(params["src_embed"], src) + params["pos"][:S]
+        for b, p in zip(self.encoder, params["encoder"]):
+            sub = None
+            if key is not None:
+                key, sub = jax.random.split(key)
+            h = b.apply(p, h, train=train, key=sub)
+        return h
+
+    def apply(self, params, src, tgt, *, train: bool = False, key=None):
+        """Teacher-forced forward: (src, tgt) token ids → logits over the
+        target vocabulary at every target position."""
+        import jax
+
+        enc_key = dec_key = None
+        if key is not None:
+            enc_key, dec_key = jax.random.split(key)
+        memory = self.encode(params, src, train=train, key=enc_key)
+        S = tgt.shape[1]
+        if S > self.max_len:
+            raise ValueError(f"target length {S} exceeds max_len {self.max_len}")
+        h = self.tgt_embed.apply(params["tgt_embed"], tgt) + params["pos"][:S]
+        for b, p in zip(self.decoder, params["decoder"]):
+            sub = None
+            if dec_key is not None:
+                dec_key, sub = jax.random.split(dec_key)
+            h = b.apply(p, h, memory, train=train, key=sub)
+        return self.head.apply(params["head"], self.ln_f.apply(params["ln_f"], h))
+
+    def decode_step(self, params, tok, pos, states):
+        """Logits for one target position given per-block decode states."""
+        h = self.tgt_embed.apply(params["tgt_embed"], tok[:, None]) + params["pos"][pos]
+        new = []
+        for b, p, s in zip(self.decoder, params["decoder"], states):
+            h, s = b.decode_step(p, h, s)
+            new.append(s)
+        logits = self.head.apply(params["head"], self.ln_f.apply(params["ln_f"], h))
+        return logits[:, 0, :], new
+
+    def generate(self, params, src, max_new_tokens: int, *, bos_id: int = 0,
+                 temperature: float = 0.0, key=None):
+        """Autoregressively decode a target sequence for ``src`` (B, S_enc)
+        starting from ``bos_id``: encode once, then one fused scan.
+        Returns (B, 1 + max_new_tokens) target tokens beginning with BOS.
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        sampled = bool(temperature)
+        if sampled and key is None:
+            raise ValueError("sampling (temperature > 0) requires key=")
+        B = src.shape[0]
+        n_new = int(max_new_tokens)
+        if 1 + n_new > self.max_len:
+            raise ValueError(f"1 + max_new_tokens = {1 + n_new} exceeds max_len {self.max_len}")
+        fn = _gen_program(self, (B, src.shape[1], n_new, sampled), lambda: jax.jit(
+            functools.partial(self._generate_scan, n_new=n_new, sampled=sampled)
+        ))
+        return fn(
+            params,
+            src,
+            jnp.asarray(bos_id, jnp.int32),
+            jnp.asarray(temperature if sampled else 1.0, jnp.float32),
+            key if key is not None else jax.random.key(0),
+        )
+
+    def _generate_scan(self, params, src, bos, temp, key, *, n_new, sampled):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        B = src.shape[0]
+        total = 1 + n_new
+        memory = self.encode(params, src)
+        states = [
+            b.decode_state(p, memory, B, total, params["pos"].dtype)
+            for b, p in zip(self.decoder, params["decoder"])
+        ]
+        ys = jnp.concatenate(
+            [jnp.full((B, 1), bos, jnp.int32), jnp.zeros((B, n_new), jnp.int32)],
+            axis=1,
+        )
+
+        def step(carry, t):
+            ys, states, k = carry
+            logits, states = self.decode_step(params, ys[:, t], t, states)
+            nxt, k = _next_token(logits, sampled, temp, k)
+            ys = lax.dynamic_update_slice_in_dim(ys, nxt[:, None], t + 1, axis=1)
+            return (ys, states, k), None
+
+        (ys, _, _), _ = lax.scan(step, (ys, states, key), jnp.arange(total - 1))
+        return ys

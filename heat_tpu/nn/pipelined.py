@@ -38,6 +38,8 @@ class Pipelined(Module):
                  remat: bool = False, batch_axis: str | None = None):
         if comm is not None and depth % comm.size:
             raise ValueError(f"depth {depth} not divisible by pipeline stages {comm.size}")
+        if comm is None and batch_axis is not None:
+            raise ValueError("batch_axis requires a communicator (it names one of its mesh axes)")
         self.block = block
         self.depth = depth
         self.comm = comm
