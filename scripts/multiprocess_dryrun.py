@@ -194,6 +194,37 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     np.testing.assert_allclose(got, ref, atol=2e-5)
     print(f"[{pid}] ring attention (cross-process ppermute): OK", flush=True)
 
+    # ---- expert parallelism across the process boundary --------------- #
+    # the MoE's two all_to_alls move tokens between experts owned by
+    # DIFFERENT processes (round-4d) — EP data movement over the seam
+    moe = ht.nn.MoE(8, 2 * comm.size, hidden_dim=16, top_k=2,
+                    capacity_factor=8.0, comm=comm)
+    dense = ht.nn.MoE(8, 2 * comm.size, hidden_dim=16, top_k=2,
+                      capacity_factor=8.0)
+    mp_ = moe.init(jax.random.key(11))
+    xm = jnp.asarray(np.random.default_rng(8).standard_normal((comm.size, 3, 8)),
+                     jnp.float32)
+    ym = moe.apply(mp_, xm)
+    assert not ym.is_fully_addressable  # EP really crossed the seam (no dense fallback)
+    np.testing.assert_allclose(
+        comm.host_fetch(ym), np.asarray(dense.apply(mp_, xm)), atol=2e-5
+    )
+    print(f"[{pid}] MoE expert parallelism (cross-process all_to_all): OK", flush=True)
+
+    # ---- pipeline parallelism across the process boundary ------------- #
+    # stage weights sharded over devices of BOTH processes; activations
+    # cross the seam on ppermute every tick
+    blk = ht.nn.Linear(8, 8)
+    pipe = ht.nn.Pipelined(blk, depth=comm.size, comm=comm, n_microbatches=2)
+    seq = ht.nn.Pipelined(blk, depth=comm.size, comm=None)
+    pp_ = pipe.init(jax.random.key(12))
+    xp = jnp.asarray(np.random.default_rng(9).standard_normal((4, 8)), jnp.float32)
+    yp = pipe.apply(pp_, xp)
+    np.testing.assert_allclose(
+        comm.host_fetch(yp), np.asarray(seq.apply(pp_, xp)), atol=2e-5
+    )
+    print(f"[{pid}] pipeline stages (cross-process ppermute): OK", flush=True)
+
     print(f"[{pid}] {MARKER}", flush=True)
     ht.core.bootstrap.finalize_distributed()
 
