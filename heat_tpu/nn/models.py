@@ -292,16 +292,62 @@ def _gen_program(model, cache_key, build):
     return fn
 
 
-def _next_token(logits, sampled, temp, k):
+def _normalize_truncation(top_k, top_p, vocab_size, sampled):
+    """Validate + canonicalize the truncation knobs BEFORE they enter the
+    program-cache key, so no-op values never fork a duplicate executable:
+    greedy decoding ignores truncation entirely; ``top_k`` of 0/None or
+    >= vocab disables it (the transformers convention); ``top_p`` of
+    None or >= 1 disables it.  Invalid values raise eagerly."""
+    if not sampled:
+        return None, None
+    if top_k is not None:
+        top_k = int(top_k)
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if top_k == 0 or top_k >= vocab_size:
+            top_k = None
+    if top_p is not None:
+        top_p = float(top_p)
+        if top_p <= 0.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_p >= 1.0:
+            top_p = None
+    return top_k, top_p
+
+
+def _next_token(logits, sampled, temp, k, top_k=None, top_p=None):
     """Greedy-or-sampled next token — the one sampling rule both decode
-    scans share."""
+    scans share.  ``top_k`` keeps only the k highest-probability tokens;
+    ``top_p`` keeps the smallest nucleus whose probability mass reaches p
+    (the highest-probability token always survives).  Both are static
+    (part of the compiled program)."""
     import jax
     import jax.numpy as jnp
 
-    if sampled:
-        k, sub = jax.random.split(k)
-        return jax.random.categorical(sub, logits / temp, axis=-1).astype(jnp.int32), k
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k
+    if not sampled:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k
+    logits = logits / temp
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(srt, axis=-1)
+        # mass STRICTLY before each sorted slot; slots whose preceding mass
+        # already reaches p are cut (a suffix of the descending order) —
+        # the top token's preceding mass is 0, so it always survives.  The
+        # threshold is the LARGEST cut logit; everything above it is kept
+        before = jnp.cumsum(probs, axis=-1) - probs
+        cutoff = jnp.max(
+            jnp.where(before >= top_p, srt, -jnp.inf), axis=-1, keepdims=True
+        )
+        # force-keep the argmax slot: ties straddling the nucleus boundary
+        # (or a tiny p) would otherwise mask EVERY token and categorical
+        # would degenerate to index 0
+        keep = (logits > cutoff) | (logits == logits.max(axis=-1, keepdims=True))
+        logits = jnp.where(keep, logits, -jnp.inf)
+    k, sub = jax.random.split(k)
+    return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), k
 
 
 class TransformerLM(nn.Module):
@@ -381,16 +427,21 @@ class TransformerLM(nn.Module):
         return logits[:, 0, :], new
 
     def generate(self, params, prompt, max_new_tokens: int, *,
-                 temperature: float = 0.0, key=None):
+                 temperature: float = 0.0, top_k: int = None,
+                 top_p: float = None, key=None):
         """Autoregressive continuation of ``prompt`` (B, S0) int tokens.
 
         ``temperature=0`` decodes greedily; otherwise softmax sampling at
-        the given temperature (requires ``key``).  The prompt is consumed
+        the given temperature (requires ``key``), optionally truncated to
+        the ``top_k`` highest-probability tokens and/or the ``top_p``
+        nucleus (static — part of the compiled program).  The prompt is consumed
         through the same cached step as generation — the whole thing is ONE
         jitted ``lax.scan`` program, LRU-cached on the model instance and
-        keyed only on (batch, total length, sampled?): the prompt length
-        and temperature ride in as DYNAMIC arguments, so a serving loop
-        with naturally varying prompt lengths reuses one executable.
+        keyed on (batch, total length, sampled?, top_k, top_p) — the
+        prompt length and temperature ride in as DYNAMIC arguments, so a
+        serving loop with naturally varying prompt lengths or temperatures
+        reuses one executable (truncation knobs are canonicalized so no-op
+        values never fork a duplicate program).
         Returns (B, S0 + max_new_tokens) tokens beginning with the prompt.
         """
         import functools
@@ -408,8 +459,10 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds max_len {self.max_len}"
             )
-        fn = _gen_program(self, (B, total, sampled), lambda: jax.jit(
-            functools.partial(self._generate_scan, total=total, sampled=sampled)
+        top_k, top_p = _normalize_truncation(top_k, top_p, self.vocab_size, sampled)
+        fn = _gen_program(self, (B, total, sampled, top_k, top_p), lambda: jax.jit(
+            functools.partial(self._generate_scan, total=total, sampled=sampled,
+                              top_k=top_k, top_p=top_p)
         ))
         ys0 = jnp.concatenate(
             [prompt.astype(jnp.int32), jnp.zeros((B, n_new), jnp.int32)], axis=1
@@ -422,7 +475,8 @@ class TransformerLM(nn.Module):
             key if key is not None else jax.random.key(0),
         )
 
-    def _generate_scan(self, params, ys, S0, temp, key, *, total, sampled):
+    def _generate_scan(self, params, ys, S0, temp, key, *, total, sampled,
+                       top_k=None, top_p=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -435,7 +489,7 @@ class TransformerLM(nn.Module):
         def step(carry, t):
             ys, caches, k = carry
             logits, caches = self.decode_step(params, ys[:, t], t, caches)
-            nxt, k = _next_token(logits, sampled, temp, k)
+            nxt, k = _next_token(logits, sampled, temp, k, top_k, top_p)
             # prompt positions keep their given token; generation begins
             # at index S0 (fed by the prediction from position S0-1)
             cur = lax.dynamic_slice_in_dim(ys, t + 1, 1, axis=1)[:, 0]
@@ -685,7 +739,8 @@ class Seq2SeqTransformer(nn.Module):
         return logits[:, 0, :], new
 
     def generate(self, params, src, max_new_tokens: int, *, bos_id: int = 0,
-                 temperature: float = 0.0, key=None):
+                 temperature: float = 0.0, top_k: int = None,
+                 top_p: float = None, key=None):
         """Autoregressively decode a target sequence for ``src`` (B, S_enc)
         starting from ``bos_id``: encode once, then one fused scan.
         Returns (B, 1 + max_new_tokens) target tokens beginning with BOS.
@@ -702,9 +757,11 @@ class Seq2SeqTransformer(nn.Module):
         n_new = int(max_new_tokens)
         if 1 + n_new > self.max_len:
             raise ValueError(f"1 + max_new_tokens = {1 + n_new} exceeds max_len {self.max_len}")
-        fn = _gen_program(self, (B, src.shape[1], n_new, sampled), lambda: jax.jit(
-            functools.partial(self._generate_scan, n_new=n_new, sampled=sampled)
-        ))
+        top_k, top_p = _normalize_truncation(top_k, top_p, self.tgt_vocab, sampled)
+        fn = _gen_program(self, (B, src.shape[1], n_new, sampled, top_k, top_p),
+                          lambda: jax.jit(functools.partial(
+                              self._generate_scan, n_new=n_new, sampled=sampled,
+                              top_k=top_k, top_p=top_p)))
         return fn(
             params,
             src,
@@ -713,7 +770,8 @@ class Seq2SeqTransformer(nn.Module):
             key if key is not None else jax.random.key(0),
         )
 
-    def _generate_scan(self, params, src, bos, temp, key, *, n_new, sampled):
+    def _generate_scan(self, params, src, bos, temp, key, *, n_new, sampled,
+                       top_k=None, top_p=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -733,7 +791,7 @@ class Seq2SeqTransformer(nn.Module):
         def step(carry, t):
             ys, states, k = carry
             logits, states = self.decode_step(params, ys[:, t], t, states)
-            nxt, k = _next_token(logits, sampled, temp, k)
+            nxt, k = _next_token(logits, sampled, temp, k, top_k, top_p)
             ys = lax.dynamic_update_slice_in_dim(ys, nxt[:, None], t + 1, axis=1)
             return (ys, states, k), None
 

@@ -272,3 +272,102 @@ class TestSeq2Seq:
             params = opt.step(params, g)
             losses.append(float(l))
         assert losses[-1] < losses[0]
+
+
+class TestSamplingTruncation:
+    def test_top_k_restricts_support(self):
+        """With top_k=2 only the two highest-probability tokens are ever
+        drawn; with top_k=1 sampling degenerates to greedy."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.models import _next_token
+
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+        draws = set()
+        k = jax.random.key(0)
+        for _ in range(60):
+            nxt, k = _next_token(logits, True, jnp.float32(1.0), k, 2, None)
+            draws.add(int(nxt[0]))
+        assert draws <= {2, 3} and len(draws) == 2
+        nxt, _ = _next_token(logits, True, jnp.float32(1.0), jax.random.key(1), 1, None)
+        assert int(nxt[0]) == 3
+
+    def test_top_p_nucleus(self):
+        """A dominant token forms the whole nucleus at small p; at p close
+        to 1 the full support returns."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.models import _next_token
+
+        logits = jnp.asarray([[8.0, 0.0, 0.0, 0.0]])  # p(top) ~ 0.999
+        k = jax.random.key(0)
+        for _ in range(30):
+            nxt, k = _next_token(logits, True, jnp.float32(1.0), k, None, 0.5)
+            assert int(nxt[0]) == 0
+        # flat-ish logits, p=0.999: every token can appear
+        logits = jnp.asarray([[0.0, 0.1, 0.2, 0.3]])
+        draws = set()
+        for _ in range(200):
+            nxt, k = _next_token(logits, True, jnp.float32(1.0), k, None, 0.999)
+            draws.add(int(nxt[0]))
+        assert draws == {0, 1, 2, 3}
+
+    def test_nucleus_never_masks_everything(self):
+        """Ties straddling the nucleus boundary (or tiny p) must keep the
+        top token(s), never degenerate to index 0 (round-4d review)."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.models import _next_token
+
+        logits = jnp.asarray([[0.0, 5.0, 5.0]])  # tied top pair, index 0 is junk
+        k = jax.random.key(0)
+        for _ in range(40):
+            nxt, k = _next_token(logits, True, jnp.float32(1.0), k, None, 0.4)
+            assert int(nxt[0]) in (1, 2)
+
+    def test_truncation_normalization(self):
+        """transformers conventions: top_k=0 disables; no-op knobs do not
+        fork duplicate compiled programs; invalid values raise."""
+        import jax
+
+        from heat_tpu.nn.models import _normalize_truncation
+
+        assert _normalize_truncation(0, None, 31, True) == (None, None)
+        assert _normalize_truncation(99, 1.0, 31, True) == (None, None)
+        assert _normalize_truncation(50, 0.9, 31, False) == (None, None)
+        with pytest.raises(ValueError, match="top_k"):
+            _normalize_truncation(-1, None, 31, True)
+        with pytest.raises(ValueError, match="top_p"):
+            _normalize_truncation(None, 0.0, 31, True)
+
+        lm, params = _lm()
+        prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 31)
+        lm.generate(params, prompt, 3)
+        n0 = len(lm._gen_programs)
+        # greedy ignores truncation -> same program as plain greedy
+        lm.generate(params, prompt, 3, top_k=5)
+        assert len(lm._gen_programs) == n0
+        # sampled with no-op knobs -> same program as plain sampled
+        lm.generate(params, prompt, 3, temperature=1.0, key=jax.random.key(2))
+        n1 = len(lm._gen_programs)
+        lm.generate(params, prompt, 3, temperature=1.0, top_k=0, top_p=1.0,
+                    key=jax.random.key(2))
+        assert len(lm._gen_programs) == n1
+
+    def test_generate_with_truncation(self):
+        import jax
+
+        lm, params = _lm()
+        prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 31)
+        n0 = len(getattr(lm, "_gen_programs", {}))
+        a = lm.generate(params, prompt, 6, temperature=1.0, top_k=5,
+                        key=jax.random.key(2))
+        assert a.shape == (2, 10) and bool((a[:, :4] == prompt).all())
+        b = lm.generate(params, prompt, 6, temperature=1.0, top_p=0.9,
+                        key=jax.random.key(2))
+        assert b.shape == (2, 10)
+        # distinct truncation settings are distinct compiled programs
+        assert len(lm._gen_programs) == n0 + 2
