@@ -617,7 +617,17 @@ def _cpu_fallback_payload(worker_error: str = "") -> dict:
     }
     if worker_error:
         payload["extra"]["worker_error"] = worker_error[:300]
+    # point degraded runs at the round's real-chip captures (the relay comes
+    # and goes; manual runs were taken while it was up)
+    import glob
+
     repo_root = os.path.dirname(os.path.abspath(__file__))
+    manual = sorted(
+        os.path.basename(f)
+        for f in glob.glob(os.path.join(repo_root, "BENCH_r*_manual.json"))
+    )
+    if manual:
+        payload["extra"]["real_chip_captures"] = manual
     script = (
         "import sys, jax, json, time\n"
         f"sys.path.insert(0, {repo_root!r})\n"
