@@ -770,6 +770,27 @@ class Seq2SeqTransformer(nn.Module):
             key if key is not None else jax.random.key(0),
         )
 
+    def _decode_init(self, params, src, total, beams: int = 1):
+        """Per-block decode states for ``src`` — THE shared setup of the
+        greedy/sampled scan and the beam scan.  The encoder runs ONCE and
+        each block's cross-attention K/V is projected from the un-repeated
+        (B, ...) memory; with ``beams > 1`` the projected K/V is repeated
+        beam-major afterwards (one cheap copy instead of W projections)
+        while the self-attention caches are sized B·beams directly."""
+        import jax.numpy as jnp
+
+        B = src.shape[0]
+        memory = self.encode(params, src)
+        states = []
+        for b, p in zip(self.decoder, params["decoder"]):
+            st = b.decode_state(p, memory, B * beams, total, params["pos"].dtype)
+            if beams > 1:
+                st = {**st,
+                      "mem_k": jnp.repeat(st["mem_k"], beams, axis=0),
+                      "mem_v": jnp.repeat(st["mem_v"], beams, axis=0)}
+            states.append(st)
+        return states
+
     def _generate_scan(self, params, src, bos, temp, key, *, n_new, sampled,
                        top_k=None, top_p=None):
         import jax
@@ -778,11 +799,7 @@ class Seq2SeqTransformer(nn.Module):
 
         B = src.shape[0]
         total = 1 + n_new
-        memory = self.encode(params, src)
-        states = [
-            b.decode_state(p, memory, B, total, params["pos"].dtype)
-            for b, p in zip(self.decoder, params["decoder"])
-        ]
+        states = self._decode_init(params, src, total)
         ys = jnp.concatenate(
             [jnp.full((B, 1), bos, jnp.int32), jnp.zeros((B, n_new), jnp.int32)],
             axis=1,
@@ -797,3 +814,88 @@ class Seq2SeqTransformer(nn.Module):
 
         (ys, _, _), _ = lax.scan(step, (ys, states, key), jnp.arange(total - 1))
         return ys
+
+    # ------------------------------------------------------------------ #
+    # beam search
+    # ------------------------------------------------------------------ #
+
+    def beam_search(self, params, src, max_new_tokens: int, *,
+                    beam_width: int = 4, bos_id: int = 0):
+        """Fixed-length beam search over the target vocabulary.
+
+        Keeps the ``beam_width`` highest-log-probability partial sequences
+        at every step; the whole search is ONE jitted ``lax.scan`` — beams
+        ride the batch dimension (B·W), and each step reorders the beams'
+        KV caches by a batched gather.  Returns the single best sequence
+        per source, (B, 1 + max_new_tokens) starting with BOS.
+
+        Sequences are fixed-length (no EOS shortcut): scores compare
+        completions of identical length, so no length normalization is
+        needed.  ``beam_width=1`` is exactly greedy decoding (tested).
+        """
+        import functools
+
+        import jax
+
+        B = src.shape[0]
+        n_new = int(max_new_tokens)
+        W = int(beam_width)
+        if W < 1:
+            raise ValueError(f"beam_width must be >= 1, got {W}")
+        if 1 + n_new > self.max_len:
+            raise ValueError(f"1 + max_new_tokens = {1 + n_new} exceeds max_len {self.max_len}")
+        fn = _gen_program(self, ("beam", B, src.shape[1], n_new, W),
+                          lambda: jax.jit(functools.partial(
+                              self._beam_scan, n_new=n_new, W=W)))
+        import jax.numpy as jnp
+
+        return fn(params, src, jnp.asarray(bos_id, jnp.int32))
+
+    def _beam_scan(self, params, src, bos, *, n_new, W):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        B = src.shape[0]
+        V = self.tgt_vocab
+        total = 1 + n_new
+        states = self._decode_init(params, src, total, beams=W)
+        ys = jnp.concatenate(
+            [jnp.full((B * W, 1), bos, jnp.int32),
+             jnp.zeros((B * W, n_new), jnp.int32)], axis=1
+        )
+        # only beam 0 is live at the start, or the first expansion would
+        # pick W copies of the same argmax token
+        scores = jnp.where(jnp.arange(W) == 0, 0.0, -jnp.inf)[None, :].repeat(B, 0)
+
+        def reorder(a, gather_idx):
+            # beam-reorder the self-cache K/V (leading dim B*W); the scalar
+            # write index is shared, and the memory K/V never needs the
+            # gather — beams of one source share identical memory rows
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] == B * W:
+                return a[gather_idx]
+            return a
+
+        def step(carry, t):
+            ys, states, scores = carry
+            logits, states = self.decode_step(params, ys[:, t], t, states)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            cand = scores[:, :, None] + logp.reshape(B, W, V)  # (B, W, V)
+            top_s, top_i = lax.top_k(cand.reshape(B, W * V), W)  # (B, W)
+            beam_of = top_i // V
+            tok = (top_i % V).astype(jnp.int32)
+            gather_idx = (jnp.arange(B)[:, None] * W + beam_of).reshape(-1)
+            ys = ys[gather_idx]
+            ys = lax.dynamic_update_slice_in_dim(
+                ys, tok.reshape(-1)[:, None], t + 1, axis=1
+            )
+            states = [
+                {**st, "self": jax.tree.map(lambda a: reorder(a, gather_idx),
+                                            st["self"])}
+                for st in states
+            ]
+            return (ys, states, top_s), None
+
+        (ys, _, scores), _ = lax.scan(step, (ys, states, scores), jnp.arange(n_new))
+        best = jnp.argmax(scores, axis=1)  # (B,)
+        return ys.reshape(B, W, total)[jnp.arange(B), best]

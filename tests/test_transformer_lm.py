@@ -371,3 +371,73 @@ class TestSamplingTruncation:
         assert b.shape == (2, 10)
         # distinct truncation settings are distinct compiled programs
         assert len(lm._gen_programs) == n0 + 2
+
+
+class TestBeamSearch:
+    def _model(self):
+        import jax
+
+        from heat_tpu.nn.models import Seq2SeqTransformer
+
+        m = Seq2SeqTransformer(src_vocab=7, tgt_vocab=5, embed_dim=16,
+                               num_heads=2, enc_depth=1, dec_depth=1, max_len=16)
+        return m, m.init(jax.random.key(0))
+
+    def test_width_one_is_greedy(self):
+        import jax
+
+        m, params = self._model()
+        src = jax.random.randint(jax.random.key(1), (2, 5), 0, 7)
+        b1 = m.beam_search(params, src, 4, beam_width=1, bos_id=1)
+        g = m.generate(params, src, 4, bos_id=1)
+        np.testing.assert_array_equal(np.asarray(b1), np.asarray(g))
+
+    def test_exhaustive_width_finds_global_optimum(self):
+        """With beam_width >= V^n the search is exhaustive and must return
+        the argmax-probability sequence (brute-force oracle)."""
+        import itertools
+
+        import jax
+        import jax.numpy as jnp
+
+        m, params = self._model()
+        src = jax.random.randint(jax.random.key(1), (2, 5), 0, 7)
+        n = 3
+
+        def seq_logprob(tgt_seq):
+            bos = jnp.ones((2, 1), jnp.int32)
+            inp = jnp.concatenate([bos, tgt_seq[:, :-1]], axis=1)
+            lp = jax.nn.log_softmax(m.apply(params, src, inp), axis=-1)
+            return jnp.take_along_axis(lp, tgt_seq[:, :, None], axis=2)[:, :, 0].sum(axis=1)
+
+        lp_fn = jax.jit(seq_logprob)
+        best_lp = np.full(2, -np.inf)
+        best_seq = np.zeros((2, n), np.int32)
+        for cand in itertools.product(range(5), repeat=n):
+            c = jnp.tile(jnp.asarray(cand, jnp.int32)[None, :], (2, 1))
+            lp = np.asarray(lp_fn(c))
+            for b in range(2):
+                if lp[b] > best_lp[b]:
+                    best_lp[b] = lp[b]
+                    best_seq[b] = cand
+        out = np.asarray(m.beam_search(params, src, n, beam_width=125, bos_id=1))[:, 1:]
+        np.testing.assert_array_equal(out, best_seq)
+
+        # a practical width must score at least as well as greedy
+        b4 = np.asarray(m.beam_search(params, src, n, beam_width=4, bos_id=1))[:, 1:]
+        g = np.asarray(m.generate(params, src, n, bos_id=1))[:, 1:]
+        lp4 = np.asarray(lp_fn(jnp.asarray(b4)))
+        lpg = np.asarray(lp_fn(jnp.asarray(g)))
+        assert (lp4 >= lpg - 1e-5).all()
+
+    def test_validation_and_cache(self):
+        import jax
+
+        m, params = self._model()
+        src = jax.random.randint(jax.random.key(1), (2, 5), 0, 7)
+        with pytest.raises(ValueError, match="beam_width"):
+            m.beam_search(params, src, 3, beam_width=0)
+        m.beam_search(params, src, 3, beam_width=2)
+        n1 = len(m._gen_programs)
+        m.beam_search(params, src, 3, beam_width=2)
+        assert len(m._gen_programs) == n1  # program reused
