@@ -509,7 +509,7 @@ class _TransformerDecoderBlock(nn.Module):
     cross-attention against the (differently-sized) encoder memory."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
-                 comm=None, remat: bool = False):
+                 comm=None, remat: bool = False, ffn: nn.Module = None):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
@@ -517,7 +517,7 @@ class _TransformerDecoderBlock(nn.Module):
         self.ln2 = nn.LayerNorm(embed_dim)
         self.cross_attn = MultiheadAttention(embed_dim, num_heads, comm=comm)
         self.ln3 = nn.LayerNorm(embed_dim)
-        self.ff = _ffn(embed_dim, mlp_ratio)
+        self.ff = ffn if ffn is not None else _ffn(embed_dim, mlp_ratio)
         self.remat = remat
         self._remat_fns = {}
 
@@ -617,6 +617,9 @@ def transformer_decoder(
     mlp_ratio: int = 4,
     comm=None,
     remat: bool = False,
+    num_experts: int = None,
+    moe_top_k: int = 2,
+    moe_capacity_factor: float = 1.5,
 ) -> nn.Module:
     """A stack of pre-norm transformer DECODER blocks: causal
     self-attention + cross-attention against an encoder ``memory``.
@@ -626,13 +629,18 @@ def transformer_decoder(
     ``comm`` every block's attentions run sequence-parallel on the mesh
     ring (the cross-attention rotates the encoder memory's K/V blocks
     against resident decoder query blocks), so BOTH context lengths scale
-    with the chip count; ``remat=True`` checkpoints each block.  Beyond-
+    with the chip count; ``remat=True`` checkpoints each block.
+    ``num_experts`` swaps every block's FFN for an expert-parallel
+    :class:`~heat_tpu.nn.MoE` of the same hidden width (Switch style;
+    ``moe_top_k``/``moe_capacity_factor`` tune the routing).  Beyond-
     reference model family, same provenance note as
     :func:`transformer_encoder`.
     """
+    moe_ffn = _block_ffn(embed_dim, mlp_ratio, num_experts, moe_top_k, comm,
+                         moe_capacity_factor)
     return _TransformerDecoder([
         _TransformerDecoderBlock(embed_dim, num_heads, mlp_ratio, comm,
-                                 remat=remat)
+                                 remat=remat, ffn=moe_ffn)
         for _ in range(depth)
     ])
 
@@ -655,21 +663,26 @@ class Seq2SeqTransformer(nn.Module):
     def __init__(self, src_vocab: int, tgt_vocab: int, embed_dim: int = 256,
                  num_heads: int = 8, enc_depth: int = 4, dec_depth: int = 4,
                  mlp_ratio: int = 4, max_len: int = 1024, comm=None,
-                 remat: bool = False):
+                 remat: bool = False, num_experts: int = None,
+                 moe_top_k: int = 2, moe_capacity_factor: float = 1.5):
         self.src_vocab = src_vocab
         self.tgt_vocab = tgt_vocab
         self.embed_dim = embed_dim
         self.max_len = max_len
         self.src_embed = nn.Embedding(src_vocab, embed_dim)
         self.tgt_embed = nn.Embedding(tgt_vocab, embed_dim)
+        # ONE shared MoE for both stacks (stateless; params are per-block
+        # via init keys) -> one compiled EP program for the whole model
+        moe_ffn = _block_ffn(embed_dim, mlp_ratio, num_experts, moe_top_k,
+                             comm, moe_capacity_factor)
         self.encoder = [
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=False,
-                              comm=comm, remat=remat)
+                              comm=comm, remat=remat, ffn=moe_ffn)
             for _ in range(enc_depth)
         ]
         self.decoder = [
             _TransformerDecoderBlock(embed_dim, num_heads, mlp_ratio, comm,
-                                     remat=remat)
+                                     remat=remat, ffn=moe_ffn)
             for _ in range(dec_depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)

@@ -242,6 +242,35 @@ class TestSeq2Seq:
         with pytest.raises(ValueError, match="requires key"):
             m.generate(params, src, 4, temperature=1.0)
 
+    def test_moe_ffn_variant(self):
+        """num_experts= swaps FFNs for MoE in BOTH the encoder and decoder
+        stacks; teacher forcing, decode and beam search all work and the
+        decode==apply contract holds (drop-free decode, loose capacity)."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.models import Seq2SeqTransformer
+
+        m = Seq2SeqTransformer(src_vocab=11, tgt_vocab=9, embed_dim=16,
+                               num_heads=2, enc_depth=1, dec_depth=1,
+                               max_len=16, num_experts=4,
+                               moe_capacity_factor=64.0)
+        params = m.init(jax.random.key(0))
+        assert "w1" in params["encoder"][0]["ff"] and "w1" in params["decoder"][0]["ff"]
+        src = jax.random.randint(jax.random.key(1), (2, 5), 0, 11)
+        tgt = jax.random.randint(jax.random.key(2), (2, 6), 0, 9)
+        full = m.apply(params, src, tgt)
+        assert bool(jnp.isfinite(full).all())
+        states = [b.decode_state(p, m.encode(params, src), 2, 6)
+                  for b, p in zip(m.decoder, params["decoder"])]
+        for t in range(6):
+            lg, states = m.decode_step(params, tgt[:, t], t, states)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+        out = m.beam_search(params, src, 4, beam_width=3, bos_id=1)
+        assert out.shape == (2, 5) and bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
     def test_copy_task_trains(self):
         """Seq2seq lifecycle: learn the identity mapping src -> src."""
         import jax
