@@ -34,15 +34,26 @@ __all__ = ["MoE"]
 def _ep_program(comm, moe):
     """Compiled expert-parallel forward, cached ON the comm (identity-keyed
     on the layer instance — same convention as the other collective
-    pipelines; jit's own cache handles shape/dtype variation)."""
+    pipelines; jit's own cache handles shape/dtype variation).
+
+    Token sharding: over the expert axis itself by default; with
+    ``moe.batch_axis`` set the tokens shard over BOTH axes jointly (dp x ep)
+    — within each dp slice this reduces to the pure-ep path over that
+    slice's token shard, so there is no replicated expert compute, while
+    the expert weights stay sharded over ep only (replicated over dp;
+    their gradients psum over dp under GSPMD exactly like any replicated
+    parameter)."""
+    from jax.sharding import PartitionSpec as P
+
+    tok = P((moe.batch_axis, comm.axis)) if moe.batch_axis else P(comm.axis)
     fn = comm.shard_map(
         moe._ep_fn,
         in_splits=(
             {"router": (2, None), "w1": (3, 0), "b1": (2, 0), "w2": (3, 0), "b2": (2, 0)},
-            (2, 0),
-            (1, 0),
+            tok,
+            tok,
         ),
-        out_splits=(2, 0),
+        out_splits=tok,
     )
     return jax.jit(fn)
 
@@ -124,15 +135,27 @@ class MoE(Module):
         top_k: int = 2,
         capacity_factor: float = 1.5,
         comm=None,
+        batch_axis: str | None = None,
     ):
         if top_k < 1 or top_k > num_experts:
             raise ValueError(f"top_k {top_k} must be in [1, num_experts={num_experts}]")
+        if batch_axis is not None:
+            if comm is None:
+                raise ValueError(
+                    "batch_axis requires a communicator (it names one of its mesh axes)"
+                )
+            if batch_axis not in comm.mesh.axis_names or batch_axis == comm.axis:
+                raise ValueError(
+                    f"batch_axis {batch_axis!r} must name a mesh axis other "
+                    f"than the expert axis {comm.axis!r}"
+                )
         self.embed_dim = embed_dim
         self.num_experts = num_experts
         self.hidden_dim = hidden_dim or 4 * embed_dim
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.comm = comm
+        self.batch_axis = batch_axis  # dp axis of a 2-D mesh (see _ep_program)
 
     def init(self, key):
         D, H, E = self.embed_dim, self.hidden_dim, self.num_experts
@@ -194,7 +217,9 @@ class MoE(Module):
             )
             return self._dense(params, x2d).reshape(orig_shape)
 
-        p = comm.size
+        # tokens shard over dp x ep jointly when batch_axis is given,
+        # else over the expert axis alone
+        p = comm.size * (comm.mesh.shape[self.batch_axis] if self.batch_axis else 1)
         n = x2d.shape[0]
         pad = (-n) % p
         mask = jnp.ones((n,), x2d.dtype)

@@ -153,6 +153,49 @@ class TestMoE:
         served = np.asarray(dispatch.sum(axis=(1, 2)))
         np.testing.assert_array_equal(served, [0.0, 1.0, 1.0])
 
+    def test_dp_ep_composition(self):
+        """Experts sharded over 'ep' with tokens sharded over 'dp' of one
+        2-D mesh — each dp slice routes its tokens through the ep-sharded
+        experts; output stays dp-sharded."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        n = len(jax.devices())
+        if n < 4 or n % 2:
+            pytest.skip("needs an even mesh of >= 4 devices")
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, n // 2), ("dp", "ep"))
+        comm_ep = ht.communication.Communication(mesh, axis="ep")
+        D, E = 8, n  # divisible by the ep axis
+        dense = ht.nn.MoE(D, E, hidden_dim=16, top_k=2, capacity_factor=64.0)
+        moe = ht.nn.MoE(D, E, hidden_dim=16, top_k=2, capacity_factor=64.0,
+                        comm=comm_ep, batch_axis="dp")
+        params = dense.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (6, 7, D))  # ragged tokens
+        np.testing.assert_allclose(
+            np.asarray(dense.apply(params, x)), np.asarray(moe.apply(params, x)),
+            rtol=2e-4, atol=2e-5,
+        )
+        g = jax.grad(lambda p: jnp.sum(moe.apply(p, x) ** 2))(params)
+        gd = jax.grad(lambda p: jnp.sum(dense.apply(p, x) ** 2))(params)
+        for k in g:
+            np.testing.assert_allclose(np.asarray(g[k]), np.asarray(gd[k]),
+                                       rtol=1e-3, atol=1e-4)
+        # the compiled EP program itself shards tokens over BOTH axes
+        # jointly — no replicated expert compute over the ep axis (apply's
+        # eager unpad/reshape afterwards may legitimately re-lay-out)
+        from heat_tpu.nn.moe import _ep_program
+
+        x2d = jax.random.normal(jax.random.key(2), (2 * n, 8))
+        mask = jnp.ones((2 * n,), x2d.dtype)
+        yprog = _ep_program(comm_ep, moe)(params, x2d, mask)
+        assert set(yprog.sharding.spec[0]) == {"dp", "ep"}
+        assert len(yprog.sharding.device_set) == n
+        with pytest.raises(ValueError, match="batch_axis"):
+            ht.nn.MoE(D, E, comm=None, batch_axis="dp")
+        with pytest.raises(ValueError, match="batch_axis"):
+            ht.nn.MoE(D, E, comm=comm_ep, batch_axis="ep")
+
     def test_load_balance_loss(self):
         import jax
 
