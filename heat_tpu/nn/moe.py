@@ -207,7 +207,10 @@ class MoE(Module):
         orig_shape = x.shape
         x2d = x.reshape(-1, self.embed_dim)
         comm = self.comm
-        if comm is None or comm.size == 1:
+        # a (dp, ep=1) mesh still runs the EP program (the all_to_all is an
+        # identity there) so the dp token sharding survives — only the
+        # truly-unsharded case takes the dense shortcut
+        if comm is None or (comm.size == 1 and self.batch_axis is None):
             return self._dense(params, x2d).reshape(orig_shape)
         if self.num_experts % comm.size:
             warnings.warn(

@@ -196,6 +196,33 @@ class TestMoE:
         with pytest.raises(ValueError, match="batch_axis"):
             ht.nn.MoE(D, E, comm=comm_ep, batch_axis="ep")
 
+    def test_dp_with_single_expert_shard(self):
+        """(dp, ep=1) mesh: batch_axis must keep the dp token sharding
+        alive instead of silently taking the replicated dense path."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        n = len(jax.devices())
+        if n < 2:
+            pytest.skip("needs a multi-device mesh")
+        mesh = Mesh(np.asarray(jax.devices()).reshape(n, 1), ("dp", "ep"))
+        comm_ep = ht.communication.Communication(mesh, axis="ep")
+        dense = ht.nn.MoE(8, 4, hidden_dim=16, top_k=2, capacity_factor=64.0)
+        moe = ht.nn.MoE(8, 4, hidden_dim=16, top_k=2, capacity_factor=64.0,
+                        comm=comm_ep, batch_axis="dp")
+        params = dense.init(jax.random.key(0))
+        from heat_tpu.nn.moe import _ep_program
+
+        x2d = jax.random.normal(jax.random.key(1), (2 * n, 8))
+        mask = jnp.ones((2 * n,), x2d.dtype)
+        y = _ep_program(comm_ep, moe)(params, x2d, mask)
+        assert len(y.sharding.device_set) == n  # dp sharding survived
+        np.testing.assert_allclose(
+            np.asarray(moe.apply(params, x2d)), np.asarray(dense.apply(params, x2d)),
+            rtol=2e-4, atol=2e-5,
+        )
+
     def test_load_balance_loss(self):
         import jax
 
