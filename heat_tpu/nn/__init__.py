@@ -2,7 +2,7 @@
 
 from .modules import *
 from . import modules
-from .attention import MultiheadAttention
+from .attention import MultiheadAttention, apply_rope
 from .moe import MoE
 from .pipelined import Pipelined
 from .recurrent import GRU, LSTM, RNN

@@ -18,7 +18,28 @@ import jax.numpy as jnp
 
 from .modules import Module
 
-__all__ = ["MultiheadAttention"]
+__all__ = ["MultiheadAttention", "apply_rope"]
+
+
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on per-head states x (..., S, d).
+
+    Rotates consecutive pairs of feature channels by position-dependent
+    angles, so q·k depends only on the RELATIVE position (the RoPE
+    property; tested).  ``positions`` broadcasts against x's S axis — an
+    ``arange`` for a full sequence, a scalar index for one decode step.
+    Pointwise along S, so it rides GSPMD sharding (the sequence-parallel
+    ring applies it to the sharded q/k before the rotation starts).
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope requires an even head dim, got {d}")
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # (d/2,)
+    ang = jnp.asarray(positions, jnp.float32)[..., None] * freqs  # (..., S, d/2)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 class MultiheadAttention(Module):
@@ -51,16 +72,22 @@ class MultiheadAttention(Module):
         bias: bool = True,
         batch_first: bool = True,
         comm=None,
+        rope: bool = False,
+        rope_base: float = 10000.0,
     ):
         if embed_dim % num_heads:
             raise ValueError(f"embed_dim {embed_dim} not divisible by num_heads {num_heads}")
         if not batch_first:
             raise ValueError("only batch_first=True is supported (framework layout)")
+        if rope and (embed_dim // num_heads) % 2:
+            raise ValueError("rope requires an even head dim")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
         self.bias = bias
         self.comm = comm
+        self.rope = rope  # rotary positions on SELF-attention q/k (not cross)
+        self.rope_base = rope_base
 
     def init(self, key):
         k1, k2 = jax.random.split(key)
@@ -152,6 +179,11 @@ class MultiheadAttention(Module):
         q, k, v = jnp.split(proj, 3, axis=-1)
         qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B,H,1,d)
         i = cache["index"]
+        if self.rope:
+            # rotate at THIS position; the cache stores post-rope keys, so
+            # cached entries already carry their positions (standard)
+            qh = apply_rope(qh, i, self.rope_base)
+            kh = apply_rope(kh, i, self.rope_base)
         kc = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh.astype(cache["k"].dtype), i, axis=2)
         vc = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh.astype(cache["v"].dtype), i, axis=2)
         L = kc.shape[2]
@@ -253,6 +285,12 @@ class MultiheadAttention(Module):
             q = x @ w[:E].T + (b[:E] if b is not None else 0.0)
             qh = self._heads(q)
             kh, vh = self._project_kv(params, kv)
+        if self.rope and kv is None:
+            # rotary positions on self-attention only (cross-attention has
+            # no shared position scale between q and the encoder memory)
+            pos = jnp.arange(qh.shape[-2])
+            qh = apply_rope(qh, pos, self.rope_base)
+            kh = apply_rope(kh, pos, self.rope_base)
         from ..parallel.ring_attention import _global_attention, ring_attention
 
         probs = None

@@ -158,11 +158,11 @@ class _TransformerBlock(nn.Module):
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  causal: bool = False, comm=None, remat: bool = False,
-                 ffn: nn.Module = None):
+                 ffn: nn.Module = None, rope: bool = False):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
-        self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm)
+        self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm, rope=rope)
         self.ln2 = nn.LayerNorm(embed_dim)
         self.ff = ffn if ffn is not None else _ffn(embed_dim, mlp_ratio)
         self.causal = causal
@@ -351,9 +351,11 @@ def _next_token(logits, sampled, temp, k, top_k=None, top_p=None):
 
 
 class TransformerLM(nn.Module):
-    """GPT-style causal language model: token embedding + learned positions
-    + causal transformer blocks + final LayerNorm + untied LM head, with a
-    compiled KV-cache ``generate`` loop.
+    """GPT-style causal language model: token embedding + positions
+    (``positions='learned'`` table, the default, or ``'rope'`` rotary —
+    no table; see :func:`heat_tpu.nn.apply_rope`) + causal transformer
+    blocks + final LayerNorm + untied LM head, with a compiled KV-cache
+    ``generate`` loop.
 
     Beyond-reference model family (same provenance note as
     :func:`transformer_encoder`), completing the inference half of the
@@ -370,17 +372,22 @@ class TransformerLM(nn.Module):
     def __init__(self, vocab_size: int, embed_dim: int = 256, num_heads: int = 8,
                  depth: int = 4, mlp_ratio: int = 4, max_len: int = 1024,
                  comm=None, remat: bool = False, num_experts: int = None,
-                 moe_top_k: int = 2, moe_capacity_factor: float = 1.5):
+                 moe_top_k: int = 2, moe_capacity_factor: float = 1.5,
+                 positions: str = "learned"):
+        if positions not in ("learned", "rope"):
+            raise ValueError(f"positions must be 'learned' or 'rope', got {positions!r}")
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.max_len = max_len
+        self.positions = positions
         self.embed = nn.Embedding(vocab_size, embed_dim)
         # one shared MoE instance (stateless) -> one compiled EP program
         moe_ffn = _block_ffn(embed_dim, mlp_ratio, num_experts, moe_top_k,
                              comm, moe_capacity_factor)
         self.blocks = [
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=True,
-                              comm=comm, remat=remat, ffn=moe_ffn)
+                              comm=comm, remat=remat, ffn=moe_ffn,
+                              rope=(positions == "rope"))
             for _ in range(depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)
@@ -392,13 +399,15 @@ class TransformerLM(nn.Module):
 
         keys = jax.random.split(key, len(self.blocks) + 4)
         scale = 1.0 / (self.embed_dim**0.5)
-        return {
+        out = {
             "embed": jax.tree.map(lambda a: a * scale, self.embed.init(keys[0])),
-            "pos": scale * jax.random.normal(keys[1], (self.max_len, self.embed_dim)),
             "blocks": [b.init(k) for b, k in zip(self.blocks, keys[2:])],
             "ln_f": self.ln_f.init(keys[-2]),
             "head": self.head.init(keys[-1]),
         }
+        if self.positions == "learned":
+            out["pos"] = scale * jax.random.normal(keys[1], (self.max_len, self.embed_dim))
+        return out
 
     def apply(self, params, tokens, *, train: bool = False, key=None):
         """Teacher-forced forward: tokens (B, S) int → logits (B, S, vocab)."""
@@ -407,7 +416,9 @@ class TransformerLM(nn.Module):
         S = tokens.shape[1]
         if S > self.max_len:
             raise ValueError(f"sequence length {S} exceeds max_len {self.max_len}")
-        h = self.embed.apply(params["embed"], tokens) + params["pos"][:S]
+        h = self.embed.apply(params["embed"], tokens)
+        if self.positions == "learned":
+            h = h + params["pos"][:S]
         for b, p in zip(self.blocks, params["blocks"]):
             sub = None
             if key is not None:
@@ -417,8 +428,17 @@ class TransformerLM(nn.Module):
 
     def decode_step(self, params, tok, pos, caches):
         """Logits for one position given the caches: tok (B,) int at
-        position ``pos``.  Returns (logits (B, vocab), new_caches)."""
-        h = self.embed.apply(params["embed"], tok[:, None]) + params["pos"][pos]
+        position ``pos``.  Returns (logits (B, vocab), new_caches).
+
+        Under ``positions='rope'`` the rotation position comes from the
+        CACHE index (which the caches advance themselves), so ``pos`` only
+        selects the learned-table row in ``'learned'`` mode — keep the two
+        in step by feeding positions 0,1,2,… from fresh caches (as
+        ``generate`` does); resuming mid-sequence needs caches whose index
+        already equals ``pos``."""
+        h = self.embed.apply(params["embed"], tok[:, None])
+        if self.positions == "learned":
+            h = h + params["pos"][pos]
         new = []
         for b, p, c in zip(self.blocks, params["blocks"], caches):
             h, c = b.decode_step(p, h, c)
@@ -484,7 +504,8 @@ class TransformerLM(nn.Module):
         B = ys.shape[0]
         # cache in the model's compute dtype (bf16 params -> bf16 K/V
         # buffers and attention einsums, halving the decode working set)
-        caches = [b.init_cache(B, total, params["pos"].dtype) for b in self.blocks]
+        dt = params["embed"]["weight"].dtype
+        caches = [b.init_cache(B, total, dt) for b in self.blocks]
 
         def step(carry, t):
             ys, caches, k = carry

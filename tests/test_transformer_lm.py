@@ -470,3 +470,108 @@ class TestBeamSearch:
         n1 = len(m._gen_programs)
         m.beam_search(params, src, 3, beam_width=2)
         assert len(m._gen_programs) == n1  # program reused
+
+
+class TestRoPE:
+    def test_relative_shift_invariance(self):
+        """The RoPE property: q·k depends only on the relative position."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.attention import apply_rope
+
+        q = jax.random.normal(jax.random.key(0), (1, 2, 1, 8))
+        k = jax.random.normal(jax.random.key(1), (1, 2, 1, 8))
+
+        def score(i, j):
+            qi = apply_rope(q, jnp.asarray([i]))
+            kj = apply_rope(k, jnp.asarray([j]))
+            return float(jnp.einsum("bhqd,bhkd->bhqk", qi, kj)[0, 0, 0, 0])
+
+        assert abs(score(3, 1) - score(10, 8)) < 1e-4
+        assert abs(score(5, 5) - score(0, 0)) < 1e-4
+        # position zero is the identity rotation
+        np.testing.assert_allclose(
+            np.asarray(apply_rope(q, jnp.asarray([0]))), np.asarray(q), atol=1e-6
+        )
+        with pytest.raises(ValueError, match="even head dim"):
+            apply_rope(jnp.zeros((1, 1, 1, 7)), jnp.asarray([0]))
+
+    def test_rope_lm_decode_contract(self):
+        """positions='rope': no learned table, cached decode == teacher-
+        forced forward, greedy generate == naive prefix recompute."""
+        import jax
+        import jax.numpy as jnp
+
+        lm = TransformerLM(vocab_size=31, embed_dim=16, num_heads=2, depth=2,
+                           max_len=32, positions="rope")
+        params = lm.init(jax.random.key(0))
+        assert "pos" not in params
+        toks = jax.random.randint(jax.random.key(1), (2, 9), 0, 31)
+        full = lm.apply(params, toks)
+        caches = [b.init_cache(2, 9) for b in lm.blocks]
+        for t in range(9):
+            lg, caches = lm.decode_step(params, toks[:, t], t, caches)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+        out = lm.generate(params, toks[:, :3], 5)
+        cur = toks[:, :3]
+        for _ in range(5):
+            nxt = jnp.argmax(lm.apply(params, cur)[:, -1, :], axis=-1)
+            cur = jnp.concatenate([cur, nxt[:, None].astype(jnp.int32)], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+    def test_rope_rides_the_ring(self):
+        """Sequence-parallel self-attention with rope == the local path
+        (rope is pointwise along S, so it shards with the sequence)."""
+        import jax
+        import jax.numpy as jnp
+
+        comm = ht.communication.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        lm_loc = TransformerLM(vocab_size=31, embed_dim=16, num_heads=2,
+                               depth=2, max_len=32, positions="rope")
+        params = lm_loc.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 11), 0, 31)
+        ring = TransformerLM(vocab_size=31, embed_dim=16, num_heads=2,
+                             depth=2, max_len=32, positions="rope", comm=comm)
+        np.testing.assert_allclose(
+            np.asarray(ring.apply(params, toks)),
+            np.asarray(lm_loc.apply(params, toks)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_rope_validation(self):
+        with pytest.raises(ValueError, match="positions"):
+            TransformerLM(vocab_size=8, positions="sinusoidal")
+        from heat_tpu.nn.attention import MultiheadAttention
+
+        with pytest.raises(ValueError, match="even head dim"):
+            MultiheadAttention(embed_dim=9, num_heads=3, rope=True)
+
+    def test_rope_training(self):
+        import jax
+        import jax.numpy as jnp
+
+        lm = TransformerLM(vocab_size=31, embed_dim=16, num_heads=2, depth=2,
+                           max_len=32, positions="rope")
+        params = lm.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (4, 12), 0, 31)
+
+        def loss_fn(p):
+            logits = lm.apply(p, toks[:, :-1])
+            return ht.nn.functional.cross_entropy(
+                logits.reshape(-1, 31), toks[:, 1:].reshape(-1)
+            )
+
+        opt = ht.optim.DataParallelOptimizer("adam", lr=1e-2)
+        opt.init_state(params)
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+        losses = []
+        for _ in range(10):
+            l, g = vg(params)
+            params = opt.step(params, g)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
