@@ -373,9 +373,10 @@ class TransformerLM(nn.Module):
                  depth: int = 4, mlp_ratio: int = 4, max_len: int = 1024,
                  comm=None, remat: bool = False, num_experts: int = None,
                  moe_top_k: int = 2, moe_capacity_factor: float = 1.5,
-                 positions: str = "learned"):
+                 positions: str = "learned", tie_embeddings: bool = False):
         if positions not in ("learned", "rope"):
             raise ValueError(f"positions must be 'learned' or 'rope', got {positions!r}")
+        self.tie_embeddings = tie_embeddings
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.max_len = max_len
@@ -391,7 +392,8 @@ class TransformerLM(nn.Module):
             for _ in range(depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)
-        self.head = nn.Linear(embed_dim, vocab_size, bias=False)
+        if not tie_embeddings:
+            self.head = nn.Linear(embed_dim, vocab_size, bias=False)
 
     def init(self, key):
         import jax
@@ -403,11 +405,21 @@ class TransformerLM(nn.Module):
             "embed": jax.tree.map(lambda a: a * scale, self.embed.init(keys[0])),
             "blocks": [b.init(k) for b, k in zip(self.blocks, keys[2:])],
             "ln_f": self.ln_f.init(keys[-2]),
-            "head": self.head.init(keys[-1]),
         }
+        if not self.tie_embeddings:
+            out["head"] = self.head.init(keys[-1])
         if self.positions == "learned":
             out["pos"] = scale * jax.random.normal(keys[1], (self.max_len, self.embed_dim))
         return out
+
+    def _logits(self, params, h):
+        """LM head: the head module, or the TRANSPOSED token embedding
+        when ``tie_embeddings`` (GPT-2 style — one (V, E) matrix serves
+        both ends, and its gradient accumulates from both uses; the tied
+        matmul matches the bias-free head module's semantics)."""
+        if self.tie_embeddings:
+            return h @ params["embed"]["weight"].T
+        return self.head.apply(params["head"], h)
 
     def apply(self, params, tokens, *, train: bool = False, key=None):
         """Teacher-forced forward: tokens (B, S) int → logits (B, S, vocab)."""
@@ -424,7 +436,7 @@ class TransformerLM(nn.Module):
             if key is not None:
                 key, sub = jax.random.split(key)
             h = b.apply(p, h, train=train, key=sub)
-        return self.head.apply(params["head"], self.ln_f.apply(params["ln_f"], h))
+        return self._logits(params, self.ln_f.apply(params["ln_f"], h))
 
     def decode_step(self, params, tok, pos, caches):
         """Logits for one position given the caches: tok (B,) int at
@@ -443,7 +455,7 @@ class TransformerLM(nn.Module):
         for b, p, c in zip(self.blocks, params["blocks"], caches):
             h, c = b.decode_step(p, h, c)
             new.append(c)
-        logits = self.head.apply(params["head"], self.ln_f.apply(params["ln_f"], h))
+        logits = self._logits(params, self.ln_f.apply(params["ln_f"], h))
         return logits[:, 0, :], new
 
     def generate(self, params, prompt, max_new_tokens: int, *,

@@ -575,3 +575,55 @@ class TestRoPE:
             params = opt.step(params, g)
             losses.append(float(l))
         assert losses[-1] < losses[0]
+
+
+class TestTiedEmbeddings:
+    def test_tied_lm(self):
+        """tie_embeddings: one (V, E) matrix serves embedding AND head —
+        no head params, logits == h @ embed.T, grads accumulate from both
+        uses, and the decode contract still holds."""
+        import jax
+        import jax.numpy as jnp
+
+        lm = TransformerLM(vocab_size=23, embed_dim=16, num_heads=2, depth=2,
+                           max_len=32, tie_embeddings=True)
+        params = lm.init(jax.random.key(0))
+        assert "head" not in params
+        toks = jax.random.randint(jax.random.key(1), (2, 9), 0, 23)
+        full = lm.apply(params, toks)
+        assert full.shape == (2, 9, 23)
+        caches = [b.init_cache(2, 9) for b in lm.blocks]
+        for t in range(9):
+            lg, caches = lm.decode_step(params, toks[:, t], t, caches)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+        out = lm.generate(params, toks[:, :3], 4)
+        assert out.shape == (2, 7) and bool((out[:, :3] == toks[:, :3]).all())
+
+        # the tied matrix receives gradient from BOTH ends: it must differ
+        # from the embed-only gradient of an untied model with equal weights
+        untied = TransformerLM(vocab_size=23, embed_dim=16, num_heads=2,
+                               depth=2, max_len=32)
+        up = untied.init(jax.random.key(0))
+        up = {**up, "embed": params["embed"],
+              "head": {"weight": params["embed"]["weight"]},
+              "blocks": params["blocks"], "ln_f": params["ln_f"],
+              "pos": params["pos"]}
+        # identical weights (head := embed) -> identical logits
+        np.testing.assert_allclose(
+            np.asarray(untied.apply(up, toks)), np.asarray(full),
+            rtol=1e-5, atol=1e-6,
+        )
+
+        def loss(p, mod):
+            logits = mod.apply(p, toks[:, :-1])
+            return ht.nn.functional.cross_entropy(
+                logits.reshape(-1, 23), toks[:, 1:].reshape(-1))
+
+        g_tied = jax.grad(lambda p: loss(p, lm))(params)["embed"]["weight"]
+        gu = jax.grad(lambda p: loss(p, untied))(up)
+        g_sum = gu["embed"]["weight"] + gu["head"]["weight"]
+        np.testing.assert_allclose(
+            np.asarray(g_tied), np.asarray(g_sum), rtol=1e-4, atol=1e-5
+        )
