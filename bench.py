@@ -576,6 +576,29 @@ def main(state: dict = None) -> dict:
             extra["lm_generate_error"] = str(e)[:120]
         snapshot()
 
+    # --- Switch-block throughput (round-4d MoE) --------------------------- #
+    # one Switch-transformer block forward (MoE FFN, top-2 of 32 experts)
+    # at (8, 2048, 1024) bf16 — tokens/s through routing + dispatch +
+    # expert GEMMs + combine, slope-timed like the attention rows
+    if not skip("moe_block", 0.1):
+        try:
+            import jax.numpy as jnp
+
+            from heat_tpu.nn.models import _TransformerBlock
+            from heat_tpu.nn.moe import MoE
+
+            blk = _TransformerBlock(1024, 8, mlp_ratio=4, causal=True,
+                                    ffn=MoE(1024, 32, hidden_dim=4096, top_k=2))
+            bp = blk.init(jax.random.key(3))
+            bp = jax.tree.map(lambda a: a.astype(jnp.bfloat16), bp)
+            xb = jax.random.normal(jax.random.key(4), (8, 2048, 1024), jnp.bfloat16)
+            per = _attn_slope(lambda q, k, v: blk.apply(bp, q), [xb, xb, xb], 1, 3)
+            extra["moe_switch_block_8x2048x1024_ms"] = round(per * 1e3, 2)
+            extra["moe_switch_block_tokens_per_s"] = round(8 * 2048 / per, 1)
+        except Exception as e:
+            extra["moe_block_error"] = str(e)[:120]
+        snapshot()
+
     # --- BASELINE config[2] scale: 1e8×32 with bf16 storage --------------- #
     # The f32 working set (12.8 GiB + temporaries) exceeds one v5e's HBM; the
     # bf16 layout (6.4 GiB) fits, keeps the E-step GEMM on the MXU's native

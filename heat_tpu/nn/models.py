@@ -354,8 +354,9 @@ class TransformerLM(nn.Module):
     """GPT-style causal language model: token embedding + positions
     (``positions='learned'`` table, the default, or ``'rope'`` rotary —
     no table; see :func:`heat_tpu.nn.apply_rope`) + causal transformer
-    blocks + final LayerNorm + untied LM head, with a compiled KV-cache
-    ``generate`` loop.
+    blocks + final LayerNorm + LM head (untied by default;
+    ``tie_embeddings=True`` shares the token-embedding matrix and drops
+    ``params['head']``), with a compiled KV-cache ``generate`` loop.
 
     Beyond-reference model family (same provenance note as
     :func:`transformer_encoder`), completing the inference half of the
