@@ -461,20 +461,23 @@ class TransformerLM(nn.Module):
 
     def generate(self, params, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int = None,
-                 top_p: float = None, key=None):
+                 top_p: float = None, eos_id: int = None, key=None):
         """Autoregressive continuation of ``prompt`` (B, S0) int tokens.
 
         ``temperature=0`` decodes greedily; otherwise softmax sampling at
         the given temperature (requires ``key``), optionally truncated to
         the ``top_k`` highest-probability tokens and/or the ``top_p``
-        nucleus (static — part of the compiled program).  The prompt is consumed
-        through the same cached step as generation — the whole thing is ONE
-        jitted ``lax.scan`` program, LRU-cached on the model instance and
-        keyed on (batch, total length, sampled?, top_k, top_p) — the
-        prompt length and temperature ride in as DYNAMIC arguments, so a
-        serving loop with naturally varying prompt lengths or temperatures
-        reuses one executable (truncation knobs are canonicalized so no-op
-        values never fork a duplicate program).
+        nucleus (static — part of the compiled program).  ``eos_id`` pins
+        a sequence to EOS once it emits it (prompt-phase EOS tokens never
+        stop a sequence).  The prompt is consumed through the same cached
+        step as generation — the whole thing is ONE jitted ``lax.scan``
+        program, LRU-cached on the model instance and keyed on (batch,
+        total length, sampled?, top_k, top_p, eos used?) — the prompt
+        length, temperature and eos VALUE ride in as DYNAMIC arguments,
+        so a serving loop with naturally varying prompt lengths,
+        temperatures or stop tokens reuses one executable (truncation
+        knobs are canonicalized so no-op values never fork a duplicate
+        program).
         Returns (B, S0 + max_new_tokens) tokens beginning with the prompt.
         """
         import functools
@@ -493,10 +496,13 @@ class TransformerLM(nn.Module):
                 f"prompt + max_new_tokens = {total} exceeds max_len {self.max_len}"
             )
         top_k, top_p = _normalize_truncation(top_k, top_p, self.vocab_size, sampled)
-        fn = _gen_program(self, (B, total, sampled, top_k, top_p), lambda: jax.jit(
-            functools.partial(self._generate_scan, total=total, sampled=sampled,
-                              top_k=top_k, top_p=top_p)
-        ))
+        has_eos = eos_id is not None
+        if has_eos and not 0 <= int(eos_id) < self.vocab_size:
+            raise ValueError(f"eos_id {eos_id} outside vocab [0, {self.vocab_size})")
+        fn = _gen_program(self, (B, total, sampled, top_k, top_p, has_eos),
+                          lambda: jax.jit(functools.partial(
+                              self._generate_scan, total=total, sampled=sampled,
+                              top_k=top_k, top_p=top_p, has_eos=has_eos)))
         ys0 = jnp.concatenate(
             [prompt.astype(jnp.int32), jnp.zeros((B, n_new), jnp.int32)], axis=1
         )
@@ -505,11 +511,12 @@ class TransformerLM(nn.Module):
             ys0,
             jnp.asarray(S0, jnp.int32),
             jnp.asarray(temperature if sampled else 1.0, jnp.float32),
+            jnp.asarray(eos_id if has_eos else -1, jnp.int32),
             key if key is not None else jax.random.key(0),
         )
 
-    def _generate_scan(self, params, ys, S0, temp, key, *, total, sampled,
-                       top_k=None, top_p=None):
+    def _generate_scan(self, params, ys, S0, temp, eos, key, *, total, sampled,
+                       top_k=None, top_p=None, has_eos=False):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -521,17 +528,26 @@ class TransformerLM(nn.Module):
         caches = [b.init_cache(B, total, dt) for b in self.blocks]
 
         def step(carry, t):
-            ys, caches, k = carry
+            ys, caches, done, k = carry
             logits, caches = self.decode_step(params, ys[:, t], t, caches)
             nxt, k = _next_token(logits, sampled, temp, k, top_k, top_p)
             # prompt positions keep their given token; generation begins
             # at index S0 (fed by the prediction from position S0-1)
+            gen = t + 1 >= S0
             cur = lax.dynamic_slice_in_dim(ys, t + 1, 1, axis=1)[:, 0]
-            nxt = jnp.where(t + 1 < S0, cur, nxt)
+            nxt = jnp.where(gen, nxt, cur)
+            if has_eos:
+                # finished sequences stay pinned to EOS; prompt-phase EOS
+                # tokens never mark a sequence finished
+                nxt = jnp.where(done, eos, nxt)
+                done = done | (gen & (nxt == eos))
             ys = lax.dynamic_update_slice_in_dim(ys, nxt[:, None], t + 1, axis=1)
-            return (ys, caches, k), None
+            return (ys, caches, done, k), None
 
-        (ys, _, _), _ = lax.scan(step, (ys, caches, key), jnp.arange(total - 1))
+        done0 = jnp.zeros((B,), bool)
+        (ys, _, _, _), _ = lax.scan(
+            step, (ys, caches, done0, key), jnp.arange(total - 1)
+        )
         return ys
 
 
@@ -787,9 +803,12 @@ class Seq2SeqTransformer(nn.Module):
 
     def generate(self, params, src, max_new_tokens: int, *, bos_id: int = 0,
                  temperature: float = 0.0, top_k: int = None,
-                 top_p: float = None, key=None):
+                 top_p: float = None, eos_id: int = None, key=None):
         """Autoregressively decode a target sequence for ``src`` (B, S_enc)
         starting from ``bos_id``: encode once, then one fused scan.
+        ``temperature``/``top_k``/``top_p``/``eos_id`` behave exactly as in
+        :meth:`TransformerLM.generate` (EOS pins finished sequences; its
+        value is dynamic, truncation knobs are static and canonicalized).
         Returns (B, 1 + max_new_tokens) target tokens beginning with BOS.
         """
         import functools
@@ -805,15 +824,19 @@ class Seq2SeqTransformer(nn.Module):
         if 1 + n_new > self.max_len:
             raise ValueError(f"1 + max_new_tokens = {1 + n_new} exceeds max_len {self.max_len}")
         top_k, top_p = _normalize_truncation(top_k, top_p, self.tgt_vocab, sampled)
-        fn = _gen_program(self, (B, src.shape[1], n_new, sampled, top_k, top_p),
+        has_eos = eos_id is not None
+        if has_eos and not 0 <= int(eos_id) < self.tgt_vocab:
+            raise ValueError(f"eos_id {eos_id} outside vocab [0, {self.tgt_vocab})")
+        fn = _gen_program(self, (B, src.shape[1], n_new, sampled, top_k, top_p, has_eos),
                           lambda: jax.jit(functools.partial(
                               self._generate_scan, n_new=n_new, sampled=sampled,
-                              top_k=top_k, top_p=top_p)))
+                              top_k=top_k, top_p=top_p, has_eos=has_eos)))
         return fn(
             params,
             src,
             jnp.asarray(bos_id, jnp.int32),
             jnp.asarray(temperature if sampled else 1.0, jnp.float32),
+            jnp.asarray(eos_id if has_eos else -1, jnp.int32),
             key if key is not None else jax.random.key(0),
         )
 
@@ -838,8 +861,8 @@ class Seq2SeqTransformer(nn.Module):
             states.append(st)
         return states
 
-    def _generate_scan(self, params, src, bos, temp, key, *, n_new, sampled,
-                       top_k=None, top_p=None):
+    def _generate_scan(self, params, src, bos, temp, eos, key, *, n_new, sampled,
+                       top_k=None, top_p=None, has_eos=False):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -853,13 +876,19 @@ class Seq2SeqTransformer(nn.Module):
         )
 
         def step(carry, t):
-            ys, states, k = carry
+            ys, states, done, k = carry
             logits, states = self.decode_step(params, ys[:, t], t, states)
             nxt, k = _next_token(logits, sampled, temp, k, top_k, top_p)
+            if has_eos:
+                nxt = jnp.where(done, eos, nxt)
+                done = done | (nxt == eos)
             ys = lax.dynamic_update_slice_in_dim(ys, nxt[:, None], t + 1, axis=1)
-            return (ys, states, k), None
+            return (ys, states, done, k), None
 
-        (ys, _, _), _ = lax.scan(step, (ys, states, key), jnp.arange(total - 1))
+        done0 = jnp.zeros((B,), bool)
+        (ys, _, _, _), _ = lax.scan(
+            step, (ys, states, done0, key), jnp.arange(total - 1)
+        )
         return ys
 
     # ------------------------------------------------------------------ #

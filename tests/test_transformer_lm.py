@@ -627,3 +627,66 @@ class TestTiedEmbeddings:
         np.testing.assert_allclose(
             np.asarray(g_tied), np.asarray(g_sum), rtol=1e-4, atol=1e-5
         )
+
+
+class TestEosStopping:
+    def test_lm_eos_pins_sequence(self):
+        """Once a sequence emits eos_id its remaining positions are pinned
+        to EOS; prompt-phase EOS tokens never mark a sequence finished."""
+        import jax
+        import jax.numpy as jnp
+
+        lm, params = _lm()
+        # prompt CONTAINS the eos token: must not stop generation
+        prompt = jnp.asarray([[5, 7, 5, 9], [1, 2, 3, 4]], jnp.int32)
+        out = lm.generate(params, prompt, 8, eos_id=5)
+        out = np.asarray(out)
+        assert (out[:, :4] == np.asarray(prompt)).all()
+        # naive oracle: greedy with manual stop
+        cur = prompt
+        for _ in range(8):
+            nxt = jnp.argmax(lm.apply(params, cur)[:, -1, :], axis=-1)
+            cur = jnp.concatenate([cur, nxt[:, None].astype(jnp.int32)], axis=1)
+        naive = np.asarray(cur)
+        for b in range(2):
+            row, want = out[b], naive[b]
+            hits = np.where(want[4:] == 5)[0]
+            stop = 4 + (hits[0] if len(hits) else 99)
+            np.testing.assert_array_equal(row[: min(stop + 1, 12)],
+                                          want[: min(stop + 1, 12)])
+            if stop + 1 < 12:
+                assert (row[stop + 1:] == 5).all()
+
+    def test_eos_program_key_and_dynamism(self):
+        """has_eos is static (separate program); the eos VALUE is dynamic
+        (sweeping it reuses the executable)."""
+        import jax
+
+        lm, params = _lm()
+        prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 31)
+        lm.generate(params, prompt, 3)
+        n0 = len(lm._gen_programs)
+        lm.generate(params, prompt, 3, eos_id=7)
+        assert len(lm._gen_programs) == n0 + 1
+        lm.generate(params, prompt, 3, eos_id=9)  # different value, same program
+        assert len(lm._gen_programs) == n0 + 1
+
+    def test_seq2seq_eos(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.models import Seq2SeqTransformer
+
+        m = Seq2SeqTransformer(src_vocab=11, tgt_vocab=7, embed_dim=16,
+                               num_heads=2, enc_depth=1, dec_depth=1, max_len=16)
+        params = m.init(jax.random.key(0))
+        src = jax.random.randint(jax.random.key(1), (3, 5), 0, 11)
+        out = np.asarray(m.generate(params, src, 8, bos_id=1, eos_id=2))
+        naive = np.asarray(m.generate(params, src, 8, bos_id=1))
+        for b in range(3):
+            hits = np.where(naive[b, 1:] == 2)[0]
+            stop = 1 + (hits[0] if len(hits) else 99)
+            np.testing.assert_array_equal(out[b, : min(stop + 1, 9)],
+                                          naive[b, : min(stop + 1, 9)])
+            if stop + 1 < 9:
+                assert (out[b, stop + 1:] == 2).all()
