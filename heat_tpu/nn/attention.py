@@ -5,11 +5,14 @@ becomes its sequence-parallel execution path instead of a free-floating
 demo).
 
 ``MultiheadAttention`` follows torch's packed-projection parameter layout
-(``in_proj_weight`` (3E, E), ``out_proj``), so state dicts round-trip, and
+(``in_proj_weight`` (3E, E), ``out_proj``) so state dicts round-trip, and
 adds ``comm=`` — with a communicator the sequence axis is sharded over the
 mesh and scores accumulate flash-style while K/V rotate on the ICI ring,
 so context length scales with the chip count (any length: the ring pads
-and masks ragged sequences).
+and masks ragged sequences).  With ``num_kv_heads < num_heads``
+(grouped-query attention, beyond torch's module) the packed projection
+shrinks to (E + 2·num_kv_heads·head_dim, E) rows — torch state dicts then
+no longer round-trip, by construction.
 """
 
 from __future__ import annotations
@@ -74,6 +77,7 @@ class MultiheadAttention(Module):
         comm=None,
         rope: bool = False,
         rope_base: float = 10000.0,
+        num_kv_heads: int = None,
     ):
         if embed_dim % num_heads:
             raise ValueError(f"embed_dim {embed_dim} not divisible by num_heads {num_heads}")
@@ -81,9 +85,17 @@ class MultiheadAttention(Module):
             raise ValueError("only batch_first=True is supported (framework layout)")
         if rope and (embed_dim // num_heads) % 2:
             raise ValueError("rope requires an even head dim")
+        if num_kv_heads is None:
+            num_kv_heads = num_heads
+        if num_kv_heads < 1 or num_heads % num_kv_heads:
+            raise ValueError(
+                f"num_heads {num_heads} not divisible by num_kv_heads {num_kv_heads}"
+            )
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        self.num_kv_heads = num_kv_heads  # < num_heads = grouped-query attention
+        self.kv_dim = num_kv_heads * self.head_dim
         self.bias = bias
         self.comm = comm
         self.rope = rope  # rotary positions on SELF-attention q/k (not cross)
@@ -92,10 +104,13 @@ class MultiheadAttention(Module):
     def init(self, key):
         k1, k2 = jax.random.split(key)
         E = self.embed_dim
-        # torch init: xavier_uniform over the packed (3E, E) projection
-        bound = (6.0 / (3 * E + E)) ** 0.5
+        # torch init: xavier_uniform over the packed projection (rows
+        # E + 2*kv_dim — equals (3E, E) when num_kv_heads == num_heads,
+        # keeping torch state-dict round-trip in the non-GQA case)
+        rows = E + 2 * self.kv_dim
+        bound = (6.0 / (rows + E)) ** 0.5
         p = {
-            "in_proj_weight": jax.random.uniform(k1, (3 * E, E), minval=-bound, maxval=bound),
+            "in_proj_weight": jax.random.uniform(k1, (rows, E), minval=-bound, maxval=bound),
             "out_proj": {
                 "weight": jax.random.uniform(
                     k2, (E, E), minval=-(1.0 / E**0.5), maxval=1.0 / E**0.5
@@ -103,13 +118,23 @@ class MultiheadAttention(Module):
             },
         }
         if self.bias:
-            p["in_proj_bias"] = jnp.zeros((3 * E,))
+            p["in_proj_bias"] = jnp.zeros((rows,))
             p["out_proj"]["bias"] = jnp.zeros((E,))
         return p
 
-    def _heads(self, t):
+    def _heads(self, t, n_heads: int = None):
         B, S, _ = t.shape
-        return t.reshape(B, S, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        n = n_heads or self.num_heads
+        return t.reshape(B, S, n, self.head_dim).transpose(0, 2, 1, 3)
+
+    def _repeat_kv(self, kh, vh):
+        """Broadcast grouped K/V heads to the full head count for paths
+        that need equal heads (ring, masks, dense cross) — the flash GQA
+        kernel and the grouped decode tail avoid this copy."""
+        if self.num_kv_heads == self.num_heads:
+            return kh, vh
+        g = self.num_heads // self.num_kv_heads
+        return jnp.repeat(kh, g, axis=1), jnp.repeat(vh, g, axis=1)
 
     def _masked_dense(self, qh, kh, vh, causal, key_padding_mask, attn_mask,
                       return_probs: bool = False):
@@ -146,7 +171,7 @@ class MultiheadAttention(Module):
         idiom: a fixed (B, H, max_len, d) buffer updated in place by
         ``dynamic_update_slice`` so the whole generation loop is one
         compiled ``lax.scan`` (no growing shapes, no retracing)."""
-        shape = (batch, self.num_heads, max_len, self.head_dim)
+        shape = (batch, self.num_kv_heads, max_len, self.head_dim)
         return {
             "k": jnp.zeros(shape, dtype),
             "v": jnp.zeros(shape, dtype),
@@ -176,8 +201,10 @@ class MultiheadAttention(Module):
         w = params["in_proj_weight"]
         b = params.get("in_proj_bias")
         proj = x @ w.T + (b if b is not None else 0.0)
-        q, k, v = jnp.split(proj, 3, axis=-1)
-        qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B,H,1,d)
+        q, k, v = jnp.split(proj, [E, E + self.kv_dim], axis=-1)
+        qh = self._heads(q)  # (B, H, 1, d)
+        kh = self._heads(k, self.num_kv_heads)
+        vh = self._heads(v, self.num_kv_heads)
         i = cache["index"]
         if self.rope:
             # rotate at THIS position; the cache stores post-rope keys, so
@@ -195,13 +222,15 @@ class MultiheadAttention(Module):
     def _project_kv(self, params, kv):
         """K/V head projection from the packed weight — the cross branch of
         :meth:`apply`, :meth:`precompute_kv` and :meth:`decode_step` share
-        this layout."""
-        E = self.embed_dim
+        this layout.  Returns ``num_kv_heads`` heads (== num_heads unless
+        grouped-query attention)."""
+        E, kvE = self.embed_dim, self.kv_dim
         w = params["in_proj_weight"]
         b = params.get("in_proj_bias")
-        k = kv @ w[E : 2 * E].T + (b[E : 2 * E] if b is not None else 0.0)
-        v = kv @ w[2 * E :].T + (b[2 * E :] if b is not None else 0.0)
-        return self._heads(k), self._heads(v)
+        k = kv @ w[E : E + kvE].T + (b[E : E + kvE] if b is not None else 0.0)
+        v = kv @ w[E + kvE :].T + (b[E + kvE :] if b is not None else 0.0)
+        n = self.num_kv_heads
+        return self._heads(k, n), self._heads(v, n)
 
     def _attend_merge_project(self, params, qh, kh, vh, dead_mask=None):
         """THE one-query decode tail: scaled scores (optionally masking
@@ -209,12 +238,18 @@ class MultiheadAttention(Module):
         output projection.  Shared by :meth:`decode_step` (masks unwritten
         cache slots) and :meth:`cross_step` (no mask) so the decode
         numerics can never drift between the two."""
-        s = jnp.einsum("bhqd,bhld->bhql", qh, kh) / (self.head_dim**0.5)
+        B, H = qh.shape[0], qh.shape[1]
+        # ONE grouped tail serves both cases: G = 1 when heads match, else
+        # each group of G query heads shares its K/V head (GQA)
+        G = H // kh.shape[1]
+        qg = qh.reshape(B, kh.shape[1], G, qh.shape[2], qh.shape[3])
+        sg = jnp.einsum("bkgqd,bkld->bkgql", qg, kh) / (self.head_dim**0.5)
         if dead_mask is not None:
-            s = jnp.where(dead_mask, s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhql,bhld->bhqd", p, vh)
-        B = out.shape[0]
+            sg = jnp.where(dead_mask, sg, -jnp.inf)
+        pg = jax.nn.softmax(sg, axis=-1)
+        out = jnp.einsum("bkgql,bkld->bkgqd", pg, vh).reshape(
+            B, H, qh.shape[2], qh.shape[3]
+        )
         merged = out.transpose(0, 2, 1, 3).reshape(B, 1, self.embed_dim)
         y = merged @ params["out_proj"]["weight"].T
         if self.bias:
@@ -279,8 +314,10 @@ class MultiheadAttention(Module):
         b = params.get("in_proj_bias")
         if kv is None:
             proj = x @ w.T + (b if b is not None else 0.0)
-            q, k, v = jnp.split(proj, 3, axis=-1)
-            qh, kh, vh = self._heads(q), self._heads(k), self._heads(v)  # (B, H, S, d)
+            q, k, v = jnp.split(proj, [E, E + self.kv_dim], axis=-1)
+            qh = self._heads(q)  # (B, H, S, d)
+            kh = self._heads(k, self.num_kv_heads)
+            vh = self._heads(v, self.num_kv_heads)
         else:
             q = x @ w[:E].T + (b[:E] if b is not None else 0.0)
             qh = self._heads(q)
@@ -294,25 +331,37 @@ class MultiheadAttention(Module):
         from ..parallel.ring_attention import _global_attention, ring_attention
 
         probs = None
+        gqa = self.num_kv_heads != self.num_heads
         if ring:
-            out = ring_attention(qh, kh, vh, self.comm, causal=causal)
+            # the ring rotates full-head K/V blocks — broadcast the groups
+            # (training-time copy; the GQA memory win is the DECODE cache)
+            out = ring_attention(qh, *self._repeat_kv(kh, vh), self.comm,
+                                 causal=causal)
         elif masked or need_weights:
             # need_weights forces the probability-returning dense path even
             # when the flash kernel would otherwise serve the call
             out = self._masked_dense(
-                qh, kh, vh, causal, key_padding_mask, attn_mask,
-                return_probs=need_weights,
+                qh, *self._repeat_kv(kh, vh), causal, key_padding_mask,
+                attn_mask, return_probs=need_weights,
             )
             if need_weights:
                 out, probs = out
-        elif qh.shape == kh.shape == vh.shape:
+        elif gqa and kv is None and qh.shape[-2] == kh.shape[-2]:
+            # grouped-query self-attention: the head-mapping flash kernel
+            # reads each group's K/V head from its index map — the
+            # H/H_kv-fold repeat never reaches HBM
+            from ..ops.flash_attention import flash_attention_gqa
+
+            out = flash_attention_gqa(qh, kh, vh, causal=causal)
+        elif not gqa and qh.shape == kh.shape == vh.shape:
             # local self-attention: flash-fused Pallas kernel on TPU (the
             # (S, S) score matrix never reaches HBM), dense-jnp elsewhere
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(qh, kh, vh, causal=causal)
         else:
-            out = _global_attention(qh, kh, vh, causal, 1.0 / (self.head_dim**0.5))
+            out = _global_attention(qh, *self._repeat_kv(kh, vh), causal,
+                                    1.0 / (self.head_dim**0.5))
         B, H, S, d = out.shape
         merged = out.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = merged @ params["out_proj"]["weight"].T

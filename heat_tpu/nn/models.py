@@ -158,11 +158,13 @@ class _TransformerBlock(nn.Module):
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  causal: bool = False, comm=None, remat: bool = False,
-                 ffn: nn.Module = None, rope: bool = False):
+                 ffn: nn.Module = None, rope: bool = False,
+                 num_kv_heads: int = None):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
-        self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm, rope=rope)
+        self.mha = MultiheadAttention(embed_dim, num_heads, comm=comm, rope=rope,
+                                      num_kv_heads=num_kv_heads)
         self.ln2 = nn.LayerNorm(embed_dim)
         self.ff = ffn if ffn is not None else _ffn(embed_dim, mlp_ratio)
         self.causal = causal
@@ -374,7 +376,8 @@ class TransformerLM(nn.Module):
                  depth: int = 4, mlp_ratio: int = 4, max_len: int = 1024,
                  comm=None, remat: bool = False, num_experts: int = None,
                  moe_top_k: int = 2, moe_capacity_factor: float = 1.5,
-                 positions: str = "learned", tie_embeddings: bool = False):
+                 positions: str = "learned", tie_embeddings: bool = False,
+                 num_kv_heads: int = None):
         if positions not in ("learned", "rope"):
             raise ValueError(f"positions must be 'learned' or 'rope', got {positions!r}")
         self.tie_embeddings = tie_embeddings
@@ -389,7 +392,8 @@ class TransformerLM(nn.Module):
         self.blocks = [
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=True,
                               comm=comm, remat=remat, ffn=moe_ffn,
-                              rope=(positions == "rope"))
+                              rope=(positions == "rope"),
+                              num_kv_heads=num_kv_heads)
             for _ in range(depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)

@@ -690,3 +690,94 @@ class TestEosStopping:
                                           naive[b, : min(stop + 1, 9)])
             if stop + 1 < 9:
                 assert (out[b, stop + 1:] == 2).all()
+
+
+class TestGQA:
+    def test_module_matches_repeated_heads(self):
+        """GQA module == a full-head module whose K/V weights repeat each
+        group's slice — the exact-equivalence oracle."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.attention import MultiheadAttention
+
+        B, S, E, H, Hkv = 2, 10, 16, 4, 2
+        d = E // H
+        gqa = MultiheadAttention(E, H, num_kv_heads=Hkv)
+        params = gqa.init(jax.random.key(0))
+        assert params["in_proj_weight"].shape == (E + 2 * Hkv * d, E)
+        x = jax.random.normal(jax.random.key(1), (B, S, E))
+        y = gqa.apply(params, x, causal=True)
+
+        full = MultiheadAttention(E, H)
+        w, b = params["in_proj_weight"], params["in_proj_bias"]
+
+        def rep(block):
+            return jnp.repeat(block.reshape(Hkv, d, E), H // Hkv, axis=0).reshape(H * d, E)
+
+        def repb(block):
+            return jnp.repeat(block.reshape(Hkv, d), H // Hkv, axis=0).reshape(H * d)
+
+        pfull = {
+            "in_proj_weight": jnp.concatenate(
+                [w[:E], rep(w[E : E + Hkv * d]), rep(w[E + Hkv * d :])], axis=0),
+            "in_proj_bias": jnp.concatenate(
+                [b[:E], repb(b[E : E + Hkv * d]), repb(b[E + Hkv * d :])]),
+            "out_proj": params["out_proj"],
+        }
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(full.apply(pfull, x, causal=True)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_decode_cache_is_grouped(self):
+        """The decode cache holds num_kv_heads heads (the GQA memory win)
+        and the cached decode still equals the full forward."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.attention import MultiheadAttention
+
+        B, S, E, H, Hkv = 2, 9, 16, 4, 1  # MQA extreme
+        mha = MultiheadAttention(E, H, num_kv_heads=Hkv)
+        params = mha.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (B, S, E))
+        y = mha.apply(params, x, causal=True)
+        cache = mha.init_cache(B, S)
+        assert cache["k"].shape[1] == Hkv
+        for t in range(S):
+            yt, cache = mha.decode_step(params, x[:, t : t + 1, :], cache)
+            np.testing.assert_allclose(
+                np.asarray(yt[:, 0]), np.asarray(y[:, t]), rtol=1e-4, atol=1e-5
+            )
+
+    def test_lm_with_gqa(self):
+        """num_kv_heads threads through the LM: halved caches, contracts
+        hold (decode == apply, greedy == naive), rope composes."""
+        import jax
+        import jax.numpy as jnp
+
+        lm = TransformerLM(vocab_size=19, embed_dim=16, num_heads=4, depth=2,
+                           max_len=32, num_kv_heads=2, positions="rope")
+        params = lm.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 19)
+        full = lm.apply(params, toks)
+        caches = [b.init_cache(2, 8) for b in lm.blocks]
+        assert caches[0]["k"].shape[1] == 2
+        for t in range(8):
+            lg, caches = lm.decode_step(params, toks[:, t], t, caches)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+        out = lm.generate(params, toks[:, :3], 4)
+        cur = toks[:, :3]
+        for _ in range(4):
+            nxt = jnp.argmax(lm.apply(params, cur)[:, -1, :], axis=-1)
+            cur = jnp.concatenate([cur, nxt[:, None].astype(jnp.int32)], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+    def test_validation(self):
+        from heat_tpu.nn.attention import MultiheadAttention
+
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            MultiheadAttention(16, 4, num_kv_heads=3)
