@@ -159,7 +159,7 @@ class _TransformerBlock(nn.Module):
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  causal: bool = False, comm=None, remat: bool = False,
                  ffn: nn.Module = None, rope: bool = False,
-                 num_kv_heads: int = None):
+                 num_kv_heads: int = None, dropout: float = 0.0):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
@@ -167,6 +167,9 @@ class _TransformerBlock(nn.Module):
                                       num_kv_heads=num_kv_heads)
         self.ln2 = nn.LayerNorm(embed_dim)
         self.ff = ffn if ffn is not None else _ffn(embed_dim, mlp_ratio)
+        # torch TransformerEncoderLayer's residual-branch dropout sites
+        # (after attention, after the FFN); 0 = disabled, eval = identity
+        self.drop = nn.Dropout(dropout)
         self.causal = causal
         self.remat = remat
         self._remat_fns = {}  # train -> jitted checkpointed block
@@ -181,14 +184,22 @@ class _TransformerBlock(nn.Module):
         }
 
     def _block(self, params, x, k1, k2, train):
-        h = x + self.mha.apply(
+        ka = kad = kf = kfd = None
+        if k1 is not None:
+            import jax
+
+            ka, kad = jax.random.split(k1)
+            kf, kfd = jax.random.split(k2)
+        a = self.mha.apply(
             params["mha"], self.ln1.apply(params["ln1"], x),
-            causal=self.causal, train=train, key=k1,
+            causal=self.causal, train=train, key=ka,
         )
-        return h + self.ff.apply(
+        h = x + self.drop.apply((), a, train=train, key=kad)
+        f = self.ff.apply(
             params["ff"], self.ln2.apply(params["ln2"], h),
-            train=train, key=k2,
+            train=train, key=kf,
         )
+        return h + self.drop.apply((), f, train=train, key=kfd)
 
     def apply(self, params, x, *, train: bool = False, key=None):
         k1 = k2 = None
@@ -246,6 +257,7 @@ def transformer_encoder(
     num_experts: int = None,
     moe_top_k: int = 2,
     moe_capacity_factor: float = 1.5,
+    dropout: float = 0.0,
 ) -> nn.Module:
     """A stack of pre-norm transformer blocks over (B, S, embed_dim) input.
 
@@ -271,7 +283,7 @@ def transformer_encoder(
                          moe_capacity_factor)
     return nn.Sequential(
         *[_TransformerBlock(embed_dim, num_heads, mlp_ratio, causal, comm,
-                            remat=remat, ffn=moe_ffn)
+                            remat=remat, ffn=moe_ffn, dropout=dropout)
           for _ in range(depth)]
     )
 
@@ -377,7 +389,7 @@ class TransformerLM(nn.Module):
                  comm=None, remat: bool = False, num_experts: int = None,
                  moe_top_k: int = 2, moe_capacity_factor: float = 1.5,
                  positions: str = "learned", tie_embeddings: bool = False,
-                 num_kv_heads: int = None):
+                 num_kv_heads: int = None, dropout: float = 0.0):
         if positions not in ("learned", "rope"):
             raise ValueError(f"positions must be 'learned' or 'rope', got {positions!r}")
         self.tie_embeddings = tie_embeddings
@@ -393,7 +405,7 @@ class TransformerLM(nn.Module):
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=True,
                               comm=comm, remat=remat, ffn=moe_ffn,
                               rope=(positions == "rope"),
-                              num_kv_heads=num_kv_heads)
+                              num_kv_heads=num_kv_heads, dropout=dropout)
             for _ in range(depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)
@@ -563,7 +575,8 @@ class _TransformerDecoderBlock(nn.Module):
     cross-attention against the (differently-sized) encoder memory."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
-                 comm=None, remat: bool = False, ffn: nn.Module = None):
+                 comm=None, remat: bool = False, ffn: nn.Module = None,
+                 dropout: float = 0.0):
         from .attention import MultiheadAttention
 
         self.ln1 = nn.LayerNorm(embed_dim)
@@ -572,6 +585,7 @@ class _TransformerDecoderBlock(nn.Module):
         self.cross_attn = MultiheadAttention(embed_dim, num_heads, comm=comm)
         self.ln3 = nn.LayerNorm(embed_dim)
         self.ff = ffn if ffn is not None else _ffn(embed_dim, mlp_ratio)
+        self.drop = nn.Dropout(dropout)  # torch residual-branch sites
         self.remat = remat
         self._remat_fns = {}
 
@@ -586,18 +600,27 @@ class _TransformerDecoderBlock(nn.Module):
         }
 
     def _block(self, params, x, memory, k1, k2, train):
-        h = x + self.self_attn.apply(
+        ka = kad = kcd = kf = kfd = None
+        if k1 is not None:
+            import jax
+
+            ka, kad, kcd = jax.random.split(k1, 3)
+            kf, kfd = jax.random.split(k2)
+        a = self.self_attn.apply(
             params["self_attn"], self.ln1.apply(params["ln1"], x),
-            causal=True, train=train, key=k1,
+            causal=True, train=train, key=ka,
         )
-        h = h + self.cross_attn.apply(
+        h = x + self.drop.apply((), a, train=train, key=kad)
+        c = self.cross_attn.apply(
             params["cross_attn"], self.ln2.apply(params["ln2"], h),
             kv=memory, train=train,
         )
-        return h + self.ff.apply(
+        h = h + self.drop.apply((), c, train=train, key=kcd)
+        f = self.ff.apply(
             params["ff"], self.ln3.apply(params["ln3"], h),
-            train=train, key=k2,
+            train=train, key=kf,
         )
+        return h + self.drop.apply((), f, train=train, key=kfd)
 
     def apply(self, params, x, memory, *, train: bool = False, key=None):
         k1 = k2 = None
@@ -674,6 +697,7 @@ def transformer_decoder(
     num_experts: int = None,
     moe_top_k: int = 2,
     moe_capacity_factor: float = 1.5,
+    dropout: float = 0.0,
 ) -> nn.Module:
     """A stack of pre-norm transformer DECODER blocks: causal
     self-attention + cross-attention against an encoder ``memory``.
@@ -694,7 +718,7 @@ def transformer_decoder(
                          moe_capacity_factor)
     return _TransformerDecoder([
         _TransformerDecoderBlock(embed_dim, num_heads, mlp_ratio, comm,
-                                 remat=remat, ffn=moe_ffn)
+                                 remat=remat, ffn=moe_ffn, dropout=dropout)
         for _ in range(depth)
     ])
 
@@ -718,7 +742,8 @@ class Seq2SeqTransformer(nn.Module):
                  num_heads: int = 8, enc_depth: int = 4, dec_depth: int = 4,
                  mlp_ratio: int = 4, max_len: int = 1024, comm=None,
                  remat: bool = False, num_experts: int = None,
-                 moe_top_k: int = 2, moe_capacity_factor: float = 1.5):
+                 moe_top_k: int = 2, moe_capacity_factor: float = 1.5,
+                 dropout: float = 0.0):
         self.src_vocab = src_vocab
         self.tgt_vocab = tgt_vocab
         self.embed_dim = embed_dim
@@ -731,12 +756,14 @@ class Seq2SeqTransformer(nn.Module):
                              comm, moe_capacity_factor)
         self.encoder = [
             _TransformerBlock(embed_dim, num_heads, mlp_ratio, causal=False,
-                              comm=comm, remat=remat, ffn=moe_ffn)
+                              comm=comm, remat=remat, ffn=moe_ffn,
+                              dropout=dropout)
             for _ in range(enc_depth)
         ]
         self.decoder = [
             _TransformerDecoderBlock(embed_dim, num_heads, mlp_ratio, comm,
-                                     remat=remat, ffn=moe_ffn)
+                                     remat=remat, ffn=moe_ffn,
+                                     dropout=dropout)
             for _ in range(dec_depth)
         ]
         self.ln_f = nn.LayerNorm(embed_dim)

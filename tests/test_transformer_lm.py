@@ -781,3 +781,87 @@ class TestGQA:
 
         with pytest.raises(ValueError, match="num_kv_heads"):
             MultiheadAttention(16, 4, num_kv_heads=3)
+
+
+class TestBlockDropout:
+    def test_dropout_semantics(self):
+        """dropout= in the blocks: eval (or no key) is deterministic and
+        equals the dropout-0 model; train with a key is stochastic."""
+        import jax
+
+        lm0 = TransformerLM(vocab_size=19, embed_dim=16, num_heads=2, depth=2,
+                            max_len=16)
+        lmd = TransformerLM(vocab_size=19, embed_dim=16, num_heads=2, depth=2,
+                            max_len=16, dropout=0.5)
+        params = lm0.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 19)
+        # eval: dropout is identity -> same logits as the dropout-0 model
+        np.testing.assert_allclose(
+            np.asarray(lmd.apply(params, toks)), np.asarray(lm0.apply(params, toks)),
+            rtol=1e-6, atol=1e-7,
+        )
+        # train with a key: stochastic, and different keys differ
+        a = lmd.apply(params, toks, train=True, key=jax.random.key(2))
+        b = lmd.apply(params, toks, train=True, key=jax.random.key(3))
+        base = lm0.apply(params, toks)
+        assert (np.asarray(a) != np.asarray(base)).any()
+        assert (np.asarray(a) != np.asarray(b)).any()
+        # same key: deterministic
+        a2 = lmd.apply(params, toks, train=True, key=jax.random.key(2))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a2), rtol=1e-6)
+        # decode path is eval-mode: contracts unaffected by the dropout knob
+        full = lmd.apply(params, toks)
+        caches = [b_.init_cache(2, 8) for b_ in lmd.blocks]
+        for t in range(8):
+            lg, caches = lmd.decode_step(params, toks[:, t], t, caches)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+
+    def test_training_with_dropout_reduces_loss(self):
+        import jax
+
+        lm = TransformerLM(vocab_size=19, embed_dim=16, num_heads=2, depth=2,
+                           max_len=16, dropout=0.1)
+        params = lm.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (4, 10), 0, 19)
+
+        def loss_fn(p, k):
+            logits = lm.apply(p, toks[:, :-1], train=True, key=k)
+            return ht.nn.functional.cross_entropy(
+                logits.reshape(-1, 19), toks[:, 1:].reshape(-1))
+
+        opt = ht.optim.DataParallelOptimizer("adam", lr=1e-2)
+        opt.init_state(params)
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+        key = jax.random.key(2)
+        losses = []
+        for _ in range(12):
+            key, sub = jax.random.split(key)
+            l, g = vg(params, sub)
+            params = opt.step(params, g)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_seq2seq_dropout(self):
+        """The decoder family gets the same knob: eval == dropout-0 model,
+        train+key stochastic."""
+        import jax
+
+        from heat_tpu.nn.models import Seq2SeqTransformer
+
+        m0 = Seq2SeqTransformer(src_vocab=11, tgt_vocab=9, embed_dim=16,
+                                num_heads=2, enc_depth=1, dec_depth=1, max_len=16)
+        md = Seq2SeqTransformer(src_vocab=11, tgt_vocab=9, embed_dim=16,
+                                num_heads=2, enc_depth=1, dec_depth=1, max_len=16,
+                                dropout=0.5)
+        params = m0.init(jax.random.key(0))
+        src = jax.random.randint(jax.random.key(1), (2, 5), 0, 11)
+        tgt = jax.random.randint(jax.random.key(2), (2, 6), 0, 9)
+        np.testing.assert_allclose(
+            np.asarray(md.apply(params, src, tgt)),
+            np.asarray(m0.apply(params, src, tgt)),
+            rtol=1e-6, atol=1e-7,
+        )
+        a = md.apply(params, src, tgt, train=True, key=jax.random.key(3))
+        assert (np.asarray(a) != np.asarray(m0.apply(params, src, tgt))).any()
