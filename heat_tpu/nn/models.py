@@ -288,6 +288,22 @@ def transformer_encoder(
     )
 
 
+def _sinusoidal_positions(positions, embed_dim: int):
+    """The original transformer's fixed sin/cos position code, computed on
+    the fly (no parameters, defined for ANY position — unlike a learned
+    table it never runs out).  ``positions`` broadcasts like in
+    :func:`heat_tpu.nn.apply_rope`: an arange for a sequence, a scalar for
+    one decode step."""
+    import jax.numpy as jnp
+
+    half = embed_dim // 2
+    div = 10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half)
+    ang = jnp.asarray(positions, jnp.float32)[..., None] / div  # (..., half)
+    return jnp.stack([jnp.sin(ang), jnp.cos(ang)], axis=-1).reshape(
+        *ang.shape[:-1], 2 * half
+    )
+
+
 def _gen_program(model, cache_key, build):
     """Per-instance LRU of compiled generation programs — ONE policy for
     every decoding model (LM and seq2seq): keyed on static shapes only,
@@ -366,8 +382,9 @@ def _next_token(logits, sampled, temp, k, top_k=None, top_p=None):
 
 class TransformerLM(nn.Module):
     """GPT-style causal language model: token embedding + positions
-    (``positions='learned'`` table, the default, or ``'rope'`` rotary —
-    no table; see :func:`heat_tpu.nn.apply_rope`) + causal transformer
+    (``positions='learned'`` table, the default; ``'rope'`` rotary — see
+    :func:`heat_tpu.nn.apply_rope`; or parameter-free ``'sinusoidal'``)
+    + causal transformer
     blocks + final LayerNorm + LM head (untied by default;
     ``tie_embeddings=True`` shares the token-embedding matrix and drops
     ``params['head']``), with a compiled KV-cache ``generate`` loop.
@@ -390,8 +407,12 @@ class TransformerLM(nn.Module):
                  moe_top_k: int = 2, moe_capacity_factor: float = 1.5,
                  positions: str = "learned", tie_embeddings: bool = False,
                  num_kv_heads: int = None, dropout: float = 0.0):
-        if positions not in ("learned", "rope"):
-            raise ValueError(f"positions must be 'learned' or 'rope', got {positions!r}")
+        if positions not in ("learned", "rope", "sinusoidal"):
+            raise ValueError(
+                f"positions must be 'learned', 'rope' or 'sinusoidal', got {positions!r}"
+            )
+        if positions == "sinusoidal" and embed_dim % 2:
+            raise ValueError("sinusoidal positions require an even embed_dim")
         self.tie_embeddings = tie_embeddings
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -448,6 +469,10 @@ class TransformerLM(nn.Module):
         h = self.embed.apply(params["embed"], tokens)
         if self.positions == "learned":
             h = h + params["pos"][:S]
+        elif self.positions == "sinusoidal":
+            import jax.numpy as jnp
+
+            h = h + _sinusoidal_positions(jnp.arange(S), self.embed_dim).astype(h.dtype)
         for b, p in zip(self.blocks, params["blocks"]):
             sub = None
             if key is not None:
@@ -460,14 +485,16 @@ class TransformerLM(nn.Module):
         position ``pos``.  Returns (logits (B, vocab), new_caches).
 
         Under ``positions='rope'`` the rotation position comes from the
-        CACHE index (which the caches advance themselves), so ``pos`` only
-        selects the learned-table row in ``'learned'`` mode — keep the two
-        in step by feeding positions 0,1,2,… from fresh caches (as
-        ``generate`` does); resuming mid-sequence needs caches whose index
-        already equals ``pos``."""
+        CACHE index (which the caches advance themselves); ``pos`` selects
+        the learned-table row or the sinusoidal code in the other modes —
+        keep them in step by feeding positions 0,1,2,… from fresh caches
+        (as ``generate`` does); resuming mid-sequence needs caches whose
+        index already equals ``pos``."""
         h = self.embed.apply(params["embed"], tok[:, None])
         if self.positions == "learned":
             h = h + params["pos"][pos]
+        elif self.positions == "sinusoidal":
+            h = h + _sinusoidal_positions(pos, self.embed_dim).astype(h.dtype)
         new = []
         for b, p, c in zip(self.blocks, params["blocks"], caches):
             h, c = b.decode_step(p, h, c)

@@ -545,7 +545,7 @@ class TestRoPE:
 
     def test_rope_validation(self):
         with pytest.raises(ValueError, match="positions"):
-            TransformerLM(vocab_size=8, positions="sinusoidal")
+            TransformerLM(vocab_size=8, positions="alibi")
         from heat_tpu.nn.attention import MultiheadAttention
 
         with pytest.raises(ValueError, match="even head dim"):
@@ -865,3 +865,44 @@ class TestBlockDropout:
         )
         a = md.apply(params, src, tgt, train=True, key=jax.random.key(3))
         assert (np.asarray(a) != np.asarray(m0.apply(params, src, tgt))).any()
+
+
+class TestSinusoidalPositions:
+    def test_table_matches_reference_formula(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.nn.models import _sinusoidal_positions
+
+        E, S = 8, 5
+        got = np.asarray(_sinusoidal_positions(jnp.arange(S), E))
+        want = np.zeros((S, E), np.float32)
+        for pos in range(S):
+            for i in range(E // 2):
+                a = pos / (10000 ** (i / (E // 2)))
+                want[pos, 2 * i] = np.sin(a)
+                want[pos, 2 * i + 1] = np.cos(a)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_lm_sinusoidal_contracts(self):
+        """No params table; decode == apply; greedy == naive."""
+        import jax
+        import jax.numpy as jnp
+
+        lm = TransformerLM(vocab_size=19, embed_dim=16, num_heads=2, depth=2,
+                           max_len=32, positions="sinusoidal")
+        params = lm.init(jax.random.key(0))
+        assert "pos" not in params
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 19)
+        full = lm.apply(params, toks)
+        caches = [b.init_cache(2, 8) for b in lm.blocks]
+        for t in range(8):
+            lg, caches = lm.decode_step(params, toks[:, t], t, caches)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t, :]), rtol=1e-4, atol=1e-5
+            )
+        out = lm.generate(params, toks[:, :3], 4)
+        cur = toks[:, :3]
+        for _ in range(4):
+            nxt = jnp.argmax(lm.apply(params, cur)[:, -1, :], axis=-1)
+            cur = jnp.concatenate([cur, nxt[:, None].astype(jnp.int32)], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
