@@ -26,14 +26,23 @@ from collections import OrderedDict
 __all__ = ["comm_cached"]
 
 
-def comm_cached(fn=None, *, maxsize: int = 32):
+def comm_cached(fn=None, *, maxsize: int = 32, key=None):
     """Memoize ``fn(comm, *args)`` on the comm instance, LRU-bounded.
 
     ``args`` must be hashable (static ints/strings/tuples — the same
-    contract ``lru_cache`` imposed).
+    contract ``lru_cache`` imposed).  ``key``, if given, maps ``*args`` to
+    the cache key instead of using the args themselves — layer-program
+    caches key on a *config tuple* (e.g. ``MoE._program_key``) so
+    identical-config layers share one executable and the table *key* never
+    pins a layer.  Note the cached *value* may still close over the first
+    instance of each config (a bound method inside the compiled program) —
+    retention drops from every-instance to one representative per config,
+    LRU-bounded.  Without ``key``, object-valued args are retained until
+    eviction, acceptable only for long-lived objects (see
+    ``parallel.pipeline._pipeline_program``).
     """
     if fn is None:
-        return lambda f: comm_cached(f, maxsize=maxsize)
+        return lambda f: comm_cached(f, maxsize=maxsize, key=key)
 
     slot = f"{fn.__module__}.{fn.__qualname__}"
 
@@ -43,13 +52,14 @@ def comm_cached(fn=None, *, maxsize: int = 32):
         table = tables.get(slot)
         if table is None:
             table = tables[slot] = OrderedDict()
-        prog = table.get(args)
+        k = key(*args) if key is not None else args
+        prog = table.get(k)
         if prog is None:
-            prog = table[args] = fn(comm, *args)
+            prog = table[k] = fn(comm, *args)
             if len(table) > maxsize:
                 table.popitem(last=False)
         else:
-            table.move_to_end(args)
+            table.move_to_end(k)
         return prog
 
     wrapper._cache_slot = slot  # introspection hook for tests

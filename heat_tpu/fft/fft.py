@@ -153,20 +153,60 @@ def irfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     return _fftn_op("irfft2", x, s=s, axes=axes, norm=norm)
 
 
-def hfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+def _hfftn_op(x: DNDarray, s, axes, norm, inverse: bool) -> DNDarray:
+    """Hermitian n-D transforms composed per axis (reference inherits them
+    whole from ``torch.fft.hfftn``/``ihfftn``; ``jnp.fft`` has only the 1-D
+    forms).  The transforms are separable, so the one-sided Hermitian axis
+    — the LAST of ``axes``, the torch convention — gets ``hfft``/``ihfft``
+    and every other axis gets a plain ``fft``/``ifft``; each 1-D transform
+    carries its own norm factor, so any ``norm`` composes exactly.  For
+    ``ihfftn`` the real input must hit ``ihfft`` first; for ``hfftn`` the
+    full-size axes are transformed first so the last axis stays one-sided
+    until the end.  Split handling matches ``_fftn_op``: resplit off a busy
+    split axis when a divisible axis can carry the shard, else direct."""
     sanitize_in(x)
-    if s is not None:
-        raise NotImplementedError("hfft2 with explicit shape not supported")
-    res = jnp.fft.hfft(jnp.fft.fft(_fft_in(x), axis=axes[0], norm=norm), axis=axes[1], norm=norm)
-    return _wrap(res, x.split, x)
+    nd = max(x.ndim, 1)
+    if axes is None:
+        axes = tuple(range(nd)) if s is None else tuple(range(nd - len(s), nd))
+    elif not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    axes = tuple(a % nd for a in axes)
+    if len(set(axes)) != len(axes):
+        # also catches hfft2 defaults (-2, -1) aliasing on a 1-D input —
+        # torch raises there too; a silent double transform would be wrong
+        raise ValueError(f"axes must be unique, got {axes} on a {nd}-D array")
+    if s is not None and len(s) != len(axes):
+        raise ValueError(f"s and axes must have the same length, got {len(s)} != {len(axes)}")
+    ss = list(s) if s is not None else [None] * len(axes)
+
+    def run(arr):
+        if inverse:
+            arr = jnp.fft.ihfft(arr, n=ss[-1], axis=axes[-1], norm=norm)
+            for a, n in zip(axes[:-1], ss[:-1]):
+                arr = jnp.fft.ifft(arr, n=n, axis=a, norm=norm)
+        else:
+            for a, n in zip(axes[:-1], ss[:-1]):
+                arr = jnp.fft.fft(arr, n=n, axis=a, norm=norm)
+            arr = jnp.fft.hfft(arr, n=ss[-1], axis=axes[-1], norm=norm)
+        return arr
+
+    t = _transpose_axis(x, set(axes))
+    if t is not None:
+        from ..core.manipulations import resplit
+
+        fft_paths["transpose"] += 1
+        xr = resplit(x, t)
+        return resplit(_wrap(run(xr._jarray), t, x), x.split)
+    fft_paths["direct"] += 1
+    return _wrap(run(_fft_in(x)), x.split, x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    return _hfftn_op(x, s, axes, norm, inverse=False)
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm=None) -> DNDarray:
-    sanitize_in(x)
-    if s is not None:
-        raise NotImplementedError("ihfft2 with explicit shape not supported")
-    res = jnp.fft.ifft(jnp.fft.ihfft(_fft_in(x), axis=axes[1], norm=norm), axis=axes[0], norm=norm)
-    return _wrap(res, x.split, x)
+    return _hfftn_op(x, s, axes, norm, inverse=True)
 
 
 def fftn(x, s=None, axes=None, norm=None) -> DNDarray:
@@ -186,11 +226,15 @@ def irfftn(x, s=None, axes=None, norm=None) -> DNDarray:
 
 
 def hfftn(x, s=None, axes=None, norm=None) -> DNDarray:
-    raise NotImplementedError("hfftn is not provided by jnp.fft; use hfft per-axis")
+    """n-D FFT of a Hermitian-symmetric (one-sided last axis) signal — real
+    output.  torch.fft.hfftn semantics (the reference's source for it);
+    composed per axis, see :func:`_hfftn_op`."""
+    return _hfftn_op(x, s, axes, norm, inverse=False)
 
 
 def ihfftn(x, s=None, axes=None, norm=None) -> DNDarray:
-    raise NotImplementedError("ihfftn is not provided by jnp.fft; use ihfft per-axis")
+    """Inverse of :func:`hfftn`: real input, one-sided complex output."""
+    return _hfftn_op(x, s, axes, norm, inverse=True)
 
 
 def fftfreq(n: int, d: float = 1.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:

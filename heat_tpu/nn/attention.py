@@ -187,15 +187,26 @@ class MultiheadAttention(Module):
 
         The caller owns the length budget: stepping past the cache's
         ``max_len`` would clamp the write onto the last slot (silent
-        corruption), so out-of-range indices raise when concrete; inside a
-        scan the index is traced and the LOOP bound must guarantee it
-        (``TransformerLM.generate`` sizes cache == loop length).
+        corruption), so out-of-range indices raise when concrete.  Inside
+        any user-written ``jit``/``scan`` the index is TRACED and this
+        guard cannot fire — the loop bound must guarantee the budget
+        (``TransformerLM.generate`` sizes cache == loop length; a hand
+        -rolled decode loop that overruns silently overwrites the last
+        slot).
         """
         E = self.embed_dim
         idx = cache["index"]
-        if not isinstance(idx, jax.core.Tracer) and int(idx) >= cache["k"].shape[2]:
+        # concreteness probe that survives JAX upgrades: int() raises the
+        # public Tracer*Error family on traced values (jax.core.Tracer is a
+        # deprecated access path)
+        try:
+            i = int(idx)
+        except (jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError, TypeError):
+            i = None
+        if i is not None and i >= cache["k"].shape[2]:
             raise ValueError(
-                f"decode_step past cache capacity: index {int(idx)} >= "
+                f"decode_step past cache capacity: index {i} >= "
                 f"max_len {cache['k'].shape[2]}"
             )
         w = params["in_proj_weight"]

@@ -361,20 +361,21 @@ def _next_token(logits, sampled, temp, k, top_k=None, top_p=None):
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
-        srt = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        # cut by sorted RANK, not by logit value: a value threshold drops
+        # in-nucleus tokens that happen to tie the largest cut logit
+        # (boundary ties would truncate more than the nucleus).  Slots whose
+        # mass STRICTLY before them already reaches p are cut — a suffix of
+        # the descending order; the top slot's preceding mass is 0, so it
+        # always survives (no degenerate all-masked row even for tiny p)
+        order = jnp.argsort(-logits, axis=-1)  # descending
+        srt = jnp.take_along_axis(logits, order, axis=-1)
         probs = jax.nn.softmax(srt, axis=-1)
-        # mass STRICTLY before each sorted slot; slots whose preceding mass
-        # already reaches p are cut (a suffix of the descending order) —
-        # the top token's preceding mass is 0, so it always survives.  The
-        # threshold is the LARGEST cut logit; everything above it is kept
         before = jnp.cumsum(probs, axis=-1) - probs
-        cutoff = jnp.max(
-            jnp.where(before >= top_p, srt, -jnp.inf), axis=-1, keepdims=True
-        )
-        # force-keep the argmax slot: ties straddling the nucleus boundary
-        # (or a tiny p) would otherwise mask EVERY token and categorical
-        # would degenerate to index 0
-        keep = (logits > cutoff) | (logits == logits.max(axis=-1, keepdims=True))
+        keep_sorted = before < top_p
+        # scatter the sorted-space mask back to vocab order: token v sits at
+        # sorted slot inv[v] = rank of v
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
     k, sub = jax.random.split(k)
     return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), k
@@ -954,8 +955,9 @@ class Seq2SeqTransformer(nn.Module):
     # ------------------------------------------------------------------ #
 
     def beam_search(self, params, src, max_new_tokens: int, *,
-                    beam_width: int = 4, bos_id: int = 0):
-        """Fixed-length beam search over the target vocabulary.
+                    beam_width: int = 4, bos_id: int = 0, eos_id: int = None,
+                    length_penalty: float = 0.0):
+        """Beam search over the target vocabulary.
 
         Keeps the ``beam_width`` highest-log-probability partial sequences
         at every step; the whole search is ONE jitted ``lax.scan`` — beams
@@ -963,9 +965,18 @@ class Seq2SeqTransformer(nn.Module):
         KV caches by a batched gather.  Returns the single best sequence
         per source, (B, 1 + max_new_tokens) starting with BOS.
 
-        Sequences are fixed-length (no EOS shortcut): scores compare
+        Without ``eos_id`` sequences are fixed-length: scores compare
         completions of identical length, so no length normalization is
-        needed.  ``beam_width=1`` is exactly greedy decoding (tested).
+        needed.  With ``eos_id``, a beam that emits EOS is *finished*: its
+        only continuation re-emits EOS at log-probability 0 (the cumulative
+        score freezes, and the tail is EOS-padded — the same padding
+        contract as :meth:`generate` with ``eos_id``), and its generated
+        length (counting the EOS token itself) is recorded.  Final ranking
+        divides each beam's score by ``length ** length_penalty``
+        (``length_penalty=0``, the default, ranks by raw score; larger
+        values favour longer completions, as in GNMT-style decoding).
+        ``beam_width=1`` is exactly greedy decoding, with or without EOS
+        (tested).
         """
         import functools
 
@@ -978,14 +989,27 @@ class Seq2SeqTransformer(nn.Module):
             raise ValueError(f"beam_width must be >= 1, got {W}")
         if 1 + n_new > self.max_len:
             raise ValueError(f"1 + max_new_tokens = {1 + n_new} exceeds max_len {self.max_len}")
-        fn = _gen_program(self, ("beam", B, src.shape[1], n_new, W),
+        has_eos = eos_id is not None
+        if has_eos and not 0 <= int(eos_id) < self.tgt_vocab:
+            raise ValueError(f"eos_id {eos_id} outside vocab [0, {self.tgt_vocab})")
+        lp = float(length_penalty)
+        if lp != 0.0 and not has_eos:
+            raise ValueError("length_penalty requires eos_id (fixed-length "
+                             "beams all share one length)")
+        # length_penalty is a TRACED scalar (like the eos value): sweeping
+        # the GNMT alpha reuses one executable per (B, S, n_new, W, has_eos)
+        fn = _gen_program(self, ("beam", B, src.shape[1], n_new, W, has_eos),
                           lambda: jax.jit(functools.partial(
-                              self._beam_scan, n_new=n_new, W=W)))
+                              self._beam_scan, n_new=n_new, W=W,
+                              has_eos=has_eos)))
         import jax.numpy as jnp
 
-        return fn(params, src, jnp.asarray(bos_id, jnp.int32))
+        eos = jnp.asarray(-1 if eos_id is None else eos_id, jnp.int32)
+        return fn(params, src, jnp.asarray(bos_id, jnp.int32), eos,
+                  jnp.asarray(lp, jnp.float32))
 
-    def _beam_scan(self, params, src, bos, *, n_new, W):
+    def _beam_scan(self, params, src, bos, eos, length_penalty, *, n_new, W,
+                   has_eos=False):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -1001,6 +1025,8 @@ class Seq2SeqTransformer(nn.Module):
         # only beam 0 is live at the start, or the first expansion would
         # pick W copies of the same argmax token
         scores = jnp.where(jnp.arange(W) == 0, 0.0, -jnp.inf)[None, :].repeat(B, 0)
+        done = jnp.zeros((B, W), bool)
+        lengths = jnp.zeros((B, W), jnp.int32)
 
         def reorder(a, gather_idx):
             # beam-reorder the self-cache K/V (leading dim B*W); the scalar
@@ -1011,10 +1037,17 @@ class Seq2SeqTransformer(nn.Module):
             return a
 
         def step(carry, t):
-            ys, states, scores = carry
+            ys, states, scores, done, lengths = carry
             logits, states = self.decode_step(params, ys[:, t], t, states)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            cand = scores[:, :, None] + logp.reshape(B, W, V)  # (B, W, V)
+            logp = logp.reshape(B, W, V)
+            if has_eos:
+                # a finished beam's single legal continuation is EOS at
+                # log-prob 0: the beam survives top-k with a frozen score
+                # instead of forking into W phantom copies of itself
+                frozen = jnp.where(jnp.arange(V) == eos, 0.0, -jnp.inf)
+                logp = jnp.where(done[:, :, None], frozen[None, None, :], logp)
+            cand = scores[:, :, None] + logp  # (B, W, V)
             top_s, top_i = lax.top_k(cand.reshape(B, W * V), W)  # (B, W)
             beam_of = top_i // V
             tok = (top_i % V).astype(jnp.int32)
@@ -1023,13 +1056,26 @@ class Seq2SeqTransformer(nn.Module):
             ys = lax.dynamic_update_slice_in_dim(
                 ys, tok.reshape(-1)[:, None], t + 1, axis=1
             )
+            if has_eos:
+                done_g = jnp.take_along_axis(done, beam_of, axis=1)
+                len_g = jnp.take_along_axis(lengths, beam_of, axis=1)
+                lengths = jnp.where(done_g, len_g, len_g + 1)
+                done = done_g | (tok == eos)
             states = [
                 {**st, "self": jax.tree.map(lambda a: reorder(a, gather_idx),
                                             st["self"])}
                 for st in states
             ]
-            return (ys, states, top_s), None
+            return (ys, states, top_s, done, lengths), None
 
-        (ys, _, scores), _ = lax.scan(step, (ys, states, scores), jnp.arange(n_new))
-        best = jnp.argmax(scores, axis=1)  # (B,)
+        (ys, _, scores, done, lengths), _ = lax.scan(
+            step, (ys, states, scores, done, lengths), jnp.arange(n_new)
+        )
+        if has_eos:
+            # len**0.0 == 1.0 exactly, so applying the norm unconditionally
+            # keeps alpha a dynamic scalar without perturbing alpha=0 ranks
+            norm = jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+            best = jnp.argmax(scores / norm, axis=1)  # (B,)
+        else:
+            best = jnp.argmax(scores, axis=1)  # (B,)
         return ys.reshape(B, W, total)[jnp.arange(B), best]

@@ -30,11 +30,15 @@ from ..core._cache import comm_cached
 __all__ = ["MoE"]
 
 
-@comm_cached
+@comm_cached(key=lambda moe: moe._program_key)
 def _ep_program(comm, moe):
-    """Compiled expert-parallel forward, cached ON the comm (identity-keyed
-    on the layer instance — same convention as the other collective
-    pipelines; jit's own cache handles shape/dtype variation).
+    """Compiled expert-parallel forward, cached ON the comm, keyed on the
+    layer's *config tuple* (``MoE._program_key``) rather than its identity:
+    the trace of ``_ep_fn`` depends only on that config (+ the comm, which
+    owns the table), so identical-config layers share one executable and
+    per-instance retention shrinks to one config representative (the first
+    instance's bound method inside the compiled program; ADVICE r4).  jit's
+    own cache handles shape/dtype variation.
 
     Token sharding: over the expert axis itself by default; with
     ``moe.batch_axis`` set the tokens shard over BOTH axes jointly (dp x ep)
@@ -157,6 +161,13 @@ class MoE(Module):
         self.comm = comm
         self.batch_axis = batch_axis  # dp axis of a 2-D mesh (see _ep_program)
 
+    @property
+    def _program_key(self):
+        """Everything the ``_ep_fn`` trace depends on besides the comm and
+        input shapes — the ``_ep_program`` cache key (see its docstring)."""
+        return (type(self), self.embed_dim, self.num_experts, self.hidden_dim,
+                self.top_k, self.capacity_factor, self.batch_axis)
+
     def init(self, key):
         D, H, E = self.embed_dim, self.hidden_dim, self.num_experts
         kr, k1, k2 = jax.random.split(key, 3)
@@ -215,7 +226,11 @@ class MoE(Module):
         if self.num_experts % comm.size:
             warnings.warn(
                 f"MoE: num_experts={self.num_experts} not divisible by mesh size "
-                f"{comm.size}; running the dense (replicated-expert) path",
+                f"{comm.size}; running the dense (replicated-expert) path. "
+                "This changes ROUTING NUMERICS, not just speed: capacity is "
+                "budgeted over the global token pool instead of per source "
+                "shard, so drop decisions (and therefore outputs) can differ "
+                "from the expert-parallel path for the same config",
                 stacklevel=2,
             )
             return self._dense(params, x2d).reshape(orig_shape)

@@ -64,6 +64,18 @@ class Pipelined(Module):
         return h
 
     def apply(self, params, x, **kw):
+        if kw.get("train") or kw.get("key") is not None:
+            # the schedule does not thread per-microbatch RNG keys, so
+            # stochastic layers (dropout) run in their EVAL behavior —
+            # silently different regularization unless the user hears it
+            import warnings
+
+            warnings.warn(
+                "Pipelined.apply ignores train=/key=: per-microbatch RNG is "
+                "not threaded through the pipeline schedule, so stochastic "
+                "layers (e.g. dropout) run in their eval behavior",
+                stacklevel=2,
+            )
         comm = self.comm
         if comm is None or (comm.size == 1 and self.batch_axis is None):
             return self._stage(params, x)

