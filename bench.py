@@ -144,7 +144,14 @@ def _summa_vs_gspmd_cpu8(repo_root: str) -> dict:
     SUMMA ring vs GSPMD-partitioned matmul (SURVEY §7 hard part #4).  Run in
     a subprocess with the scrubbed CPU env (platform pinned BEFORE jax import,
     axon site injection stripped) so a wedged accelerator tunnel can never
-    hang the child at import time — the round-1 failure mode."""
+    hang the child at import time — the round-1 failure mode.
+
+    Round-5 methodology fix (VERDICT r4 weak #4): the two arms are timed
+    INTERLEAVED (min over alternating reps) instead of back-to-back
+    ``timeit_min`` blocks — r4d's one-shot 0.708 "SUMMA ahead at 2048" was
+    an ordering artifact of the sequential blocks.  Both shapes of the
+    measured crossover are recorded: 2048 (GSPMD side) and 4096 (SUMMA
+    side), matching the ``_SUMMA_DISPATCH`` table in linalg/basics.py."""
     import subprocess
     import sys
 
@@ -153,18 +160,24 @@ def _summa_vs_gspmd_cpu8(repo_root: str) -> dict:
     from __graft_entry__ import _scrubbed_cpu_env
 
     script = (
-        "import sys, os, json\n"
+        "import sys, os, json, time\n"
         "import jax\n"
         f"sys.path.insert(0, {repo_root!r})\n"
         "import heat_tpu as ht\n"
-        "n = 2048\n"
-        "a = ht.random.randn(n, n, split=0); b = ht.random.randn(n, n, split=0)\n"
-        "t = ht.utils.profiler.timeit_min\n"
-        "summa = t(lambda: ht.linalg.matmul_summa(a, b), reps=3)\n"
-        "gspmd = t(lambda: ht.matmul(a, b), reps=3)\n"
-        "print(json.dumps({'summa_2048_s0xs0_s': round(summa, 5),"
-        " 'gspmd_2048_s0xs0_s': round(gspmd, 5),"
-        " 'summa_over_gspmd': round(summa / gspmd, 3)}))\n"
+        "from heat_tpu.linalg.basics import matmul_summa\n"
+        "out = {}\n"
+        "for n, reps in ((2048, 4), (4096, 3)):\n"
+        "    a = ht.random.randn(n, n, split=0); b = ht.random.randn(n, n, split=0)\n"
+        "    ht.matmul(a, b, method='gspmd')._jarray.block_until_ready()\n"
+        "    matmul_summa(a, b)._jarray.block_until_ready()\n"
+        "    tg, ts = [], []\n"
+        "    for _ in range(reps):\n"
+        "        t0 = time.perf_counter(); ht.matmul(a, b, method='gspmd')._jarray.block_until_ready(); tg.append(time.perf_counter() - t0)\n"
+        "        t0 = time.perf_counter(); matmul_summa(a, b)._jarray.block_until_ready(); ts.append(time.perf_counter() - t0)\n"
+        "    out[f'summa_{n}_s0xs0_s'] = round(min(ts), 5)\n"
+        "    out[f'gspmd_{n}_s0xs0_s'] = round(min(tg), 5)\n"
+        "    out[f'summa_over_gspmd_{n}'] = round(min(ts) / min(tg), 3)\n"
+        "print(json.dumps(out))\n"
     )
     out = subprocess.run(
         [sys.executable, "-c", script],
@@ -205,7 +218,20 @@ def main(state: dict = None) -> dict:
         "device_kind": str(dk),
         "bf16_peak_tflops_per_chip": peak,
         "skipped": [],
+        # machine-readable capture manifest (VERDICT r4 item 1): a
+        # watchdog-cut payload shows exactly which rows landed vs were due
+        "rows_expected": [
+            "headline", "f32_default", "f32_highest", "m4096", "m8192",
+            "host_ratio", "summa_vs_gspmd", "kmeans", "qr_tsqr",
+            "kmeans_kernel_ab", "flash_attention_ab", "gqa_attention_ab",
+            "flash_attention_32k", "lm_generate", "moe_block",
+            "kmeans_1e8_bf16",
+        ],
+        "rows_captured": [],
     }
+
+    def captured(name: str):
+        extra["rows_captured"].append(name)
 
     N = 16384
     flops = 2.0 * N * N * N
@@ -241,6 +267,7 @@ def main(state: dict = None) -> dict:
 
             state["partial"] = copy.deepcopy(payload)
 
+    captured("headline")
     # headline is in: from here on a watchdog timeout emits the snapshot
     # (partial, flagged) instead of discarding the TPU datapoint
     snapshot()
@@ -254,24 +281,37 @@ def main(state: dict = None) -> dict:
         return False
 
     # --- f32 inputs, DEFAULT TPU matmul precision (bf16 MXU passes) ------- #
+    # SLOPE-TIMED from round 5 (VERDICT r4 weak #2): the r4b-vs-r4d 35.6 →
+    # 4.617 swing on this row was the naive chain/iters quotient absorbing a
+    # multi-second tunnel stall into 6 iterations; the slope cancels every
+    # per-call constant, so a degrading relay shows up as the explicit
+    # noise-dominated error instead of a silently wrong TFLOPS number.
     if not skip("f32_default", 0.45):
         try:
-            t_def = _gemm_seconds(ht, jax, N, ht.float32, iters=6)
+            r = _gemm_seconds_slope(ht, jax, N, ht.float32, 2, 8)
             extra["matmul_16384_f32_default_precision_tflops_per_chip"] = round(
-                flops / t_def / 1e12 / n_chips, 3
+                flops / r["per_gemm_s"] / 1e12 / n_chips, 3
             )
+            extra["f32_default_dispatch_overhead_s"] = round(r["const_overhead_s"], 4)
+            captured("f32_default")
         except Exception as e:
             extra["f32_default_error"] = str(e)[:80]
         snapshot()
 
     # --- TRUE f32: precision=HIGHEST (6-pass bf16 emulation) -------------- #
+    # The v5e has no native f32 MXU mode; HIGHEST is the honest f32 number
+    # and its arithmetic ceiling is bf16_peak/6 (the 6-pass decomposition).
+    # mfu_f32 is reported against that ceiling (doc/design.md "f32 on TPU").
     if not skip("f32_highest", 0.4):
         try:
             with jax.default_matmul_precision("highest"):
-                t_hi = _gemm_seconds(ht, jax, N, ht.float32, iters=4)
-            extra["matmul_16384_f32_highest_tflops_per_chip"] = round(
-                flops / t_hi / 1e12 / n_chips, 3
-            )
+                r = _gemm_seconds_slope(ht, jax, N, ht.float32, 2, 6)
+            v = flops / r["per_gemm_s"] / 1e12 / n_chips
+            extra["matmul_16384_f32_highest_tflops_per_chip"] = round(v, 3)
+            if peak:
+                extra["f32_ceiling_tflops_per_chip"] = round(peak / 6.0, 1)
+                extra["mfu_f32"] = round(v / (peak / 6.0), 4)
+            captured("f32_highest")
         except Exception as e:
             extra["f32_highest_error"] = str(e)[:80]
         snapshot()
@@ -291,6 +331,7 @@ def main(state: dict = None) -> dict:
                 f / r["naive_per_gemm_s"] / 1e12 / n_chips, 3
             )
             extra[f"matmul_{nn}_dispatch_overhead_s"] = round(r["const_overhead_s"], 4)
+            captured(f"m{nn}")
         except Exception as e:
             extra[f"m{nn}_error"] = str(e)[:80]
         snapshot()
@@ -317,6 +358,7 @@ def main(state: dict = None) -> dict:
             "on this host; context only — NOT a HeAT-CUDA comparison (no "
             "reference numbers exist in this environment, see BASELINE.md)"
         )
+        captured("host_ratio")
     except Exception as e:
         extra["host_ratio_error"] = f"torch-CPU reference unavailable: {e}"[:120]
 
@@ -328,6 +370,8 @@ def main(state: dict = None) -> dict:
         try:
             repo_root = os.path.dirname(os.path.abspath(__file__))
             extra["summa_vs_gspmd_cpu8dev"] = _summa_vs_gspmd_cpu8(repo_root)
+            if "error" not in extra["summa_vs_gspmd_cpu8dev"]:
+                captured("summa_vs_gspmd")
         except Exception as e:
             extra["summa_vs_gspmd_cpu8dev"] = {"error": str(e)[:120]}
         snapshot()
@@ -363,6 +407,7 @@ def main(state: dict = None) -> dict:
             extra["kmeans_data_gib"] = round(n_rows * 32 * 4 / 2**30, 2)
             extra[f"kmeans_{n_rows}_x32_k64_iter_per_s"] = round(1.0 / t_km, 3)
             largest = log2n
+            captured("kmeans")
             break
         except Exception as e:
             extra[f"kmeans_2e{log2n}_error"] = str(e)[:80]
@@ -403,6 +448,7 @@ def main(state: dict = None) -> dict:
                     2.0 * 1_000_000 * 256**2 / dt / 1e9, 1
                 )
             del A, rf
+            captured("qr_tsqr")
         except Exception as e:
             extra["qr_tsqr_error"] = str(e)[:100]
         snapshot()
@@ -419,6 +465,7 @@ def main(state: dict = None) -> dict:
             extra[f"kmeans_{n_ab}_x32_k64_kernel_pallas_iter_per_s"] = round(1.0 / t_on, 3)
             extra[f"kmeans_{n_ab}_x32_k64_kernel_jnp_iter_per_s"] = round(1.0 / t_off, 3)
             extra["kmeans_kernel_speedup"] = round(t_off / t_on, 3)
+            captured("kmeans_kernel_ab")
         except Exception as e:
             extra["kmeans_kernel_ab_error"] = str(e)[:120]
         snapshot()
@@ -479,6 +526,7 @@ def main(state: dict = None) -> dict:
             extra["attn_4x8x4096x64_causal_flash_ms"] = round(t_flash * 1e3, 3)
             extra["attn_4x8x4096x64_causal_dense_ms"] = round(t_dense * 1e3, 3)
             extra["flash_attention_speedup"] = round(t_dense / t_flash, 3)
+            captured("flash_attention_ab")
         except Exception as e:
             extra["flash_attention_ab_error"] = str(e)[:120]
         snapshot()
@@ -516,6 +564,7 @@ def main(state: dict = None) -> dict:
             extra["gqa_4x8over2x4096x64_kernel_ms"] = round(t_gqa * 1e3, 3)
             extra["gqa_4x8over2x4096x64_dense_repeat_ms"] = round(t_rep * 1e3, 3)
             extra["gqa_kernel_speedup"] = round(t_rep / t_gqa, 3)
+            captured("gqa_attention_ab")
         except Exception as e:
             extra["gqa_attention_ab_error"] = str(e)[:120]
         snapshot()
@@ -542,6 +591,7 @@ def main(state: dict = None) -> dict:
             fl = 2 * 2 * B2 * H * S2 * S2 * d / 2  # causal
             extra["attn_2x8x32768x64_causal_flash_ms"] = round(per * 1e3, 2)
             extra["attn_32k_flash_tflops"] = round(fl / per / 1e12, 2)
+            captured("flash_attention_32k")
         except Exception as e:
             extra["flash_attention_32k_error"] = str(e)[:120]
         snapshot()
@@ -572,6 +622,7 @@ def main(state: dict = None) -> dict:
                 reps=2,
             )
             extra["lm_decode_b8_d8_e512_tok_per_s"] = round(8 * n_new / t, 1)
+            captured("lm_generate")
         except Exception as e:
             extra["lm_generate_error"] = str(e)[:120]
         snapshot()
@@ -595,6 +646,7 @@ def main(state: dict = None) -> dict:
             per = _attn_slope(lambda q, k, v: blk.apply(bp, q), [xb, xb, xb], 1, 3)
             extra["moe_switch_block_8x2048x1024_ms"] = round(per * 1e3, 2)
             extra["moe_switch_block_tokens_per_s"] = round(8 * 2048 / per, 1)
+            captured("moe_block")
         except Exception as e:
             extra["moe_block_error"] = str(e)[:120]
         snapshot()
@@ -610,6 +662,7 @@ def main(state: dict = None) -> dict:
             extra["kmeans_bf16_rows"] = n_rows
             extra["kmeans_bf16_data_gib"] = round(n_rows * 32 * 2 / 2**30, 2)
             extra["kmeans_1e8_x32_k64_bf16_iter_per_s"] = round(1.0 / t_km, 3)
+            captured("kmeans_1e8_bf16")
         except Exception as e:
             extra["kmeans_1e8_bf16_error"] = str(e)[:80]
 

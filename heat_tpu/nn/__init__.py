@@ -2,6 +2,9 @@
 
 from .modules import *
 from . import modules
+from .activations import *
+from .losses import *
+from . import activations, losses
 from .attention import MultiheadAttention, apply_rope
 from .moe import MoE
 from .pipelined import Pipelined

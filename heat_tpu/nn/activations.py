@@ -1,0 +1,187 @@
+"""Extended activation zoo mirroring ``torch.nn``'s activation modules.
+
+The reference's ``ht.nn`` resolves ALL of ``torch.nn`` dynamically
+(SURVEY §2.5 "nn module mirror"); the TPU-native equivalent enumerates the
+same constructor names as pure-functional modules over ``jax.nn`` /
+``jnp`` primitives — every one is elementwise (fused into neighbouring ops
+by XLA), matches torch's formulas and argument defaults, and is verified
+against the ``torch.nn`` oracle in ``tests/test_nn_activations.py``.
+``scripts/torch_coverage.py`` accounts for the full ``torch.nn`` surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .modules import Module, _Activation
+
+__all__ = [
+    "CELU", "ELU", "GLU", "Hardshrink", "Hardsigmoid", "Hardswish",
+    "Hardtanh", "LeakyReLU", "LogSigmoid", "Mish", "PReLU", "RReLU",
+    "ReLU6", "SELU", "SiLU", "Softmin", "Softplus", "Softshrink",
+    "Softsign", "Tanhshrink", "Threshold",
+]
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0):
+        self.alpha = alpha
+
+    def apply(self, params, x, **kw):
+        return jax.nn.elu(x, alpha=self.alpha)
+
+
+class CELU(Module):
+    def __init__(self, alpha: float = 1.0):
+        self.alpha = alpha
+
+    def apply(self, params, x, **kw):
+        return jax.nn.celu(x, alpha=self.alpha)
+
+
+class SELU(_Activation):
+    fn = staticmethod(jax.nn.selu)
+
+
+class SiLU(_Activation):
+    fn = staticmethod(jax.nn.silu)
+
+
+class Mish(_Activation):
+    # x * tanh(softplus(x)) — jax.nn.mish is absent in some versions
+    fn = staticmethod(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+class ReLU6(_Activation):
+    fn = staticmethod(lambda x: jnp.clip(x, 0.0, 6.0))
+
+
+class LeakyReLU(Module):
+    def __init__(self, negative_slope: float = 0.01):
+        self.negative_slope = negative_slope
+
+    def apply(self, params, x, **kw):
+        return jax.nn.leaky_relu(x, negative_slope=self.negative_slope)
+
+
+class LogSigmoid(_Activation):
+    fn = staticmethod(jax.nn.log_sigmoid)
+
+
+class Softplus(Module):
+    """torch formula incl. the linear-above-threshold shortcut (numerical
+    parity: torch returns x where beta*x > threshold)."""
+
+    def __init__(self, beta: float = 1.0, threshold: float = 20.0):
+        self.beta = beta
+        self.threshold = threshold
+
+    def apply(self, params, x, **kw):
+        return jnp.where(
+            self.beta * x > self.threshold, x,
+            jax.nn.softplus(self.beta * x) / self.beta,
+        )
+
+
+class Softsign(_Activation):
+    fn = staticmethod(jax.nn.soft_sign)
+
+
+class Tanhshrink(_Activation):
+    fn = staticmethod(lambda x: x - jnp.tanh(x))
+
+
+class Hardtanh(Module):
+    def __init__(self, min_val: float = -1.0, max_val: float = 1.0):
+        self.min_val = min_val
+        self.max_val = max_val
+
+    def apply(self, params, x, **kw):
+        return jnp.clip(x, self.min_val, self.max_val)
+
+
+class Hardswish(_Activation):
+    fn = staticmethod(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+
+
+class Hardsigmoid(_Activation):
+    fn = staticmethod(lambda x: jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+
+
+class Hardshrink(Module):
+    def __init__(self, lambd: float = 0.5):
+        self.lambd = lambd
+
+    def apply(self, params, x, **kw):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class Softshrink(Module):
+    def __init__(self, lambd: float = 0.5):
+        self.lambd = lambd
+
+    def apply(self, params, x, **kw):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lambd, 0.0)
+
+
+class Threshold(Module):
+    def __init__(self, threshold: float, value: float):
+        self.threshold = threshold
+        self.value = value
+
+    def apply(self, params, x, **kw):
+        return jnp.where(x > self.threshold, x, self.value)
+
+
+class GLU(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, **kw):
+        a, b = jnp.split(x, 2, axis=self.dim)
+        return a * jax.nn.sigmoid(b)
+
+
+class Softmin(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, **kw):
+        return jax.nn.softmax(-x, axis=self.dim)
+
+
+class PReLU(Module):
+    """Learned leaky slope (the one parametric activation in the zoo —
+    ``num_parameters`` is 1 or the channel count, broadcast on axis 1 for
+    >=2-D inputs exactly as torch does)."""
+
+    def __init__(self, num_parameters: int = 1, init: float = 0.25):
+        self.num_parameters = num_parameters
+        self._init_val = init  # 'init' the attr would shadow init() the method
+
+    def init(self, key):
+        return {"weight": jnp.full((self.num_parameters,), self._init_val)}
+
+    def apply(self, params, x, **kw):
+        a = params["weight"]
+        if x.ndim >= 2 and a.shape[0] > 1:
+            a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, a * x)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] per element in train
+    mode (requires ``key=``, like Dropout), fixed mean slope in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3):
+        self.lower = lower
+        self.upper = upper
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        if not train:
+            return jnp.where(x >= 0, x, 0.5 * (self.lower + self.upper) * x)
+        if key is None:
+            raise ValueError("RReLU in train mode requires a PRNG key")
+        slope = jax.random.uniform(key, x.shape, minval=self.lower, maxval=self.upper)
+        return jnp.where(x >= 0, x, slope * x)

@@ -9,9 +9,19 @@ from ..core.dndarray import DNDarray
 
 __all__ = [
     "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
-    "binary_cross_entropy", "relu", "softmax", "log_softmax",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "huber_loss", "smooth_l1_loss", "kl_div",
+    "relu", "softmax", "log_softmax",
     "scaled_dot_product_attention",
 ]
+
+
+def _reduce(v, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
 
 
 def _j(x):
@@ -71,6 +81,49 @@ def binary_cross_entropy(pred, target, reduction: str = "mean", eps: float = 1e-
     if reduction == "sum":
         return jnp.sum(b)
     return b
+
+
+def binary_cross_entropy_with_logits(logits, target, reduction: str = "mean"):
+    """Numerically-stable BCE on logits: max(z,0) - z*t + log1p(exp(-|z|))
+    (the torch formulation — no probability clipping needed)."""
+    z, t = _j(logits), _j(target)
+    b = jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return _reduce(b, reduction)
+
+
+def huber_loss(pred, target, reduction: str = "mean", delta: float = 1.0):
+    """Quadratic within ``delta``, linear outside (torch ``huber_loss``)."""
+    d = jnp.abs(_j(pred) - _j(target))
+    v = jnp.where(d <= delta, 0.5 * d**2, delta * (d - 0.5 * delta))
+    return _reduce(v, reduction)
+
+
+def smooth_l1_loss(pred, target, reduction: str = "mean", beta: float = 1.0):
+    """Huber scaled by 1/beta (torch ``smooth_l1_loss``; equals l1 at
+    beta -> 0, which torch special-cases — so do we)."""
+    d = jnp.abs(_j(pred) - _j(target))
+    if beta == 0.0:
+        return _reduce(d, reduction)
+    v = jnp.where(d < beta, 0.5 * d**2 / beta, d - 0.5 * beta)
+    return _reduce(v, reduction)
+
+
+def kl_div(log_pred, target, reduction: str = "mean", log_target: bool = False):
+    """Pointwise KL divergence, torch argument convention: ``log_pred`` is
+    log-probabilities; ``target`` is probabilities unless ``log_target``.
+    Note torch's ``reduction='mean'`` averages over ELEMENTS (and warns
+    that 'batchmean' is the mathematically-correct KL) — we mirror torch.
+    """
+    lp, t = _j(log_pred), _j(target)
+    if log_target:
+        v = jnp.exp(t) * (t - lp)
+    else:
+        # t*log(t) term: 0 where t == 0 (limit), avoiding nan from log(0)
+        tlogt = jnp.where(t > 0, t * jnp.log(jnp.where(t > 0, t, 1.0)), 0.0)
+        v = tlogt - t * lp
+    if reduction == "batchmean":
+        return jnp.sum(v) / lp.shape[0]
+    return _reduce(v, reduction)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -1,0 +1,105 @@
+"""Loss modules mirroring ``torch.nn``'s criterion classes.
+
+The reference inherits these from ``torch.nn`` wholesale (SURVEY §2.5);
+here each is a thin parameter-free :class:`~heat_tpu.nn.modules.Module`
+over the corresponding ``ht.nn.functional`` form, so the same object works
+as ``loss(params, pred, target)`` free function or inside a training step.
+Verified against the ``torch.nn`` oracle in ``tests/test_nn_activations.py``.
+"""
+
+from __future__ import annotations
+
+from .modules import Module
+from . import functional as F
+
+__all__ = [
+    "BCELoss", "BCEWithLogitsLoss", "CrossEntropyLoss", "HuberLoss",
+    "KLDivLoss", "L1Loss", "MSELoss", "NLLLoss", "SmoothL1Loss",
+]
+
+
+class _Loss(Module):
+    """Criterion base: ``reduction`` in {'mean', 'sum', 'none'} (torch
+    default 'mean'); ``apply(params, pred, target)`` — params unused, kept
+    for the Module calling convention."""
+
+    _reductions = ("mean", "sum", "none")
+
+    def __init__(self, reduction: str = "mean"):
+        if reduction not in self._reductions:
+            raise ValueError(f"unknown reduction {reduction!r}")
+        self.reduction = reduction
+
+    def _fn(self, pred, target):
+        raise NotImplementedError
+
+    def apply(self, params, pred, target=None, **kw):
+        return self._fn(pred, target)
+
+    def __call__(self, *args, **kw):
+        # criterion convenience: loss(pred, target) without params, the
+        # torch call shape — or the full Module form loss(params, pred, tgt).
+        # A target= kwarg disambiguates loss(params, pred, target=t), which
+        # also has two positionals but must route through apply
+        if len(args) == 2 and "target" not in kw:
+            return self._fn(*args)
+        return self.apply(*args, **kw)
+
+
+class MSELoss(_Loss):
+    def _fn(self, pred, target):
+        return F.mse_loss(pred, target, reduction=self.reduction)
+
+
+class L1Loss(_Loss):
+    def _fn(self, pred, target):
+        return F.l1_loss(pred, target, reduction=self.reduction)
+
+
+class CrossEntropyLoss(_Loss):
+    def _fn(self, pred, target):
+        return F.cross_entropy(pred, target, reduction=self.reduction)
+
+
+class NLLLoss(_Loss):
+    def _fn(self, pred, target):
+        return F.nll_loss(pred, target, reduction=self.reduction)
+
+
+class BCELoss(_Loss):
+    def _fn(self, pred, target):
+        return F.binary_cross_entropy(pred, target, reduction=self.reduction)
+
+
+class BCEWithLogitsLoss(_Loss):
+    def _fn(self, pred, target):
+        return F.binary_cross_entropy_with_logits(pred, target, reduction=self.reduction)
+
+
+class HuberLoss(_Loss):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0):
+        super().__init__(reduction)
+        self.delta = delta
+
+    def _fn(self, pred, target):
+        return F.huber_loss(pred, target, reduction=self.reduction, delta=self.delta)
+
+
+class SmoothL1Loss(_Loss):
+    def __init__(self, reduction: str = "mean", beta: float = 1.0):
+        super().__init__(reduction)
+        self.beta = beta
+
+    def _fn(self, pred, target):
+        return F.smooth_l1_loss(pred, target, reduction=self.reduction, beta=self.beta)
+
+
+class KLDivLoss(_Loss):
+    _reductions = ("mean", "sum", "none", "batchmean")  # torch: KL only
+
+    def __init__(self, reduction: str = "mean", log_target: bool = False):
+        super().__init__(reduction)
+        self.log_target = log_target
+
+    def _fn(self, pred, target):
+        return F.kl_div(pred, target, reduction=self.reduction, log_target=self.log_target)

@@ -26,7 +26,11 @@ __all__ = [
     "Softmax",
     "LogSoftmax",
     "Dropout",
+    "Dropout1d",
+    "Dropout2d",
+    "Dropout3d",
     "Flatten",
+    "Unflatten",
     "Sequential",
     "Conv2d",
     "MaxPool2d",
@@ -35,6 +39,7 @@ __all__ = [
     "BatchNorm1d",
     "BatchNorm2d",
     "LayerNorm",
+    "RMSNorm",
     "GroupNorm",
     "Embedding",
     "Residual",
@@ -120,8 +125,18 @@ class Sigmoid(_Activation):
     fn = staticmethod(jax.nn.sigmoid)
 
 
-class GELU(_Activation):
-    fn = staticmethod(jax.nn.gelu)
+class GELU(Module):
+    """torch parity: default is the EXACT erf form (``approximate='none'``);
+    ``jax.nn.gelu``'s default is the tanh approximation, so the flag maps
+    explicitly."""
+
+    def __init__(self, approximate: str = "none"):
+        if approximate not in ("none", "tanh"):
+            raise ValueError(f"approximate must be 'none' or 'tanh', got {approximate!r}")
+        self.approximate = approximate
+
+    def apply(self, params, x, **kw):
+        return jax.nn.gelu(x, approximate=self.approximate == "tanh")
 
 
 class Softmax(Module):
@@ -154,9 +169,57 @@ class Dropout(Module):
         return jnp.where(mask, x / keep, 0.0)
 
 
+class _ChannelDropout(Module):
+    """Zero whole channels (torch ``Dropout1d/2d/3d``): the mask covers
+    (N, C) and broadcasts over the trailing ``spatial`` dims."""
+
+    spatial: int = 0
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        if not train or self.p == 0.0:
+            return x
+        if key is None:
+            raise ValueError("channel dropout in train mode requires a PRNG key")
+        if x.ndim != self.spatial + 2:
+            raise ValueError(
+                f"expected a {self.spatial + 2}-D (N, C, ...) input, got {x.ndim}-D"
+            )
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(key, keep, x.shape[:2] + (1,) * self.spatial)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Dropout1d(_ChannelDropout):
+    spatial = 1
+
+
+class Dropout2d(_ChannelDropout):
+    spatial = 2
+
+
+class Dropout3d(_ChannelDropout):
+    spatial = 3
+
+
 class Flatten(Module):
     def apply(self, params, x, **kw):
         return x.reshape(x.shape[0], -1)
+
+
+class Unflatten(Module):
+    """Inverse of Flatten: expand ``dim`` into ``unflattened_size`` (torch
+    argument convention)."""
+
+    def __init__(self, dim: int, unflattened_size):
+        self.dim = dim
+        self.unflattened_size = tuple(unflattened_size)
+
+    def apply(self, params, x, **kw):
+        d = self.dim % x.ndim
+        return x.reshape(x.shape[:d] + self.unflattened_size + x.shape[d + 1:])
 
 
 class Conv2d(Module):
@@ -349,6 +412,34 @@ class LayerNorm(Module):
         y = (x - mean) / jnp.sqrt(var + self.eps)
         if self.affine:
             y = y * params["weight"] + params["bias"]
+        return y
+
+
+class RMSNorm(Module):
+    """Root-mean-square normalization over the trailing ``normalized_shape``
+    dims (torch ``nn.RMSNorm``; the LLM-standard LayerNorm variant — no
+    mean subtraction, no bias).  ``eps=None`` follows torch: the input
+    dtype's machine epsilon."""
+
+    def __init__(self, normalized_shape, eps: float | None = None,
+                 elementwise_affine: bool = True):
+        self.normalized_shape = (
+            (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+        )
+        self.eps = eps
+        self.affine = elementwise_affine
+
+    def init(self, key):
+        if self.affine:
+            return {"weight": jnp.ones(self.normalized_shape)}
+        return {}
+
+    def apply(self, params, x, **kw):
+        axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
+        eps = jnp.finfo(x.dtype).eps if self.eps is None else self.eps
+        y = x * jax.lax.rsqrt(jnp.mean(x * x, axis=axes, keepdims=True) + eps)
+        if self.affine:
+            y = y * params["weight"]
         return y
 
 

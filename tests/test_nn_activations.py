@@ -1,0 +1,231 @@
+"""Extended activation/loss/RMSNorm zoo vs the torch.nn oracle.
+
+The reference's ``ht.nn`` IS ``torch.nn`` (dynamic mirror, SURVEY §2.5), so
+torch itself is the ground truth for these modules' numerics; every module
+here is checked elementwise against its torch namesake on shared random
+inputs (VERDICT r4 missing #1 — surface breadth with accounting; see
+``scripts/torch_coverage.py``).
+"""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import heat_tpu as ht
+
+
+RNG = np.random.default_rng(42)
+X = (RNG.normal(size=(4, 10)) * 2.0).astype(np.float32)
+
+# (name, ht ctor args/kwargs, torch ctor args/kwargs) — defaults AND
+# non-default args, both sides constructed identically
+ACTS = [
+    ("ReLU", (), {}),
+    ("ELU", (), {}),
+    ("ELU", (0.7,), {}),
+    ("CELU", (0.7,), {}),
+    ("SELU", (), {}),
+    ("SiLU", (), {}),
+    ("Mish", (), {}),
+    ("ReLU6", (), {}),
+    ("LeakyReLU", (0.2,), {}),
+    ("LogSigmoid", (), {}),
+    ("Softplus", (), {}),
+    ("Softplus", (2.0, 1.5), {}),
+    ("Softsign", (), {}),
+    ("Tanhshrink", (), {}),
+    ("Hardtanh", (-2.0, 0.5), {}),
+    ("Hardswish", (), {}),
+    ("Hardsigmoid", (), {}),
+    ("Hardshrink", (0.3,), {}),
+    ("Softshrink", (0.3,), {}),
+    ("Threshold", (0.1, -7.0), {}),
+    ("GLU", (), {}),
+    ("Softmin", (), {"dim": -1}),
+    ("GELU", (), {}),
+    ("GELU", (), {"approximate": "tanh"}),
+    ("Sigmoid", (), {}),
+    ("Tanh", (), {}),
+]
+
+
+@pytest.mark.parametrize("name,args,kwargs", ACTS,
+                         ids=[f"{n}{a}" for n, a, _ in ACTS])
+def test_activation_matches_torch(name, args, kwargs):
+    import jax
+
+    m = getattr(ht.nn, name)(*args, **kwargs)
+    t = getattr(torch.nn, name)(*args, **kwargs)
+    p = m.init(jax.random.key(0))
+    got = np.asarray(m.apply(p, ht.array(X)._jarray))
+    want = t(torch.from_numpy(X)).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_prelu_matches_torch():
+    import jax
+
+    for n_param in (1, 10):
+        m = ht.nn.PReLU(n_param, init=0.1)
+        t = torch.nn.PReLU(n_param, init=0.1)
+        p = m.init(jax.random.key(0))
+        got = np.asarray(m.apply(p, ht.array(X)._jarray))
+        want = t(torch.from_numpy(X)).detach().numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    # channel-broadcast on a 4-D input (torch broadcasts on axis 1)
+    x4 = RNG.normal(size=(2, 6, 3, 3)).astype(np.float32)
+    m = ht.nn.PReLU(6, init=0.3)
+    t = torch.nn.PReLU(6, init=0.3)
+    got = np.asarray(m.apply(m.init(jax.random.key(0)), x4))
+    np.testing.assert_allclose(got, t(torch.from_numpy(x4)).detach().numpy(), atol=1e-6)
+
+
+def test_rrelu_contracts():
+    import jax
+
+    m = ht.nn.RReLU(0.1, 0.3)
+    # eval: fixed mean slope, matches torch eval mode
+    t = torch.nn.RReLU(0.1, 0.3).eval()
+    got = np.asarray(m.apply((), X))
+    np.testing.assert_allclose(got, t(torch.from_numpy(X)).numpy(), atol=1e-6)
+    # train: slopes land inside [lower, upper], key required
+    with pytest.raises(ValueError, match="PRNG key"):
+        m.apply((), X, train=True)
+    y = np.asarray(m.apply((), X, train=True, key=jax.random.key(1)))
+    neg = X < 0
+    ratio = y[neg] / X[neg]
+    assert (ratio >= 0.1 - 1e-6).all() and (ratio <= 0.3 + 1e-6).all()
+    assert (y[~neg] == X[~neg]).all()
+
+
+def test_rmsnorm_matches_torch():
+    import jax
+
+    for eps in (None, 1e-6):
+        m = ht.nn.RMSNorm(10, eps=eps)
+        t = torch.nn.RMSNorm(10, eps=eps)
+        got = np.asarray(m.apply(m.init(jax.random.key(0)), X))
+        np.testing.assert_allclose(got, t(torch.from_numpy(X)).detach().numpy(),
+                                   atol=2e-5)
+    # no-affine variant has no params
+    m = ht.nn.RMSNorm(10, elementwise_affine=False)
+    assert m.init(jax.random.key(0)) == {}
+
+
+LOSSES = [
+    ("MSELoss", {}, "real"),
+    ("L1Loss", {}, "real"),
+    ("HuberLoss", {"delta": 0.7}, "real"),
+    ("SmoothL1Loss", {"beta": 0.7}, "real"),
+    ("BCEWithLogitsLoss", {}, "binary_logit"),
+    ("BCELoss", {}, "binary_prob"),
+    ("CrossEntropyLoss", {}, "class_logit"),
+    ("NLLLoss", {}, "class_logp"),
+    ("KLDivLoss", {"log_target": False}, "kl"),
+    ("KLDivLoss", {"log_target": True}, "kl_log"),
+]
+
+
+def _loss_data(kind):
+    logits = RNG.normal(size=(6, 5)).astype(np.float32)
+    if kind == "real":
+        return logits, RNG.normal(size=(6, 5)).astype(np.float32)
+    if kind == "binary_logit":
+        return logits, RNG.uniform(size=(6, 5)).astype(np.float32)
+    if kind == "binary_prob":
+        return 1 / (1 + np.exp(-logits)), RNG.uniform(size=(6, 5)).astype(np.float32)
+    if kind == "class_logit":
+        return logits, RNG.integers(0, 5, size=(6,)).astype(np.int64)
+    if kind == "class_logp":
+        lp = torch.log_softmax(torch.from_numpy(logits), -1).numpy()
+        return lp, RNG.integers(0, 5, size=(6,)).astype(np.int64)
+    if kind in ("kl", "kl_log"):
+        lp = torch.log_softmax(torch.from_numpy(logits), -1).numpy()
+        q = torch.softmax(torch.from_numpy(RNG.normal(size=(6, 5)).astype(np.float32)), -1).numpy()
+        return lp, (np.log(q) if kind == "kl_log" else q)
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+@pytest.mark.parametrize("name,kwargs,kind", LOSSES,
+                         ids=[f"{n}-{k}" for n, _, k in LOSSES])
+def test_loss_matches_torch(name, kwargs, kind, reduction):
+    pred, tgt = _loss_data(kind)
+    m = getattr(ht.nn, name)(reduction=reduction, **kwargs)
+    t = getattr(torch.nn, name)(reduction=reduction, **kwargs)
+    got = np.asarray(m(pred, tgt))  # torch criterion call shape
+    want = t(torch.from_numpy(pred), torch.from_numpy(tgt)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_loss_module_calling_convention():
+    """The full Module form loss(params, pred, target) works too (so a
+    criterion can sit inside Sequential-style training code)."""
+    pred, tgt = _loss_data("real")
+    m = ht.nn.MSELoss()
+    np.testing.assert_allclose(np.asarray(m((), pred, tgt)),
+                               np.asarray(m(pred, tgt)))
+    # two positionals + target= kwarg is the Module shape, not the torch
+    # criterion shape — params must not leak into the loss math
+    np.testing.assert_allclose(np.asarray(m((), pred, target=tgt)),
+                               np.asarray(m(pred, tgt)))
+    with pytest.raises(ValueError, match="reduction"):
+        ht.nn.MSELoss(reduction="bogus")
+    # batchmean is a KL-only reduction (torch parity): others reject it
+    with pytest.raises(ValueError, match="reduction"):
+        ht.nn.MSELoss(reduction="batchmean")
+    ht.nn.KLDivLoss(reduction="batchmean")  # allowed
+
+
+def test_channel_dropout_and_unflatten():
+    import jax
+
+    x = RNG.normal(size=(3, 8, 5, 5)).astype(np.float32)
+    m = ht.nn.Dropout2d(p=0.5)
+    assert (np.asarray(m.apply((), x)) == x).all()  # eval = identity
+    y = np.asarray(m.apply((), x, train=True, key=jax.random.key(0)))
+    # whole channels are zeroed; survivors are scaled by 1/keep
+    per_chan = y.reshape(3, 8, -1)
+    dead = (per_chan == 0).all(axis=2)
+    alive = ~dead
+    np.testing.assert_allclose(per_chan[alive], (x.reshape(3, 8, -1) / 0.5)[alive],
+                               rtol=1e-6)
+    assert dead.any() and alive.any()
+    with pytest.raises(ValueError, match="4-D"):
+        m.apply((), x[0], train=True, key=jax.random.key(0))
+    with pytest.raises(ValueError, match="PRNG key"):
+        m.apply((), x, train=True)
+
+    u = ht.nn.Unflatten(1, (2, 4))
+    t = torch.nn.Unflatten(1, (2, 4))
+    np.testing.assert_array_equal(
+        np.asarray(u.apply((), x.reshape(3, 8, 25))),
+        t(torch.from_numpy(x.reshape(3, 8, 25))).numpy())
+
+
+def test_torch_coverage_accounting():
+    """Every torch.nn module class and torch.fft callable must be covered,
+    served via a named facility, or documented out — the script exits
+    nonzero on any unaccounted name (VERDICT r4 item 6)."""
+    import subprocess
+    import sys as _sys
+
+    r = subprocess.run(
+        [_sys.executable, "scripts/torch_coverage.py"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, env={**os.environ, "PYTHONPATH": ""},
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "UNACCOUNTED" not in r.stdout
+
+
+def test_kl_batchmean():
+    pred, tgt = _loss_data("kl")
+    m = ht.nn.KLDivLoss(reduction="batchmean")
+    t = torch.nn.KLDivLoss(reduction="batchmean")
+    np.testing.assert_allclose(
+        np.asarray(m(pred, tgt)),
+        t(torch.from_numpy(pred), torch.from_numpy(tgt)).numpy(), atol=1e-6)
