@@ -49,6 +49,23 @@ def init_distributed(
         mesh = make_mesh(axis_names=tuple(axis_names))
     use_mesh(mesh)
 
+    if jax.process_count() > 1:
+        # SPMD RNG contract: the import-time default seed is per-process
+        # entropy, which would make ht.random.* produce DIFFERENT values on
+        # each rank (found by the -m mp suite lane).  Broadcast rank 0's
+        # seed so every process holds identical Threefry state — the
+        # reference bcasts its time-derived default the same way
+        # (heat/core/random.py seed bcast from rank 0).
+        from jax.experimental import multihost_utils
+
+        from . import random as _random
+
+        # int32-safe payload: with x64 disabled, jax arrays truncate int64
+        s0 = multihost_utils.broadcast_one_to_all(
+            np.asarray(_random.get_state()[1] % (2**31), np.int32)
+        )
+        _random.set_state(("Threefry", int(s0), 0))
+
 
 def finalize_distributed() -> None:
     """Shut down the multi-host runtime (reference: implicit MPI_Finalize)."""

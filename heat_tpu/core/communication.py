@@ -196,9 +196,13 @@ class Communication:
         (every process must call this together — the same contract the
         reference's gather-to-all has).  Fully-replicated arrays read their
         local replica directly — no collective, so ``if rank == 0: print(x)``
-        on replicated data stays legal."""
-        if getattr(array, "is_fully_addressable", True) or getattr(
-            array, "is_fully_replicated", False
+        on replicated data stays legal — PROVIDED this process holds a
+        replica: an array on a sub-mesh of purely remote devices is
+        "replicated" yet unreadable locally, and must allgather (found by
+        the -m mp lane's sub-mesh sweep)."""
+        if getattr(array, "is_fully_addressable", True) or (
+            getattr(array, "is_fully_replicated", False)
+            and len(array.addressable_shards) > 0
         ):
             return np.asarray(jax.device_get(array))
         from jax.experimental import multihost_utils
@@ -232,6 +236,18 @@ class Communication:
             return lax.with_sharding_constraint(array, sh)
         if getattr(array, "sharding", None) == sh:
             return array
+        if self.n_processes > 1 and getattr(array, "is_fully_addressable", True):
+            # multi-process device_put runs multihost assert_equal, whose
+            # np.equal makes NaN != NaN — identical NaN-bearing inputs would
+            # spuriously fail.  Inputs are SPMD-identical by contract, so
+            # build the global array from per-device slices instead (found
+            # by the -m mp lane: nansum's ht.array([1, nan, 3]))
+            host = np.asarray(array)
+            # explicit dtype: a sub-mesh can leave this process with
+            # ZERO addressable shards, where inference has no data
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx: host[idx], dtype=host.dtype
+            )
         return jax.device_put(array, sh)
 
     def pad_shard(self, array: jax.Array, split: int) -> jax.Array:
@@ -271,6 +287,14 @@ class Communication:
                 return array  # inside a transform where constraints don't apply
         if getattr(array, "sharding", None) == sh:
             return array
+        if self.n_processes > 1 and getattr(array, "is_fully_addressable", True):
+            # same NaN-vs-assert_equal hazard as shard() (see there)
+            host = np.asarray(array)
+            # explicit dtype: a sub-mesh can leave this process with
+            # ZERO addressable shards, where inference has no data
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx: host[idx], dtype=host.dtype
+            )
         return jax.device_put(array, sh)
 
     def split_of(self, array: jax.Array) -> Optional[int]:

