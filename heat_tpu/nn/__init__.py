@@ -4,7 +4,8 @@ from .modules import *
 from . import modules
 from .activations import *
 from .losses import *
-from . import activations, losses
+from .spatial import *
+from . import activations, losses, spatial
 from .attention import MultiheadAttention, apply_rope
 from .moe import MoE
 from .pipelined import Pipelined

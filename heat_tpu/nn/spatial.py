@@ -1,0 +1,165 @@
+"""1-D convolution/pooling and distance modules mirroring torch.nn.
+
+Round-5 widening of the zoo (the reference resolves all of ``torch.nn``
+dynamically, SURVEY §2.5): the 1-D spatial family composes the same
+``lax.conv_general_dilated`` / ``reduce_window`` primitives as the 2-D
+zoo in ``modules.py``; the distance modules are einsum/norm one-liners
+kept as constructors for torch call-shape parity.  All verified against
+the ``torch.nn`` oracle in ``tests/test_nn_activations.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .modules import AvgPool2d, Conv2d, MaxPool2d, Module
+
+__all__ = [
+    "AvgPool1d", "Bilinear", "Conv1d", "CosineSimilarity",
+    "LocalResponseNorm", "MaxPool1d", "PairwiseDistance",
+]
+
+
+class Conv1d(Module):
+    """1-D convolution, NCL layout (torch convention).
+
+    Delegates to :class:`Conv2d` over a height-1 image — one conv
+    implementation serves both ranks; only the torch-parity (O, I, K)
+    weight layout lives here."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int,
+                 stride: int = 1, padding: int = 0, bias: bool = True):
+        self._c2 = Conv2d(in_channels, out_channels, (1, int(kernel_size)),
+                          stride=(1, int(stride)), padding=(0, int(padding)),
+                          bias=bias)
+        self.bias = bias
+
+    def init(self, key):
+        p = self._c2.init(key)
+        p["weight"] = p["weight"][:, :, 0, :]  # (O, I, 1, K) -> torch (O, I, K)
+        return p
+
+    def apply(self, params, x, **kw):
+        p2 = dict(params, weight=params["weight"][:, :, None, :])
+        return self._c2.apply(p2, x[:, :, None, :])[:, :, 0, :]
+
+
+class _Pool1dVia2d(Module):
+    """1-D pooling via the 2-D reduce_window over a height-1 image."""
+
+    pool2d_cls = None
+
+    def __init__(self, kernel_size: int, stride: Optional[int] = None):
+        stride = int(stride if stride is not None else kernel_size)
+        self._p2 = self.pool2d_cls((1, int(kernel_size)), (1, stride))
+
+    def apply(self, params, x, **kw):
+        return self._p2.apply((), x[:, :, None, :])[:, :, 0, :]
+
+
+class MaxPool1d(_Pool1dVia2d):
+    pool2d_cls = MaxPool2d
+
+
+class AvgPool1d(_Pool1dVia2d):
+    pool2d_cls = AvgPool2d
+
+
+class CosineSimilarity(Module):
+    """cos(x1, x2) along ``dim`` with torch's eps clamp on the norms."""
+
+    def __init__(self, dim: int = 1, eps: float = 1e-8):
+        self.dim = dim
+        self.eps = eps
+
+    def apply(self, params, x1, x2=None, **kw):
+        n1 = jnp.maximum(jnp.linalg.norm(x1, axis=self.dim), self.eps)
+        n2 = jnp.maximum(jnp.linalg.norm(x2, axis=self.dim), self.eps)
+        return (x1 * x2).sum(axis=self.dim) / (n1 * n2)
+
+    def __call__(self, *args, **kw):
+        if len(args) == 2:  # torch call shape: cos(x1, x2)
+            return self.apply((), *args, **kw)
+        return self.apply(*args, **kw)
+
+
+class PairwiseDistance(Module):
+    """p-norm distance between row pairs (torch semantics: along the last
+    dim, with additive eps for differentiability at 0).  For all-pairs
+    distributed distances use ``ht.spatial.cdist``."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-6, keepdim: bool = False):
+        self.p = p
+        self.eps = eps
+        self.keepdim = keepdim
+
+    def apply(self, params, x1, x2=None, **kw):
+        d = x1 - x2 + self.eps
+        return jnp.linalg.norm(d, ord=self.p, axis=-1, keepdims=self.keepdim)
+
+    def __call__(self, *args, **kw):
+        if len(args) == 2:
+            return self.apply((), *args, **kw)
+        return self.apply(*args, **kw)
+
+
+class Bilinear(Module):
+    """y = x1 @ W @ x2 + b per output feature (torch ``nn.Bilinear``)."""
+
+    def __init__(self, in1_features: int, in2_features: int, out_features: int,
+                 bias: bool = True):
+        self.in1_features = in1_features
+        self.in2_features = in2_features
+        self.out_features = out_features
+        self.bias = bias
+
+    def init(self, key):
+        wk, bk = jax.random.split(key)
+        bound = 1.0 / jnp.sqrt(self.in1_features)
+        w = jax.random.uniform(
+            wk, (self.out_features, self.in1_features, self.in2_features),
+            minval=-bound, maxval=bound,
+        )
+        if self.bias:
+            return {"weight": w,
+                    "bias": jax.random.uniform(bk, (self.out_features,),
+                                               minval=-bound, maxval=bound)}
+        return {"weight": w}
+
+    def apply(self, params, x1, x2=None, **kw):
+        y = jnp.einsum("...i,oij,...j->...o", x1, params["weight"], x2)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class LocalResponseNorm(Module):
+    """Cross-channel local response normalization (torch formula):
+    ``x / (k + alpha/n * sum_{window} x^2) ** beta`` over a channel window
+    of ``size``, NC... layout."""
+
+    def __init__(self, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 1.0):
+        self.size = int(size)
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, params, x, **kw):
+        sq = x * x
+        half = self.size // 2
+        lo = half
+        hi = self.size - half - 1  # torch centers the window with this split
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (lo, hi)
+        sq = jnp.pad(sq, pad)
+        win = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size) + (1,) * (x.ndim - 2),
+            window_strides=(1,) * x.ndim,
+            padding="VALID",
+        )
+        return x / (self.k + self.alpha / self.size * win) ** self.beta

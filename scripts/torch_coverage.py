@@ -56,9 +56,8 @@ VIA = {
     "InstanceNorm1d": "ht.nn.GroupNorm(num_groups=C, C) — instance norm is the groups==channels case",
     "InstanceNorm2d": "ht.nn.GroupNorm(num_groups=C, C)",
     "InstanceNorm3d": "ht.nn.GroupNorm(num_groups=C, C)",
-    "CosineSimilarity": "ht.nn.functional / jnp one-liner over normalized rows (ht.spatial.cdist for batched distances)",
-    "PairwiseDistance": "ht.spatial.cdist (distributed) or a jnp.linalg.norm one-liner",
     "Softmax2d": "ht.nn.Softmax(dim=-3) (torch deprecated the 2d spelling)",
+    "CrossMapLRN2d": "ht.nn.LocalResponseNorm (CrossMapLRN2d is its legacy CUDA-path alias)",
 }
 
 # ---------------------------------------------------------------------- #
@@ -87,8 +86,8 @@ _out("1-D/3-D spatial variants of the implemented 2-D zoo: the reference's exerc
      "workloads (SURVEY §6 baselines) are 2-D convnets; the reduce_window/conv "
      "pattern in modules.py extends mechanically when a workload needs them",
      ["AdaptiveAvgPool1d", "AdaptiveAvgPool3d", "AdaptiveMaxPool1d",
-      "AdaptiveMaxPool2d", "AdaptiveMaxPool3d", "AvgPool1d", "AvgPool3d",
-      "MaxPool1d", "MaxPool3d", "Conv1d", "Conv3d", "ConvTranspose1d",
+      "AdaptiveMaxPool2d", "AdaptiveMaxPool3d", "AvgPool3d",
+      "MaxPool3d", "Conv3d", "ConvTranspose1d",
       "ConvTranspose2d", "ConvTranspose3d", "BatchNorm3d"])
 
 _out("exotic pooling with no reference-workload user; LPPool is a powered "
@@ -127,10 +126,6 @@ _out("SELU-coupled dropout variants that rescale to preserve self-normalizing "
 _out("jax.image.resize is the JAX-native upsampling (nearest/bilinear/bicubic)",
      ["Upsample", "UpsamplingBilinear2d", "UpsamplingNearest2d"])
 
-_out("AlexNet-era local response normalization; a 5-line reduce_window if needed",
-     ["LocalResponseNorm", "CrossMapLRN2d"])
-
-_out("an einsum one-liner (x1 @ W @ x2)", ["Bilinear"])
 _out("sparse-gradient bag-reduction of Embedding rows; segment_sum one-liner, "
      "no reference workload", ["EmbeddingBag"])
 
