@@ -9,39 +9,51 @@ Verified against the ``torch.nn`` oracle in ``tests/test_nn_activations.py``.
 
 from __future__ import annotations
 
+import math
+
+import jax
+import jax.numpy as jnp
+
 from .modules import Module
+from .spatial import CosineSimilarity, PairwiseDistance
 from . import functional as F
 
 __all__ = [
-    "BCELoss", "BCEWithLogitsLoss", "CrossEntropyLoss", "HuberLoss",
-    "KLDivLoss", "L1Loss", "MSELoss", "NLLLoss", "SmoothL1Loss",
+    "BCELoss", "BCEWithLogitsLoss", "CosineEmbeddingLoss", "CrossEntropyLoss",
+    "GaussianNLLLoss", "HingeEmbeddingLoss", "HuberLoss", "KLDivLoss",
+    "L1Loss", "MSELoss", "MarginRankingLoss", "NLLLoss", "PoissonNLLLoss",
+    "SmoothL1Loss", "SoftMarginLoss", "TripletMarginLoss",
 ]
 
 
 class _Loss(Module):
     """Criterion base: ``reduction`` in {'mean', 'sum', 'none'} (torch
-    default 'mean'); ``apply(params, pred, target)`` — params unused, kept
-    for the Module calling convention."""
+    default 'mean'); ``apply(params, *inputs)`` — params unused, kept for
+    the Module calling convention.  ``_arity`` is the criterion's tensor
+    count (2 for pred/target; ranking/triplet losses take 3)."""
 
     _reductions = ("mean", "sum", "none")
+    _arity = 2
 
     def __init__(self, reduction: str = "mean"):
         if reduction not in self._reductions:
             raise ValueError(f"unknown reduction {reduction!r}")
         self.reduction = reduction
 
-    def _fn(self, pred, target):
+    def _fn(self, *inputs):
         raise NotImplementedError
 
-    def apply(self, params, pred, target=None, **kw):
-        return self._fn(pred, target)
+    def apply(self, params, *inputs, target=None, **kw):
+        if target is not None:
+            inputs = inputs + (target,)
+        return self._fn(*inputs)
 
     def __call__(self, *args, **kw):
-        # criterion convenience: loss(pred, target) without params, the
-        # torch call shape — or the full Module form loss(params, pred, tgt).
+        # criterion convenience: loss(pred, target, ...) without params, the
+        # torch call shape — or the full Module form loss(params, pred, ...).
         # A target= kwarg disambiguates loss(params, pred, target=t), which
-        # also has two positionals but must route through apply
-        if len(args) == 2 and "target" not in kw:
+        # also has _arity positionals but must route through apply
+        if len(args) == self._arity and "target" not in kw:
             return self._fn(*args)
         return self.apply(*args, **kw)
 
@@ -92,6 +104,130 @@ class SmoothL1Loss(_Loss):
 
     def _fn(self, pred, target):
         return F.smooth_l1_loss(pred, target, reduction=self.reduction, beta=self.beta)
+
+
+class SoftMarginLoss(_Loss):
+    """log(1 + exp(-y·x)) with targets in {-1, +1}."""
+
+    def _fn(self, pred, target):
+        v = jax.nn.softplus(-F._j(target) * F._j(pred))
+        return F._reduce(v, self.reduction)
+
+
+class HingeEmbeddingLoss(_Loss):
+    """x where y == 1, max(0, margin - x) where y == -1."""
+
+    def __init__(self, margin: float = 1.0, reduction: str = "mean"):
+        super().__init__(reduction)
+        self.margin = margin
+
+    def _fn(self, pred, target):
+        x, y = F._j(pred), F._j(target)
+        v = jnp.where(y == 1, x, jnp.maximum(0.0, self.margin - x))
+        return F._reduce(v, self.reduction)
+
+
+class MarginRankingLoss(_Loss):
+    """max(0, -y·(x1 - x2) + margin) — y = +1 ranks x1 above x2."""
+
+    _arity = 3
+
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        super().__init__(reduction)
+        self.margin = margin
+
+    def _fn(self, x1, x2, target):
+        v = jnp.maximum(0.0, -F._j(target) * (F._j(x1) - F._j(x2)) + self.margin)
+        return F._reduce(v, self.reduction)
+
+
+class CosineEmbeddingLoss(_Loss):
+    """1 - cos(x1, x2) for y == 1; max(0, cos(x1, x2) - margin) for y == -1
+    (cosine along dim 1, torch's eps-clamped norms)."""
+
+    _arity = 3
+
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        super().__init__(reduction)
+        self.margin = margin
+
+    def _fn(self, x1, x2, target):
+        a, b, y = F._j(x1), F._j(x2), F._j(target)
+        # torch accepts (N, D) or unbatched (D,): feature axis is the last
+        cos = CosineSimilarity(dim=a.ndim - 1)(a, b)
+        v = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return F._reduce(v, self.reduction)
+
+
+class GaussianNLLLoss(_Loss):
+    """0.5·(log max(var, eps) + (x - t)² / max(var, eps)) [+ 0.5·log 2π]
+    — torch call shape ``loss(input, target, var)``."""
+
+    _arity = 3
+
+    def __init__(self, full: bool = False, eps: float = 1e-6,
+                 reduction: str = "mean"):
+        super().__init__(reduction)
+        self.full = full
+        self.eps = eps
+
+    def _fn(self, pred, target, var):
+        v = jnp.maximum(F._j(var), self.eps)
+        out = 0.5 * (jnp.log(v) + (F._j(pred) - F._j(target)) ** 2 / v)
+        if self.full:
+            out = out + 0.5 * math.log(2 * math.pi)
+        return F._reduce(out, self.reduction)
+
+
+class PoissonNLLLoss(_Loss):
+    """exp(x) - t·x (log-space input, the default) or x - t·log(x + eps);
+    ``full`` adds the Stirling approximation for t > 1 (torch formula)."""
+
+    def __init__(self, log_input: bool = True, full: bool = False,
+                 eps: float = 1e-8, reduction: str = "mean"):
+        super().__init__(reduction)
+        self.log_input = log_input
+        self.full = full
+        self.eps = eps
+
+    def _fn(self, pred, target):
+        x, t = F._j(pred), F._j(target)
+        if self.log_input:
+            v = jnp.exp(x) - t * x
+        else:
+            v = x - t * jnp.log(x + self.eps)
+        if self.full:
+            stirling = t * jnp.log(jnp.where(t > 1, t, 1.0)) - t + 0.5 * jnp.log(
+                2 * math.pi * jnp.where(t > 1, t, 1.0)
+            )
+            v = v + jnp.where(t > 1, stirling, 0.0)
+        return F._reduce(v, self.reduction)
+
+
+class TripletMarginLoss(_Loss):
+    """max(0, d(a, p) - d(a, n) + margin) with the torch pairwise p-norm
+    (additive eps); ``swap`` uses min(d(a, n), d(p, n)) as the negative
+    distance."""
+
+    _arity = 3
+
+    def __init__(self, margin: float = 1.0, p: float = 2.0, eps: float = 1e-6,
+                 swap: bool = False, reduction: str = "mean"):
+        super().__init__(reduction)
+        self.margin = margin
+        self.p = p
+        self.eps = eps
+        self.swap = swap
+
+    def _fn(self, anchor, positive, negative):
+        dist = PairwiseDistance(p=self.p, eps=self.eps)
+        a, p_, n = F._j(anchor), F._j(positive), F._j(negative)
+        d_pos = dist(a, p_)
+        d_neg = dist(a, n)
+        if self.swap:
+            d_neg = jnp.minimum(d_neg, dist(p_, n))
+        v = jnp.maximum(0.0, d_pos - d_neg + self.margin)
+        return F._reduce(v, self.reduction)
 
 
 class KLDivLoss(_Loss):

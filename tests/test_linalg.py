@@ -70,10 +70,14 @@ class TestMatmul(TestCase):
         monkeypatch.setattr(basics, "_SUMMA_DISPATCH", {(platform, comm.size): 128})
         self.assert_array_equal(basics.matmul(ha, hb), a @ b, rtol=1e-3, atol=1e-3)
         assert not calls
-        # at/above the crossover: the ring path, same numbers and split
+        # at/above the crossover: the ring path, same numbers and split —
+        # except at p=1, where auto NEVER dispatches (nothing to ring over)
         monkeypatch.setattr(basics, "_SUMMA_DISPATCH", {(platform, comm.size): 64})
         res = basics.matmul(ha, hb)
-        assert calls and res.split == 0
+        if comm.size > 1:
+            assert calls and res.split == 0  # ring path, split preserved
+        else:
+            assert not calls and res.split in (0, None)
         self.assert_array_equal(res, a @ b, rtol=1e-3, atol=1e-3)
         # other split cases never dispatch, whatever the table says
         calls.clear()

@@ -205,6 +205,99 @@ def test_channel_dropout_and_unflatten():
         t(torch.from_numpy(x.reshape(3, 8, 25))).numpy())
 
 
+class TestExtendedLosses:
+    """Round-5 long-tail criteria vs the torch oracle (previously
+    documented-out rows of scripts/torch_coverage.py)."""
+
+    def _pm_targets(self, n):
+        return (RNG.integers(0, 2, size=n) * 2 - 1).astype(np.float32)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_two_input_losses(self, reduction):
+        x = RNG.normal(size=(12,)).astype(np.float32)
+        y = self._pm_targets(12)
+        for name, kwargs in (("SoftMarginLoss", {}),
+                             ("HingeEmbeddingLoss", {"margin": 0.7})):
+            got = np.asarray(getattr(ht.nn, name)(reduction=reduction, **kwargs)(x, y))
+            want = getattr(torch.nn, name)(reduction=reduction, **kwargs)(
+                torch.from_numpy(x), torch.from_numpy(y)).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_poisson_nll(self):
+        x = RNG.normal(size=(10,)).astype(np.float32)
+        t = RNG.poisson(3.0, size=10).astype(np.float32)
+        for log_input in (True, False):
+            for full in (False, True):
+                xx = x if log_input else np.abs(x) + 0.1
+                m = ht.nn.PoissonNLLLoss(log_input=log_input, full=full)
+                tm = torch.nn.PoissonNLLLoss(log_input=log_input, full=full)
+                np.testing.assert_allclose(
+                    np.asarray(m(xx, t)),
+                    tm(torch.from_numpy(xx), torch.from_numpy(t)).numpy(),
+                    rtol=1e-5, atol=1e-6)
+
+    def test_margin_ranking(self):
+        x1 = RNG.normal(size=(9,)).astype(np.float32)
+        x2 = RNG.normal(size=(9,)).astype(np.float32)
+        y = self._pm_targets(9)
+        m = ht.nn.MarginRankingLoss(margin=0.3)
+        t = torch.nn.MarginRankingLoss(margin=0.3)
+        np.testing.assert_allclose(
+            np.asarray(m(x1, x2, y)),
+            t(torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(y)).numpy(),
+            rtol=1e-6, atol=1e-7)
+
+    def test_cosine_embedding(self):
+        a = RNG.normal(size=(8, 5)).astype(np.float32)
+        b = RNG.normal(size=(8, 5)).astype(np.float32)
+        y = self._pm_targets(8)
+        m = ht.nn.CosineEmbeddingLoss(margin=0.2)
+        t = torch.nn.CosineEmbeddingLoss(margin=0.2)
+        np.testing.assert_allclose(
+            np.asarray(m(a, b, y)),
+            t(torch.from_numpy(a), torch.from_numpy(b), torch.from_numpy(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        # torch also accepts unbatched (D,) inputs with a scalar target
+        ys = np.float32(1.0)
+        np.testing.assert_allclose(
+            np.asarray(m(a[0], b[0], ys)),
+            t(torch.from_numpy(a[0]), torch.from_numpy(b[0]), torch.tensor(ys)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_gaussian_nll(self):
+        x = RNG.normal(size=(10,)).astype(np.float32)
+        t = RNG.normal(size=(10,)).astype(np.float32)
+        var = (RNG.uniform(size=10) + 0.01).astype(np.float32)
+        for full in (False, True):
+            m = ht.nn.GaussianNLLLoss(full=full)
+            tm = torch.nn.GaussianNLLLoss(full=full)
+            np.testing.assert_allclose(
+                np.asarray(m(x, t, var)),
+                tm(torch.from_numpy(x), torch.from_numpy(t), torch.from_numpy(var)).numpy(),
+                rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("swap", [False, True])
+    def test_triplet_margin(self, swap):
+        a = RNG.normal(size=(7, 6)).astype(np.float32)
+        p = RNG.normal(size=(7, 6)).astype(np.float32)
+        n = RNG.normal(size=(7, 6)).astype(np.float32)
+        m = ht.nn.TripletMarginLoss(margin=0.8, swap=swap)
+        t = torch.nn.TripletMarginLoss(margin=0.8, swap=swap)
+        np.testing.assert_allclose(
+            np.asarray(m(a, p, n)),
+            t(torch.from_numpy(a), torch.from_numpy(p), torch.from_numpy(n)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_three_input_module_form(self):
+        """Multi-input criteria also accept the Module (params-first) shape."""
+        x1 = RNG.normal(size=(5,)).astype(np.float32)
+        x2 = RNG.normal(size=(5,)).astype(np.float32)
+        y = self._pm_targets(5)
+        m = ht.nn.MarginRankingLoss()
+        np.testing.assert_allclose(np.asarray(m((), x1, x2, y)),
+                                   np.asarray(m(x1, x2, y)))
+
+
 class TestSpatial1dAndDistances:
     """Round-5 zoo widening (heat_tpu/nn/spatial.py) vs the torch oracle."""
 
