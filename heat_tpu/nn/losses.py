@@ -13,16 +13,18 @@ import math
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from .modules import Module
 from .spatial import CosineSimilarity, PairwiseDistance
 from . import functional as F
 
 __all__ = [
-    "BCELoss", "BCEWithLogitsLoss", "CosineEmbeddingLoss", "CrossEntropyLoss",
-    "GaussianNLLLoss", "HingeEmbeddingLoss", "HuberLoss", "KLDivLoss",
-    "L1Loss", "MSELoss", "MarginRankingLoss", "NLLLoss", "PoissonNLLLoss",
-    "SmoothL1Loss", "SoftMarginLoss", "TripletMarginLoss",
+    "BCELoss", "BCEWithLogitsLoss", "CTCLoss", "CosineEmbeddingLoss",
+    "CrossEntropyLoss", "GaussianNLLLoss", "HingeEmbeddingLoss", "HuberLoss",
+    "KLDivLoss", "L1Loss", "MSELoss", "MarginRankingLoss",
+    "MultiLabelSoftMarginLoss", "MultiMarginLoss", "NLLLoss",
+    "PoissonNLLLoss", "SmoothL1Loss", "SoftMarginLoss", "TripletMarginLoss",
 ]
 
 
@@ -239,3 +241,88 @@ class KLDivLoss(_Loss):
 
     def _fn(self, pred, target):
         return F.kl_div(pred, target, reduction=self.reduction, log_target=self.log_target)
+
+
+class MultiLabelSoftMarginLoss(_Loss):
+    """Per-class binary logistic loss averaged over classes (torch formula):
+    ``-1/C · Σ_c [y·logσ(x) + (1-y)·logσ(-x)]``."""
+
+    def _fn(self, pred, target):
+        x, y = F._j(pred), F._j(target)
+        v = -(y * jax.nn.log_sigmoid(x) + (1.0 - y) * jax.nn.log_sigmoid(-x))
+        return F._reduce(v.mean(axis=-1), self.reduction)
+
+
+class MultiMarginLoss(_Loss):
+    """Multi-class hinge (torch formula): ``1/C · Σ_{i≠y} max(0, margin -
+    x[y] + x[i])^p`` with integer class targets."""
+
+    def __init__(self, p: int = 1, margin: float = 1.0, reduction: str = "mean"):
+        if p not in (1, 2):
+            raise ValueError(f"p must be 1 or 2, got {p}")
+        super().__init__(reduction)
+        self.p = p
+        self.margin = margin
+
+    def _fn(self, pred, target):
+        x = F._j(pred)
+        y = F._j(target).astype(jnp.int32)
+        C = x.shape[-1]
+        xy = jnp.take_along_axis(x, y[..., None], axis=-1)
+        h = jnp.maximum(0.0, self.margin - xy + x) ** self.p
+        # the i == y term contributes max(0, margin)^p; torch excludes it
+        h = h * (jnp.arange(C) != y[..., None])
+        return F._reduce(h.sum(axis=-1) / C, self.reduction)
+
+
+class CTCLoss(_Loss):
+    """Connectionist temporal classification, torch call shape:
+    ``ctc(log_probs (T, N, C), targets (N, S), input_lengths (N),
+    target_lengths (N))`` — delegated to ``optax.ctc_loss`` (the JAX-native
+    forward-backward), with the layout/padding conversion here.  Targets
+    must be the padded 2-D form (the reference's torch backend also
+    accepts a concatenated 1-D form; pad with any value, e.g. 0).
+    ``reduction='mean'`` divides each sequence loss by its target length,
+    then averages (torch semantics)."""
+
+    def __init__(self, blank: int = 0, reduction: str = "mean",
+                 zero_infinity: bool = False):
+        super().__init__(reduction)
+        self.blank = blank
+        self.zero_infinity = zero_infinity
+
+    def _fn(self, log_probs, targets, input_lengths, target_lengths):
+        lp = F._j(log_probs)
+        tg = F._j(targets).astype(jnp.int32)
+        il = F._j(input_lengths).astype(jnp.int32)
+        tl = F._j(target_lengths).astype(jnp.int32)
+        if tg.ndim != 2:
+            raise ValueError(
+                "CTCLoss expects padded 2-D targets (N, S); the concatenated "
+                "1-D torch form is not supported — reshape with per-sequence "
+                "rows")
+        T = lp.shape[0]
+        S = tg.shape[1]
+        logits = jnp.swapaxes(lp, 0, 1)  # (N, T, C), optax layout
+        logit_pad = (jnp.arange(T)[None, :] >= il[:, None]).astype(lp.dtype)
+        label_pad = (jnp.arange(S)[None, :] >= tl[:, None]).astype(lp.dtype)
+        per_seq = optax.ctc_loss(logits, logit_pad, tg, label_pad,
+                                 blank_id=self.blank)
+        # optax clamps log(0) to a large finite value, so infeasible
+        # alignments never read as inf — detect them explicitly: a CTC path
+        # needs target_length + (adjacent repeats, which force a blank)
+        # frames.  torch returns inf there (zeroed under zero_infinity)
+        valid = jnp.arange(S)[None, :] < tl[:, None]
+        rep = jnp.zeros_like(tl) if S < 2 else (
+            (tg[:, 1:] == tg[:, :-1]) & valid[:, 1:]
+        ).sum(axis=1)
+        infeasible = tl + rep > il
+        per_seq = jnp.where(infeasible, jnp.inf, per_seq)
+        if self.zero_infinity:
+            per_seq = jnp.where(jnp.isfinite(per_seq), per_seq, 0.0)
+        if self.reduction == "mean":
+            # torch: per-sequence loss / target_length, then batch mean
+            return jnp.mean(per_seq / jnp.maximum(tl, 1))
+        return F._reduce(per_seq, self.reduction)
+
+    _arity = 4

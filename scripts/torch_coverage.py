@@ -112,11 +112,12 @@ _out("lax.conv_general_dilated_patches is the JAX-native im2col; Fold/Unfold "
 
 _out("remaining long-tail criteria outside the reference's exercised surface; "
      "the _Loss pattern in losses.py makes each a ~10-line addition "
-     "(CTC: optax.ctc_loss is the JAX-native implementation; "
-     "TripletMarginWithDistanceLoss: TripletMarginLoss with a callable d)",
-     ["AdaptiveLogSoftmaxWithLoss", "CTCLoss", "LinearCrossEntropyLoss",
-      "MultiLabelMarginLoss", "MultiLabelSoftMarginLoss",
-      "MultiMarginLoss", "TripletMarginWithDistanceLoss"])
+     "(TripletMarginWithDistanceLoss: TripletMarginLoss with a callable d; "
+     "MultiLabelMarginLoss: MultiMarginLoss summed over a label SET; "
+     "AdaptiveLogSoftmax/LinearCrossEntropy: fused softmax variants XLA "
+     "fuses on its own)",
+     ["AdaptiveLogSoftmaxWithLoss", "LinearCrossEntropyLoss",
+      "MultiLabelMarginLoss", "TripletMarginWithDistanceLoss"])
 
 _out("SELU-coupled dropout variants that rescale to preserve self-normalizing "
      "statistics; no SELU workload in the reference baselines",

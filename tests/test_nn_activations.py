@@ -288,6 +288,75 @@ class TestExtendedLosses:
             t(torch.from_numpy(a), torch.from_numpy(p), torch.from_numpy(n)).numpy(),
             rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_multilabel_soft_margin(self, reduction):
+        x = RNG.normal(size=(6, 4)).astype(np.float32)
+        y = RNG.integers(0, 2, size=(6, 4)).astype(np.float32)
+        m = ht.nn.MultiLabelSoftMarginLoss(reduction=reduction)
+        t = torch.nn.MultiLabelSoftMarginLoss(reduction=reduction)
+        np.testing.assert_allclose(
+            np.asarray(m(x, y)),
+            t(torch.from_numpy(x), torch.from_numpy(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("p", [1, 2])
+    def test_multi_margin(self, p):
+        x = RNG.normal(size=(7, 5)).astype(np.float32)
+        y = RNG.integers(0, 5, size=7).astype(np.int64)
+        m = ht.nn.MultiMarginLoss(p=p, margin=0.6)
+        t = torch.nn.MultiMarginLoss(p=p, margin=0.6)
+        np.testing.assert_allclose(
+            np.asarray(m(x, y)),
+            t(torch.from_numpy(x), torch.from_numpy(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="p must be"):
+            ht.nn.MultiMarginLoss(p=3)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_ctc_matches_torch(self, reduction):
+        """CTC via optax forward-backward vs torch's native implementation:
+        padded 2-D targets, ragged input/target lengths, blank=0."""
+        T, N, C, S = 12, 3, 5, 4
+        logits = RNG.normal(size=(T, N, C)).astype(np.float32)
+        log_probs = torch.log_softmax(torch.from_numpy(logits), dim=-1).numpy()
+        targets = RNG.integers(1, C, size=(N, S)).astype(np.int64)  # no blanks
+        input_lengths = np.array([12, 10, 8], dtype=np.int64)
+        target_lengths = np.array([4, 3, 2], dtype=np.int64)
+        m = ht.nn.CTCLoss(blank=0, reduction=reduction)
+        t = torch.nn.CTCLoss(blank=0, reduction=reduction)
+        got = np.asarray(m(log_probs, targets, input_lengths, target_lengths))
+        want = t(torch.from_numpy(log_probs), torch.from_numpy(targets),
+                 torch.from_numpy(input_lengths), torch.from_numpy(target_lengths)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("zero_infinity", [False, True])
+    def test_ctc_infeasible_alignment(self, zero_infinity):
+        """A sequence needing more frames than input_length: torch gives
+        inf (or 0 under zero_infinity) — optax clamps to a large finite
+        value, so feasibility is detected explicitly."""
+        T, N, C, S = 3, 2, 5, 4
+        logits = RNG.normal(size=(T, N, C)).astype(np.float32)
+        log_probs = torch.log_softmax(torch.from_numpy(logits), dim=-1).numpy()
+        targets = np.array([[1, 2, 3, 4], [2, 2, 0, 0]], dtype=np.int64)
+        input_lengths = np.array([3, 3], dtype=np.int64)
+        # row 0: tl=4 > T=3 infeasible; row 1: [2,2] repeat needs 3 frames, ok
+        target_lengths = np.array([4, 2], dtype=np.int64)
+        m = ht.nn.CTCLoss(reduction="none", zero_infinity=zero_infinity)
+        t = torch.nn.CTCLoss(reduction="none", zero_infinity=zero_infinity)
+        got = np.asarray(m(log_probs, targets, input_lengths, target_lengths))
+        want = t(torch.from_numpy(log_probs), torch.from_numpy(targets),
+                 torch.from_numpy(input_lengths), torch.from_numpy(target_lengths)).numpy()
+        if zero_infinity:
+            assert got[0] == 0.0 and want[0] == 0.0
+        else:
+            assert np.isinf(got[0]) and np.isinf(want[0])
+        np.testing.assert_allclose(got[1], want[1], rtol=1e-4, atol=1e-5)
+
+    def test_ctc_validation(self):
+        with pytest.raises(ValueError, match="2-D targets"):
+            ht.nn.CTCLoss()(np.zeros((4, 1, 3), np.float32),
+                            np.array([1, 2]), np.array([4]), np.array([2]))
+
     def test_three_input_module_form(self):
         """Multi-input criteria also accept the Module (params-first) shape."""
         x1 = RNG.normal(size=(5,)).astype(np.float32)
