@@ -18,8 +18,10 @@ import jax.numpy as jnp
 from .modules import AvgPool2d, Conv2d, MaxPool2d, Module
 
 __all__ = [
-    "AvgPool1d", "Bilinear", "Conv1d", "CosineSimilarity",
-    "LocalResponseNorm", "MaxPool1d", "PairwiseDistance",
+    "AdaptiveAvgPool1d", "AvgPool1d", "AvgPool3d", "Bilinear", "Conv1d",
+    "Conv3d", "CosineSimilarity", "LocalResponseNorm", "MaxPool1d",
+    "MaxPool3d", "PairwiseDistance", "Upsample", "UpsamplingBilinear2d",
+    "UpsamplingNearest2d",
 ]
 
 
@@ -163,3 +165,140 @@ class LocalResponseNorm(Module):
             padding="VALID",
         )
         return x / (self.k + self.alpha / self.size * win) ** self.beta
+
+
+def _triple(v):
+    """torch-style int-or-tuple normalization for 3-D spatial args (the
+    3-D sibling of modules._pair)."""
+    return v if isinstance(v, tuple) else (v, v, v)
+
+
+class Conv3d(Module):
+    """3-D convolution, NCDHW layout (torch convention)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, bias: bool = True):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride)
+        self.padding = _triple(padding)
+        self.bias = bias
+
+    def init(self, key):
+        wk, bk = jax.random.split(key)
+        k = self.kernel_size
+        fan_in = self.in_channels * k[0] * k[1] * k[2]
+        bound = 1.0 / jnp.sqrt(fan_in)
+        w = jax.random.uniform(
+            wk, (self.out_channels, self.in_channels) + k,
+            minval=-bound, maxval=bound,
+        )
+        if self.bias:
+            return {"weight": w,
+                    "bias": jax.random.uniform(bk, (self.out_channels,),
+                                               minval=-bound, maxval=bound)}
+        return {"weight": w}
+
+    def apply(self, params, x, **kw):
+        y = jax.lax.conv_general_dilated(
+            x, params["weight"], window_strides=self.stride,
+            padding=[(p, p) for p in self.padding],
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+        if self.bias:
+            y = y + params["bias"][None, :, None, None, None]
+        return y
+
+
+class _Pool3d(Module):
+    def __init__(self, kernel_size, stride=None):
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride if stride is not None else kernel_size)
+
+
+class MaxPool3d(_Pool3d):
+    def apply(self, params, x, **kw):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 1) + self.kernel_size,
+            window_strides=(1, 1) + self.stride,
+            padding="VALID",
+        )
+
+
+class AvgPool3d(_Pool3d):
+    def apply(self, params, x, **kw):
+        k = self.kernel_size
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1, 1) + k,
+            window_strides=(1, 1) + self.stride,
+            padding="VALID",
+        )
+        return summed / (k[0] * k[1] * k[2])
+
+
+class AdaptiveAvgPool1d(Module):
+    """Average-pool NCL input to a fixed length (divisible case, like
+    AdaptiveAvgPool2d in modules.py)."""
+
+    def __init__(self, output_size: int = 1):
+        self.output_size = int(output_size)
+
+    def apply(self, params, x, **kw):
+        n, c, length = x.shape
+        o = self.output_size
+        if length % o:
+            raise ValueError(
+                f"AdaptiveAvgPool1d: input {length} not divisible by output {o}"
+            )
+        return x.reshape(n, c, o, length // o).mean(axis=3)
+
+
+class Upsample(Module):
+    """Spatial upsampling over the trailing dims of an (N, C, ...) input via
+    ``jax.image.resize`` — mode 'nearest' (default) or 'bilinear'/'linear'
+    ('bilinear' follows torch's default align_corners=False geometry, which
+    is what jax.image's 'linear' computes).
+
+    DEVIATION: for NON-integer resize ratios, 'nearest' picks source pixels
+    by jax.image's half-pixel rounding, while torch uses an asymmetric
+    floor rule — outputs differ at some pixels.  Integer scale factors (the
+    overwhelmingly common case) agree exactly."""
+
+    def __init__(self, size=None, scale_factor=None, mode: str = "nearest"):
+        if (scale_factor is None) == (size is None):
+            raise ValueError("exactly one of scale_factor/size is required")
+        if mode not in ("nearest", "bilinear", "linear", "trilinear"):
+            raise ValueError(f"unsupported mode {mode!r}")
+        self.scale_factor = scale_factor
+        self.size = size
+        self.mode = mode
+
+    def apply(self, params, x, **kw):
+        spatial = x.shape[2:]
+        if self.size is not None:
+            out = self.size if isinstance(self.size, tuple) else (self.size,) * len(spatial)
+        else:
+            sf = (self.scale_factor if isinstance(self.scale_factor, tuple)
+                  else (self.scale_factor,) * len(spatial))
+            out = tuple(int(s * f) for s, f in zip(spatial, sf))
+        method = "nearest" if self.mode == "nearest" else "linear"
+        return jax.image.resize(x, x.shape[:2] + out, method=method)
+
+
+class UpsamplingNearest2d(Upsample):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__(scale_factor=scale_factor, size=size, mode="nearest")
+
+
+class UpsamplingBilinear2d(Upsample):
+    """DEVIATION from torch's deprecated alias: torch's
+    ``UpsamplingBilinear2d`` hard-codes ``align_corners=True``; this one
+    uses the half-pixel (``align_corners=False``) geometry that
+    ``jax.image.resize`` computes — i.e. it equals
+    ``Upsample(mode='bilinear')``, torch's recommended replacement."""
+
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__(scale_factor=scale_factor, size=size, mode="bilinear")

@@ -82,13 +82,14 @@ _out("the scan-based RNN/LSTM/GRU layers subsume per-step cells; decode paths us
      "explicit carry/caches instead of cell objects",
      ["RNNBase", "RNNCell", "RNNCellBase", "LSTMCell", "GRUCell"])
 
-_out("1-D/3-D spatial variants of the implemented 2-D zoo: the reference's exercised "
-     "workloads (SURVEY §6 baselines) are 2-D convnets; the reduce_window/conv "
-     "pattern in modules.py extends mechanically when a workload needs them",
-     ["AdaptiveAvgPool1d", "AdaptiveAvgPool3d", "AdaptiveMaxPool1d",
-      "AdaptiveMaxPool2d", "AdaptiveMaxPool3d", "AvgPool3d",
-      "MaxPool3d", "Conv3d", "ConvTranspose1d",
-      "ConvTranspose2d", "ConvTranspose3d", "BatchNorm3d"])
+_out("remaining spatial variants of the implemented 1-D/2-D/3-D zoo: no "
+     "reference-workload user (SURVEY §6 baselines are 2-D convnets); "
+     "adaptive-MAX pools and transposed convs follow the same "
+     "reduce_window / conv_transpose patterns when a workload needs them",
+     ["AdaptiveAvgPool3d", "AdaptiveMaxPool1d",
+      "AdaptiveMaxPool2d", "AdaptiveMaxPool3d",
+      "ConvTranspose1d", "ConvTranspose2d", "ConvTranspose3d",
+      "BatchNorm3d"])
 
 _out("exotic pooling with no reference-workload user; LPPool is a powered "
      "reduce_window, MaxUnpool needs argmax indices torch-style, FractionalMaxPool "
@@ -122,9 +123,6 @@ _out("remaining long-tail criteria outside the reference's exercised surface; "
 _out("SELU-coupled dropout variants that rescale to preserve self-normalizing "
      "statistics; no SELU workload in the reference baselines",
      ["AlphaDropout", "FeatureAlphaDropout"])
-
-_out("jax.image.resize is the JAX-native upsampling (nearest/bilinear/bicubic)",
-     ["Upsample", "UpsamplingBilinear2d", "UpsamplingNearest2d"])
 
 _out("sparse-gradient bag-reduction of Embedding rows; segment_sum one-liner, "
      "no reference workload", ["EmbeddingBag"])

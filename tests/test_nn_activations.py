@@ -426,6 +426,57 @@ class TestSpatial1dAndDistances:
             t(torch.from_numpy(x1), torch.from_numpy(x2)).detach().numpy(),
             atol=1e-5)
 
+    def test_conv3d_pool3d_match_torch(self):
+        import jax
+
+        x = RNG.normal(size=(2, 3, 6, 7, 8)).astype(np.float32)
+        m = ht.nn.Conv3d(3, 4, 2, stride=1, padding=1)
+        p = m.init(jax.random.key(0))
+        t = torch.nn.Conv3d(3, 4, 2, stride=1, padding=1)
+        with torch.no_grad():
+            t.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+            t.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+        np.testing.assert_allclose(np.asarray(m.apply(p, x)),
+                                   t(torch.from_numpy(x)).detach().numpy(),
+                                   atol=1e-5)
+        for name in ("MaxPool3d", "AvgPool3d"):
+            got = np.asarray(getattr(ht.nn, name)(2).apply((), x))
+            want = getattr(torch.nn, name)(2)(torch.from_numpy(x)).numpy()
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_adaptive_avgpool1d(self):
+        x = RNG.normal(size=(2, 3, 12)).astype(np.float32)
+        got = np.asarray(ht.nn.AdaptiveAvgPool1d(4).apply((), x))
+        want = torch.nn.AdaptiveAvgPool1d(4)(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        with pytest.raises(ValueError, match="divisible"):
+            ht.nn.AdaptiveAvgPool1d(5).apply((), x)
+
+    def test_upsample_matches_torch(self):
+        x = RNG.normal(size=(2, 3, 4, 5)).astype(np.float32)
+        # nearest: exact
+        got = np.asarray(ht.nn.Upsample(scale_factor=2).apply((), x))
+        want = torch.nn.Upsample(scale_factor=2)(torch.from_numpy(x)).numpy()
+        np.testing.assert_array_equal(got, want)
+        got = np.asarray(ht.nn.UpsamplingNearest2d(scale_factor=3).apply((), x))
+        want = torch.nn.UpsamplingNearest2d(scale_factor=3)(torch.from_numpy(x)).numpy()
+        np.testing.assert_array_equal(got, want)
+        # bilinear: torch's default align_corners=False == jax half-pixel
+        got = np.asarray(ht.nn.Upsample(scale_factor=2, mode="bilinear").apply((), x))
+        want = torch.nn.Upsample(scale_factor=2, mode="bilinear")(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # size= form + validation; size is the FIRST positional (torch order)
+        got = np.asarray(ht.nn.Upsample(size=(8, 10)).apply((), x))
+        assert got.shape == (2, 3, 8, 10)
+        got = np.asarray(ht.nn.Upsample(8).apply((), x))
+        assert got.shape == (2, 3, 8, 8)  # torch arg order: 8 is a SIZE
+        # (values differ from torch at the 5 -> 8 non-integer ratio: the
+        # documented half-pixel-vs-floor nearest deviation)
+        with pytest.raises(ValueError, match="exactly one"):
+            ht.nn.Upsample()
+        with pytest.raises(ValueError, match="mode"):
+            ht.nn.Upsample(scale_factor=2, mode="bicubic-ish")
+
     @pytest.mark.parametrize("size", [3, 4, 5])
     def test_lrn_matches_torch(self, size):
         x = RNG.normal(size=(2, 7, 4, 4)).astype(np.float32)
