@@ -5,7 +5,8 @@ from . import modules
 from .activations import *
 from .losses import *
 from .spatial import *
-from . import activations, losses, spatial
+from .padshuffle import *
+from . import activations, losses, padshuffle, spatial
 from .attention import MultiheadAttention, apply_rope
 from .moe import MoE
 from .pipelined import Pipelined

@@ -283,23 +283,45 @@ class AvgPool2d(_Pool2d):
         return summed / (self.kernel_size[0] * self.kernel_size[1])
 
 
-class AdaptiveAvgPool2d(Module):
-    """Average-pool NCHW input to a fixed (H, W) output (torch semantics for
-    the common case where the input size is a multiple of the output size)."""
+class _AdaptivePool(Module):
+    """Adaptive pooling over the trailing ``spatial`` dims, divisible case
+    (torch semantics where input size is a multiple of output size — the
+    pooled windows are then uniform).  ``output_size`` accepts an int, a
+    tuple/list, and torch's ``None`` entries (keep that dim)."""
+
+    spatial: int = 2
+    op = staticmethod(jnp.mean)
 
     def __init__(self, output_size=1):
-        self.output_size = (
-            output_size if isinstance(output_size, tuple) else (output_size, output_size)
-        )
+        n = self.spatial
+        if isinstance(output_size, (tuple, list)):
+            self.output_size = tuple(output_size)
+        else:
+            self.output_size = (output_size,) * n
+        if len(self.output_size) != n:
+            raise ValueError(f"output_size must have {n} entries")
 
     def apply(self, params, x, **kw):
-        oh, ow = self.output_size
-        n, c, h, w = x.shape
-        if h % oh or w % ow:
-            raise ValueError(
-                f"AdaptiveAvgPool2d: input {h}x{w} not divisible by output {oh}x{ow}"
-            )
-        return x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+        n = self.spatial
+        spatial = x.shape[-n:]
+        outs = tuple(
+            s if o is None else int(o)  # torch: None keeps the input extent
+            for s, o in zip(spatial, self.output_size)
+        )
+        shape = list(x.shape[:-n])
+        axes = []
+        for s, o in zip(spatial, outs):
+            if s % o:
+                raise ValueError(
+                    f"{type(self).__name__}: input {s} not divisible by output {o}"
+                )
+            shape += [o, s // o]
+            axes.append(len(shape) - 1)
+        return type(self).op(x.reshape(shape), axis=tuple(axes))
+
+
+class AdaptiveAvgPool2d(_AdaptivePool):
+    spatial = 2
 
 
 class Identity(Module):

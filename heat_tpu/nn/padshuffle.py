@@ -1,0 +1,198 @@
+"""Padding, shuffle, and adaptive-max modules mirroring torch.nn.
+
+Round-5 mirror completion (SURVEY §2.5): every padding module is one
+``jnp.pad`` mode applied to the trailing spatial dims; the shuffles are
+single reshape/transpose expressions; adaptive max pools follow the
+divisible-case reshape pattern of ``AdaptiveAvgPool2d``.  All verified
+against the ``torch.nn`` oracle in ``tests/test_nn_padshuffle.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .modules import Module, _AdaptivePool
+
+__all__ = [
+    "AdaptiveAvgPool3d", "AdaptiveMaxPool1d", "AdaptiveMaxPool2d",
+    "AdaptiveMaxPool3d", "ChannelShuffle", "CircularPad1d", "CircularPad2d",
+    "CircularPad3d", "ConstantPad1d", "ConstantPad2d", "ConstantPad3d",
+    "PixelShuffle", "PixelUnshuffle", "ReflectionPad1d", "ReflectionPad2d",
+    "ReflectionPad3d", "ReplicationPad1d", "ReplicationPad2d",
+    "ReplicationPad3d", "ZeroPad1d", "ZeroPad2d", "ZeroPad3d",
+]
+
+
+# ---------------------------------------------------------------------- #
+# padding: torch gives per-side widths as a flat tuple ordered LAST dim
+# first — (left, right[, top, bottom[, front, back]]); an int pads every
+# side of every spatial dim
+# ---------------------------------------------------------------------- #
+class _Pad(Module):
+    """Base: ``spatial`` trailing dims padded with one jnp.pad mode."""
+
+    spatial: int = 1
+    mode: str = "constant"
+
+    def __init__(self, padding, value: float = 0.0):
+        n = self.spatial
+        if isinstance(padding, int):
+            padding = (padding,) * (2 * n)
+        padding = tuple(int(p) for p in padding)
+        if len(padding) != 2 * n:
+            raise ValueError(
+                f"{type(self).__name__} expects an int or {2 * n} per-side "
+                f"widths (torch order: last dim first), got {len(padding)}"
+            )
+        self.padding = padding
+        self.value = value
+
+    def apply(self, params, x, **kw):
+        n = self.spatial
+        if x.ndim < n + 1:
+            raise ValueError(
+                f"{type(self).__name__} expects at least {n + 1}-D input, got {x.ndim}-D"
+            )
+        # torch's flat tuple is last-dim-first: pairs reversed vs axis order
+        widths = [(0, 0)] * (x.ndim - n) + [
+            (self.padding[2 * (n - 1 - i)], self.padding[2 * (n - 1 - i) + 1])
+            for i in range(n)
+        ]
+        kwargs = {"constant_values": self.value} if self.mode == "constant" else {}
+        # torch semantics: NEGATIVE widths crop; jnp.pad rejects them, so
+        # pad the non-negative part then slice the cropped edges off
+        pads = [(max(lo, 0), max(hi, 0)) for lo, hi in widths]
+        y = jnp.pad(x, pads, mode=self.mode, **kwargs)
+        idx = tuple(
+            slice(-min(lo, 0) or None, min(hi, 0) or None)
+            for lo, hi in widths
+        )
+        return y[idx]
+
+
+def _pad_family(spatial: int):
+    """The four torch pad flavours for one spatial rank."""
+
+    class Zero(_Pad):
+        pass
+
+    class Constant(_Pad):
+        pass
+
+    class Reflection(_Pad):
+        mode = "reflect"
+
+        def __init__(self, padding):
+            super().__init__(padding)
+
+    class Replication(_Pad):
+        mode = "edge"
+
+        def __init__(self, padding):
+            super().__init__(padding)
+
+    class Circular(_Pad):
+        mode = "wrap"
+
+        def __init__(self, padding):
+            super().__init__(padding)
+
+    for cls in (Zero, Constant, Reflection, Replication, Circular):
+        cls.spatial = spatial
+    return Zero, Constant, Reflection, Replication, Circular
+
+
+ZeroPad1d, ConstantPad1d, ReflectionPad1d, ReplicationPad1d, CircularPad1d = _pad_family(1)
+ZeroPad2d, ConstantPad2d, ReflectionPad2d, ReplicationPad2d, CircularPad2d = _pad_family(2)
+ZeroPad3d, ConstantPad3d, ReflectionPad3d, ReplicationPad3d, CircularPad3d = _pad_family(3)
+for _c, _n in ((ZeroPad1d, "ZeroPad1d"), (ConstantPad1d, "ConstantPad1d"),
+               (ReflectionPad1d, "ReflectionPad1d"), (ReplicationPad1d, "ReplicationPad1d"),
+               (CircularPad1d, "CircularPad1d"),
+               (ZeroPad2d, "ZeroPad2d"), (ConstantPad2d, "ConstantPad2d"),
+               (ReflectionPad2d, "ReflectionPad2d"), (ReplicationPad2d, "ReplicationPad2d"),
+               (CircularPad2d, "CircularPad2d"),
+               (ZeroPad3d, "ZeroPad3d"), (ConstantPad3d, "ConstantPad3d"),
+               (ReflectionPad3d, "ReflectionPad3d"), (ReplicationPad3d, "ReplicationPad3d"),
+               (CircularPad3d, "CircularPad3d")):
+    _c.__name__ = _c.__qualname__ = _n
+
+
+# ---------------------------------------------------------------------- #
+# shuffles
+# ---------------------------------------------------------------------- #
+class PixelShuffle(Module):
+    """(N, C·r², H, W) -> (N, C, H·r, W·r) (torch sub-pixel layout)."""
+
+    def __init__(self, upscale_factor: int):
+        self.r = int(upscale_factor)
+
+    def apply(self, params, x, **kw):
+        *lead, crr, h, w = x.shape
+        r = self.r
+        if crr % (r * r):
+            raise ValueError(f"channels {crr} not divisible by r^2 = {r * r}")
+        c = crr // (r * r)
+        y = x.reshape(*lead, c, r, r, h, w)
+        k = len(lead)
+        # (..., c, r1, r2, h, w) -> (..., c, h, r1, w, r2)
+        y = y.transpose(*range(k), k, k + 3, k + 1, k + 4, k + 2)
+        return y.reshape(*lead, c, h * r, w * r)
+
+
+class PixelUnshuffle(Module):
+    """Inverse of :class:`PixelShuffle`."""
+
+    def __init__(self, downscale_factor: int):
+        self.r = int(downscale_factor)
+
+    def apply(self, params, x, **kw):
+        *lead, c, hr, wr = x.shape
+        r = self.r
+        if hr % r or wr % r:
+            raise ValueError(f"spatial dims ({hr}, {wr}) not divisible by r = {r}")
+        h, w = hr // r, wr // r
+        y = x.reshape(*lead, c, h, r, w, r)
+        k = len(lead)
+        # (..., c, h, r1, w, r2) -> (..., c, r1, r2, h, w)
+        y = y.transpose(*range(k), k, k + 2, k + 4, k + 1, k + 3)
+        return y.reshape(*lead, c * r * r, h, w)
+
+
+class ChannelShuffle(Module):
+    """(N, g·c, ...) -> interleave the g channel groups (ShuffleNet)."""
+
+    def __init__(self, groups: int):
+        self.groups = int(groups)
+
+    def apply(self, params, x, **kw):
+        ch = x.shape[1]
+        g = self.groups
+        if ch % g:
+            raise ValueError(f"channels {ch} not divisible by groups {g}")
+        shape = x.shape
+        return (x.reshape(shape[0], g, ch // g, *shape[2:])
+                 .swapaxes(1, 2)
+                 .reshape(shape))
+
+
+# ---------------------------------------------------------------------- #
+# adaptive pools — the shared divisible-case base lives in modules.py
+# (AdaptiveAvgPool2d is the same class at spatial=2)
+# ---------------------------------------------------------------------- #
+class AdaptiveMaxPool1d(_AdaptivePool):
+    spatial = 1
+    op = staticmethod(jnp.max)
+
+
+class AdaptiveMaxPool2d(_AdaptivePool):
+    spatial = 2
+    op = staticmethod(jnp.max)
+
+
+class AdaptiveMaxPool3d(_AdaptivePool):
+    spatial = 3
+    op = staticmethod(jnp.max)
+
+
+class AdaptiveAvgPool3d(_AdaptivePool):
+    spatial = 3

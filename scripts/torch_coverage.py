@@ -84,11 +84,8 @@ _out("the scan-based RNN/LSTM/GRU layers subsume per-step cells; decode paths us
 
 _out("remaining spatial variants of the implemented 1-D/2-D/3-D zoo: no "
      "reference-workload user (SURVEY §6 baselines are 2-D convnets); "
-     "adaptive-MAX pools and transposed convs follow the same "
-     "reduce_window / conv_transpose patterns when a workload needs them",
-     ["AdaptiveAvgPool3d", "AdaptiveMaxPool1d",
-      "AdaptiveMaxPool2d", "AdaptiveMaxPool3d",
-      "ConvTranspose1d", "ConvTranspose2d", "ConvTranspose3d",
+     "transposed convs follow lax.conv_transpose when a workload needs them",
+     ["ConvTranspose1d", "ConvTranspose2d", "ConvTranspose3d",
       "BatchNorm3d"])
 
 _out("exotic pooling with no reference-workload user; LPPool is a powered "
@@ -96,16 +93,6 @@ _out("exotic pooling with no reference-workload user; LPPool is a powered "
      "is stochastic — each is a contained addition if ever needed",
      ["LPPool1d", "LPPool2d", "LPPool3d", "MaxUnpool1d", "MaxUnpool2d",
       "MaxUnpool3d", "FractionalMaxPool2d", "FractionalMaxPool3d"])
-
-_out("jnp.pad exposes all of these as modes (constant/reflect/edge/wrap); a module "
-     "wrapper around a pure reshape-free op adds nothing in a functional API",
-     ["ZeroPad1d", "ZeroPad2d", "ZeroPad3d", "ConstantPad1d", "ConstantPad2d",
-      "ConstantPad3d", "ReflectionPad1d", "ReflectionPad2d", "ReflectionPad3d",
-      "ReplicationPad1d", "ReplicationPad2d", "ReplicationPad3d",
-      "CircularPad1d", "CircularPad2d", "CircularPad3d"])
-
-_out("single jnp.reshape/transpose expressions (pixel/channel shuffling)",
-     ["ChannelShuffle", "PixelShuffle", "PixelUnshuffle"])
 
 _out("lax.conv_general_dilated_patches is the JAX-native im2col; Fold/Unfold "
      "exist in torch to emulate what XLA fuses automatically",

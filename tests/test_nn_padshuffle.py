@@ -1,0 +1,116 @@
+"""Padding / shuffle / adaptive-max modules vs the torch.nn oracle
+(round-5 mirror completion; see heat_tpu/nn/padshuffle.py)."""
+
+import numpy as np
+import pytest
+import torch
+
+import heat_tpu as ht
+
+RNG = np.random.default_rng(11)
+
+
+def _x(spatial):
+    shape = {1: (2, 3, 9), 2: (2, 3, 6, 7), 3: (2, 3, 4, 5, 6)}[spatial]
+    return RNG.normal(size=shape).astype(np.float32)
+
+
+PADS = [
+    ("ZeroPad1d", 1, 2), ("ZeroPad1d", 1, (1, 3)),
+    ("ZeroPad2d", 2, 1), ("ZeroPad2d", 2, (1, 2, 0, 3)),
+    ("ZeroPad3d", 3, (1, 0, 2, 1, 0, 2)),
+    ("ReflectionPad1d", 1, 2), ("ReflectionPad2d", 2, (1, 2, 0, 3)),
+    ("ReflectionPad3d", 3, 1),
+    ("ReplicationPad1d", 1, 3), ("ReplicationPad2d", 2, (2, 0, 1, 1)),
+    ("ReplicationPad3d", 3, 1),
+    ("CircularPad1d", 1, 2), ("CircularPad2d", 2, (1, 2, 3, 0)),
+    ("CircularPad3d", 3, 1),
+]
+
+
+@pytest.mark.parametrize("name,spatial,pad", PADS,
+                         ids=[f"{n}-{p}" for n, _, p in PADS])
+def test_pad_matches_torch(name, spatial, pad):
+    x = _x(spatial)
+    got = np.asarray(getattr(ht.nn, name)(pad).apply((), x))
+    want = getattr(torch.nn, name)(pad)(torch.from_numpy(x)).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_constant_pad_value():
+    x = _x(2)
+    got = np.asarray(ht.nn.ConstantPad2d((1, 2, 0, 1), 7.5).apply((), x))
+    want = torch.nn.ConstantPad2d((1, 2, 0, 1), 7.5)(torch.from_numpy(x)).numpy()
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="per-side"):
+        ht.nn.ConstantPad2d((1, 2, 3))
+
+
+def test_pixel_shuffle_roundtrip_matches_torch():
+    x = RNG.normal(size=(2, 12, 3, 4)).astype(np.float32)
+    got = np.asarray(ht.nn.PixelShuffle(2).apply((), x))
+    want = torch.nn.PixelShuffle(2)(torch.from_numpy(x)).numpy()
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(ht.nn.PixelUnshuffle(2).apply((), got))
+    np.testing.assert_array_equal(back, x)
+    wantu = torch.nn.PixelUnshuffle(2)(torch.from_numpy(got)).numpy()
+    np.testing.assert_array_equal(back, wantu)
+    with pytest.raises(ValueError, match="divisible"):
+        ht.nn.PixelShuffle(5).apply((), x)
+
+
+def test_channel_shuffle_matches_torch():
+    x = RNG.normal(size=(2, 8, 3, 3)).astype(np.float32)
+    got = np.asarray(ht.nn.ChannelShuffle(4).apply((), x))
+    want = torch.nn.ChannelShuffle(4)(torch.from_numpy(x)).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name,spatial,out", [
+    ("AdaptiveMaxPool1d", 1, 3), ("AdaptiveMaxPool2d", 2, (3, 7)),
+    ("AdaptiveMaxPool3d", 3, (2, 5, 3)), ("AdaptiveAvgPool3d", 3, (2, 1, 2)),
+])
+def test_adaptive_pools_match_torch(name, spatial, out):
+    x = _x(spatial)
+    got = np.asarray(getattr(ht.nn, name)(out).apply((), x))
+    want = getattr(torch.nn, name)(out)(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_adaptive_divisibility_raises():
+    with pytest.raises(ValueError, match="divisible"):
+        ht.nn.AdaptiveMaxPool1d(4).apply((), _x(1))  # 9 rows / 4
+
+
+def test_negative_padding_crops_like_torch():
+    x = _x(2)
+    for pad in ((-1, 1, 0, 0), (-1, -2, 1, -1)):
+        got = np.asarray(ht.nn.ZeroPad2d(pad).apply((), x))
+        want = torch.nn.ZeroPad2d(pad)(torch.from_numpy(x)).numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+def test_pixel_shuffle_unbatched_and_5d():
+    x3 = RNG.normal(size=(12, 3, 4)).astype(np.float32)
+    got = np.asarray(ht.nn.PixelShuffle(2).apply((), x3))
+    want = torch.nn.PixelShuffle(2)(torch.from_numpy(x3)).numpy()
+    np.testing.assert_array_equal(got, want)
+    x5 = RNG.normal(size=(2, 2, 8, 3, 4)).astype(np.float32)
+    got = np.asarray(ht.nn.PixelShuffle(2).apply((), x5))
+    want = torch.nn.PixelShuffle(2)(torch.from_numpy(x5)).numpy()
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(ht.nn.PixelUnshuffle(2).apply((), got))
+    np.testing.assert_array_equal(back, x5)
+
+
+def test_adaptive_output_size_forms():
+    x = _x(2)  # (2, 3, 6, 7)
+    # list form and torch's None (= keep that dim)
+    got = np.asarray(ht.nn.AdaptiveMaxPool2d([3, 7]).apply((), x))
+    want = torch.nn.AdaptiveMaxPool2d([3, 7])(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    got = np.asarray(ht.nn.AdaptiveMaxPool2d((3, None)).apply((), x))
+    want = torch.nn.AdaptiveMaxPool2d((3, None))(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    with pytest.raises(ValueError, match="entries"):
+        ht.nn.AdaptiveMaxPool2d((3, 4, 5))
