@@ -6,7 +6,8 @@ from .activations import *
 from .losses import *
 from .spatial import *
 from .padshuffle import *
-from . import activations, losses, padshuffle, spatial
+from .extended import *
+from . import activations, extended, losses, padshuffle, spatial
 from .attention import MultiheadAttention, apply_rope
 from .moe import MoE
 from .pipelined import Pipelined

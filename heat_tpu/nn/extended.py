@@ -1,0 +1,208 @@
+"""LPPool, alpha dropouts, EmbeddingBag, Fold/Unfold — the torch.nn
+mirror's long tail (SURVEY §2.5; round-5 completion).
+
+Unfold is ``lax.conv_general_dilated_patches`` (whose channel ordering —
+(C, kh, kw) — matches torch's im2col exactly, verified by oracle); Fold
+is its VJP, which IS col2im.  EmbeddingBag reduces over
+``jax.ops.segment_sum``-style segments.  Oracle tests live in
+``tests/test_nn_padshuffle.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .modules import Module, _pair
+
+__all__ = [
+    "AlphaDropout", "EmbeddingBag", "FeatureAlphaDropout", "Fold",
+    "LPPool1d", "LPPool2d", "LPPool3d", "Unfold",
+]
+
+
+# ---------------------------------------------------------------------- #
+# LP pooling: (sum |x|^p over window)^(1/p) — torch computes sum of x^p
+# (sign-carrying for odd p); we follow torch's formula exactly
+# ---------------------------------------------------------------------- #
+class _LPPool(Module):
+    spatial: int = 1
+
+    def __init__(self, norm_type: float, kernel_size, stride=None):
+        n = self.spatial
+
+        def _tup(v):
+            return v if isinstance(v, tuple) else (v,) * n
+
+        self.norm_type = float(norm_type)
+        self.kernel_size = _tup(kernel_size)
+        self.stride = _tup(stride if stride is not None else kernel_size)
+
+    def apply(self, params, x, **kw):
+        n = self.spatial
+        p = self.norm_type
+        s = jax.lax.reduce_window(
+            x ** p, 0.0, jax.lax.add,
+            window_dimensions=(1, 1) + self.kernel_size,
+            window_strides=(1, 1) + self.stride,
+            padding="VALID",
+        )
+        # torch: relu before the root (negative window sums at odd p)
+        return jnp.maximum(s, 0.0) ** (1.0 / p)
+
+
+class LPPool1d(_LPPool):
+    spatial = 1
+
+
+class LPPool2d(_LPPool):
+    spatial = 2
+
+
+class LPPool3d(_LPPool):
+    spatial = 3
+
+
+# ---------------------------------------------------------------------- #
+# alpha dropouts (SELU-preserving)
+# ---------------------------------------------------------------------- #
+_ALPHA_PRIME = -1.7580993408473766  # -selu_scale * selu_alpha
+
+
+class AlphaDropout(Module):
+    """Dropout that preserves SELU self-normalizing statistics: dropped
+    units take the SELU saturation value alpha' and the output is affinely
+    rescaled so mean/var stay (0, 1) (torch formula)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def _mask_shape(self, x):
+        return x.shape
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        if not train or self.p == 0.0:
+            return x
+        if key is None:
+            raise ValueError("AlphaDropout in train mode requires a PRNG key")
+        keep = 1.0 - self.p
+        a = (keep + _ALPHA_PRIME**2 * keep * (1 - keep)) ** -0.5
+        b = -a * _ALPHA_PRIME * (1 - keep)
+        mask = jax.random.bernoulli(key, keep, self._mask_shape(x))
+        return a * jnp.where(mask, x, _ALPHA_PRIME) + b
+
+
+class FeatureAlphaDropout(AlphaDropout):
+    """AlphaDropout over whole channels ((N, C) mask broadcast over the
+    spatial dims, like Dropout2d vs Dropout)."""
+
+    def _mask_shape(self, x):
+        return x.shape[:2] + (1,) * (x.ndim - 2)
+
+
+# ---------------------------------------------------------------------- #
+# EmbeddingBag
+# ---------------------------------------------------------------------- #
+class EmbeddingBag(Module):
+    """Sum/mean/max reduction of embedding rows per bag (torch call
+    shapes: 2-D ``(B, L)`` indices without offsets, or 1-D indices with a
+    1-D ``offsets`` tensor of bag starts).  ``per_sample_weights`` is
+    supported for mode='sum' like torch."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 mode: str = "mean"):
+        if mode not in ("sum", "mean", "max"):
+            raise ValueError(f"mode must be sum/mean/max, got {mode!r}")
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.mode = mode
+
+    def init(self, key):
+        return {"weight": jax.random.normal(
+            key, (self.num_embeddings, self.embedding_dim))}
+
+    def apply(self, params, idx, offsets=None, per_sample_weights=None, **kw):
+        w = params["weight"]
+        if per_sample_weights is not None and self.mode != "sum":
+            raise ValueError("per_sample_weights requires mode='sum' (torch)")
+        idx = jnp.asarray(idx)
+        if offsets is None:
+            if idx.ndim != 2:
+                raise ValueError("without offsets, indices must be 2-D (B, L)")
+            rows = w[idx]  # (B, L, D)
+            if per_sample_weights is not None:
+                rows = rows * jnp.asarray(per_sample_weights)[..., None]
+            if self.mode == "sum":
+                return rows.sum(axis=1)
+            if self.mode == "mean":
+                return rows.mean(axis=1)
+            return rows.max(axis=1)
+        if idx.ndim != 1:
+            raise ValueError("with offsets, indices must be 1-D")
+        offsets = jnp.asarray(offsets)
+        if offsets.shape[0] and int(offsets[0]) != 0:
+            raise ValueError("offsets[0] has to be 0 (torch contract) — "
+                             "leading indices would silently fall outside "
+                             "every bag")
+        n_bags = offsets.shape[0]
+        # bag id of each index: how many offsets are <= position
+        pos = jnp.arange(idx.shape[0])
+        seg = jnp.searchsorted(offsets, pos, side="right") - 1
+        rows = w[idx]
+        if per_sample_weights is not None:
+            rows = rows * jnp.asarray(per_sample_weights)[:, None]
+        counts = jax.ops.segment_sum(jnp.ones_like(idx, jnp.float32), seg,
+                                     num_segments=n_bags)
+        if self.mode == "max":
+            mx = jax.ops.segment_max(rows, seg, num_segments=n_bags)
+            # empty bags: torch returns 0, segment_max's identity is -inf
+            return jnp.where(counts[:, None] > 0, mx, 0.0)
+        sums = jax.ops.segment_sum(rows, seg, num_segments=n_bags)
+        if self.mode == "sum":
+            return sums
+        return sums / jnp.maximum(counts, 1.0)[:, None]
+
+
+# ---------------------------------------------------------------------- #
+# Fold / Unfold (im2col / col2im)
+# ---------------------------------------------------------------------- #
+class Unfold(Module):
+    """im2col: (N, C, H, W) -> (N, C·kh·kw, L) patches (torch layout —
+    ``lax.conv_general_dilated_patches`` orders patch channels (C, kh, kw)
+    exactly like torch, verified by the oracle test)."""
+
+    def __init__(self, kernel_size, dilation=1, padding=0, stride=1):
+        self.kernel_size = _pair(kernel_size)
+        self.dilation = _pair(dilation)
+        self.padding = _pair(padding)
+        self.stride = _pair(stride)
+
+    def apply(self, params, x, **kw):
+        p = jax.lax.conv_general_dilated_patches(
+            x, filter_shape=self.kernel_size, window_strides=self.stride,
+            padding=[(q, q) for q in self.padding],
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        return p.reshape(p.shape[0], p.shape[1], -1)
+
+
+class Fold(Module):
+    """col2im: the exact inverse-scatter of :class:`Unfold` — implemented
+    as Unfold's VJP, which IS the column-to-image accumulation (overlaps
+    sum, torch semantics)."""
+
+    def __init__(self, output_size, kernel_size, dilation=1, padding=0,
+                 stride=1):
+        self.output_size = _pair(output_size)
+        self._unfold = Unfold(kernel_size, dilation, padding, stride)
+
+    def apply(self, params, cols, **kw):
+        n = cols.shape[0]
+        # infer C from the patch-channel extent
+        kh, kw = self._unfold.kernel_size
+        c = cols.shape[1] // (kh * kw)
+        x0 = jnp.zeros((n, c) + self.output_size, cols.dtype)
+        _, vjp = jax.vjp(lambda x: self._unfold.apply((), x), x0)
+        (out,) = vjp(cols.reshape(n, cols.shape[1], -1))
+        return out

@@ -25,6 +25,7 @@ __all__ = [
     "KLDivLoss", "L1Loss", "MSELoss", "MarginRankingLoss",
     "MultiLabelSoftMarginLoss", "MultiMarginLoss", "NLLLoss",
     "PoissonNLLLoss", "SmoothL1Loss", "SoftMarginLoss", "TripletMarginLoss",
+    "TripletMarginWithDistanceLoss",
 ]
 
 
@@ -222,14 +223,12 @@ class TripletMarginLoss(_Loss):
         self.swap = swap
 
     def _fn(self, anchor, positive, negative):
-        dist = PairwiseDistance(p=self.p, eps=self.eps)
-        a, p_, n = F._j(anchor), F._j(positive), F._j(negative)
-        d_pos = dist(a, p_)
-        d_neg = dist(a, n)
-        if self.swap:
-            d_neg = jnp.minimum(d_neg, dist(p_, n))
-        v = jnp.maximum(0.0, d_pos - d_neg + self.margin)
-        return F._reduce(v, self.reduction)
+        # one implementation of the triplet rule: the callable-distance
+        # variant, specialized with the torch pairwise p-norm
+        return TripletMarginWithDistanceLoss(
+            distance_function=PairwiseDistance(p=self.p, eps=self.eps),
+            margin=self.margin, swap=self.swap, reduction=self.reduction,
+        )._fn(anchor, positive, negative)
 
 
 class KLDivLoss(_Loss):
@@ -326,3 +325,30 @@ class CTCLoss(_Loss):
         return F._reduce(per_seq, self.reduction)
 
     _arity = 4
+
+
+class TripletMarginWithDistanceLoss(_Loss):
+    """TripletMarginLoss with a caller-supplied distance callable
+    (default: the torch pairwise Euclidean distance)."""
+
+    _arity = 3
+
+    def __init__(self, distance_function=None, margin: float = 1.0,
+                 swap: bool = False, reduction: str = "mean"):
+        super().__init__(reduction)
+        self.distance_function = (
+            distance_function if distance_function is not None
+            else PairwiseDistance()
+        )
+        self.margin = margin
+        self.swap = swap
+
+    def _fn(self, anchor, positive, negative):
+        d = self.distance_function
+        a, p_, n = F._j(anchor), F._j(positive), F._j(negative)
+        d_pos = d(a, p_)
+        d_neg = d(a, n)
+        if self.swap:
+            d_neg = jnp.minimum(d_neg, d(p_, n))
+        v = jnp.maximum(0.0, d_pos - d_neg + self.margin)
+        return F._reduce(v, self.reduction)

@@ -38,6 +38,7 @@ __all__ = [
     "AdaptiveAvgPool2d",
     "BatchNorm1d",
     "BatchNorm2d",
+    "BatchNorm3d",
     "LayerNorm",
     "RMSNorm",
     "GroupNorm",
@@ -409,6 +410,15 @@ class BatchNorm2d(_BatchNorm):
     def _axes(self, ndim: int) -> Tuple[int, ...]:
         if ndim != 4:
             raise ValueError(f"BatchNorm2d expects 4-D input, got {ndim}-D")
+        return super()._axes(ndim)
+
+
+class BatchNorm3d(_BatchNorm):
+    """BatchNorm over (N, C, D, H, W) input."""
+
+    def _axes(self, ndim: int) -> Tuple[int, ...]:
+        if ndim != 5:
+            raise ValueError(f"BatchNorm3d expects 5-D input, got {ndim}-D")
         return super()._axes(ndim)
 
 

@@ -10,6 +10,7 @@ the ``torch.nn`` oracle in ``tests/test_nn_activations.py``.
 
 from __future__ import annotations
 
+from math import prod
 from typing import Optional
 
 import jax
@@ -19,7 +20,8 @@ from .modules import AvgPool2d, Conv2d, MaxPool2d, Module
 
 __all__ = [
     "AdaptiveAvgPool1d", "AvgPool1d", "AvgPool3d", "Bilinear", "Conv1d",
-    "Conv3d", "CosineSimilarity", "LocalResponseNorm", "MaxPool1d",
+    "Conv3d", "ConvTranspose1d", "ConvTranspose2d", "ConvTranspose3d",
+    "CosineSimilarity", "LocalResponseNorm", "MaxPool1d",
     "MaxPool3d", "PairwiseDistance", "Upsample", "UpsamplingBilinear2d",
     "UpsamplingNearest2d",
 ]
@@ -302,3 +304,81 @@ class UpsamplingBilinear2d(Upsample):
 
     def __init__(self, size=None, scale_factor=None):
         super().__init__(scale_factor=scale_factor, size=size, mode="bilinear")
+
+
+class _ConvTransposeNd(Module):
+    """Rank-generic transposed convolution (torch semantics, groups=1).
+
+    Implemented as a FRACTIONALLY-STRIDED convolution — the gradient-of-conv
+    view: dilate the input by ``stride`` (lhs_dilation), flip the kernel and
+    swap its in/out axes, then run a unit-stride conv with per-edge padding
+    ``(k-1-p, k-1-p+output_padding)``, which reproduces torch's output size
+    ``(i-1)·s - 2p + k + output_padding``.  Weights keep torch's
+    ``(in, out, *k)`` transposed-conv layout."""
+
+    spatial: int = 2
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, output_padding=0, bias: bool = True):
+        n = self.spatial
+
+        def _tup(v):
+            return v if isinstance(v, tuple) else (v,) * n
+
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _tup(kernel_size)
+        self.stride = _tup(stride)
+        self.padding = _tup(padding)
+        self.output_padding = _tup(output_padding)
+        for op_, s in zip(self.output_padding, self.stride):
+            if op_ >= s:
+                raise ValueError("output_padding must be smaller than stride")
+        self.bias = bias
+
+    def init(self, key):
+        wk, bk = jax.random.split(key)
+        k = self.kernel_size
+        # torch ConvTransposeNd init: fan_in = out_channels * prod(k)
+        fan_in = self.out_channels * prod(k)
+        bound = 1.0 / jnp.sqrt(fan_in)
+        w = jax.random.uniform(
+            wk, (self.in_channels, self.out_channels) + k,
+            minval=-bound, maxval=bound,
+        )
+        if self.bias:
+            return {"weight": w,
+                    "bias": jax.random.uniform(bk, (self.out_channels,),
+                                               minval=-bound, maxval=bound)}
+        return {"weight": w}
+
+    _DIMNUMS = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+                3: ("NCDHW", "OIDHW", "NCDHW")}
+
+    def apply(self, params, x, **kw):
+        n = self.spatial
+        w = params["weight"]
+        # (I, O, *k) -> (O, I, *k) with every spatial axis flipped
+        w = jnp.swapaxes(w, 0, 1)[(slice(None), slice(None)) + (slice(None, None, -1),) * n]
+        pad = [(k - 1 - p, k - 1 - p + op_)
+               for k, p, op_ in zip(self.kernel_size, self.padding, self.output_padding)]
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * n, padding=pad,
+            lhs_dilation=self.stride,
+            dimension_numbers=self._DIMNUMS[n],
+        )
+        if self.bias:
+            y = y + params["bias"].reshape((1, -1) + (1,) * n)
+        return y
+
+
+class ConvTranspose1d(_ConvTransposeNd):
+    spatial = 1
+
+
+class ConvTranspose2d(_ConvTransposeNd):
+    spatial = 2
+
+
+class ConvTranspose3d(_ConvTransposeNd):
+    spatial = 3

@@ -82,37 +82,19 @@ _out("the scan-based RNN/LSTM/GRU layers subsume per-step cells; decode paths us
      "explicit carry/caches instead of cell objects",
      ["RNNBase", "RNNCell", "RNNCellBase", "LSTMCell", "GRUCell"])
 
-_out("remaining spatial variants of the implemented 1-D/2-D/3-D zoo: no "
-     "reference-workload user (SURVEY §6 baselines are 2-D convnets); "
-     "transposed convs follow lax.conv_transpose when a workload needs them",
-     ["ConvTranspose1d", "ConvTranspose2d", "ConvTranspose3d",
-      "BatchNorm3d"])
-
-_out("exotic pooling with no reference-workload user; LPPool is a powered "
-     "reduce_window, MaxUnpool needs argmax indices torch-style, FractionalMaxPool "
-     "is stochastic — each is a contained addition if ever needed",
-     ["LPPool1d", "LPPool2d", "LPPool3d", "MaxUnpool1d", "MaxUnpool2d",
-      "MaxUnpool3d", "FractionalMaxPool2d", "FractionalMaxPool3d"])
-
-_out("lax.conv_general_dilated_patches is the JAX-native im2col; Fold/Unfold "
-     "exist in torch to emulate what XLA fuses automatically",
-     ["Fold", "Unfold"])
+_out("MaxUnpool needs torch-style argmax indices threaded from the pool, "
+     "FractionalMaxPool is a stochastic-grid pool — no reference-workload "
+     "user for either",
+     ["MaxUnpool1d", "MaxUnpool2d", "MaxUnpool3d",
+      "FractionalMaxPool2d", "FractionalMaxPool3d"])
 
 _out("remaining long-tail criteria outside the reference's exercised surface; "
      "the _Loss pattern in losses.py makes each a ~10-line addition "
-     "(TripletMarginWithDistanceLoss: TripletMarginLoss with a callable d; "
-     "MultiLabelMarginLoss: MultiMarginLoss summed over a label SET; "
+     "(MultiLabelMarginLoss: MultiMarginLoss summed over a label SET; "
      "AdaptiveLogSoftmax/LinearCrossEntropy: fused softmax variants XLA "
      "fuses on its own)",
      ["AdaptiveLogSoftmaxWithLoss", "LinearCrossEntropyLoss",
-      "MultiLabelMarginLoss", "TripletMarginWithDistanceLoss"])
-
-_out("SELU-coupled dropout variants that rescale to preserve self-normalizing "
-     "statistics; no SELU workload in the reference baselines",
-     ["AlphaDropout", "FeatureAlphaDropout"])
-
-_out("sparse-gradient bag-reduction of Embedding rows; segment_sum one-liner, "
-     "no reference workload", ["EmbeddingBag"])
+      "MultiLabelMarginLoss"])
 
 
 def nn_rows():

@@ -77,9 +77,198 @@ def test_adaptive_pools_match_torch(name, spatial, out):
     np.testing.assert_allclose(got, want, atol=1e-6)
 
 
+class TestExtended:
+    """LPPool / alpha dropouts / EmbeddingBag / Fold-Unfold /
+    TripletMarginWithDistanceLoss vs torch (heat_tpu/nn/extended.py)."""
+
+    @pytest.mark.parametrize("name,spatial,args", [
+        ("LPPool1d", 1, (2.0, 3)), ("LPPool1d", 1, (1.5, 2, 1)),
+        ("LPPool2d", 2, (2.0, 2)), ("LPPool2d", 2, (3.0, (2, 3))),
+        ("LPPool3d", 3, (2.0, 2)),
+    ])
+    def test_lppool_matches_torch(self, name, spatial, args):
+        x = np.abs(_x(spatial))  # positive inputs: fair p-th-power ground
+        got = np.asarray(getattr(ht.nn, name)(*args).apply((), x))
+        want = getattr(torch.nn, name)(*args)(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_alpha_dropout_statistics(self):
+        import jax
+
+        x = RNG.normal(size=(2000, 64)).astype(np.float32)
+        m = ht.nn.AlphaDropout(p=0.3)
+        assert (np.asarray(m.apply((), x)) == x).all()  # eval = identity
+        y = np.asarray(m.apply((), x, train=True, key=jax.random.key(0)))
+        # self-normalizing contract: mean ~ 0, var ~ 1 preserved
+        assert abs(y.mean()) < 0.05 and abs(y.var() - 1.0) < 0.1
+        # dropped positions carry the affine-shifted SELU saturation value
+        vals, counts = np.unique(np.round(y, 5), return_counts=True)
+        assert counts.max() > 0.2 * y.size  # one repeated saturation value
+        with pytest.raises(ValueError, match="PRNG key"):
+            m.apply((), x, train=True)
+
+    def test_feature_alpha_dropout_channelwise(self):
+        import jax
+
+        x = RNG.normal(size=(4, 8, 5, 5)).astype(np.float32)
+        y = np.asarray(ht.nn.FeatureAlphaDropout(0.5).apply(
+            (), x, train=True, key=jax.random.key(1)))
+        # each (n, c) slice is either fully transformed-identity or fully
+        # saturated: per-channel std of the "dropped" channels is ~0
+        per = y.reshape(4, 8, -1)
+        stds = per.std(axis=2)
+        assert (stds < 1e-4).any() and (stds > 0.1).any()
+
+    @pytest.mark.parametrize("mode", ["sum", "mean", "max"])
+    def test_embedding_bag_2d_matches_torch(self, mode):
+        import jax
+
+        m = ht.nn.EmbeddingBag(11, 6, mode=mode)
+        p = m.init(jax.random.key(0))
+        t = torch.nn.EmbeddingBag(11, 6, mode=mode)
+        with torch.no_grad():
+            t.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+        idx = RNG.integers(0, 11, size=(5, 4)).astype(np.int64)
+        got = np.asarray(m.apply(p, idx))
+        want = t(torch.from_numpy(idx)).detach().numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["sum", "mean", "max"])
+    def test_embedding_bag_offsets_matches_torch(self, mode):
+        import jax
+
+        m = ht.nn.EmbeddingBag(11, 6, mode=mode)
+        p = m.init(jax.random.key(0))
+        t = torch.nn.EmbeddingBag(11, 6, mode=mode)
+        with torch.no_grad():
+            t.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+        idx = RNG.integers(0, 11, size=10).astype(np.int64)
+        offsets = np.array([0, 3, 3, 7], dtype=np.int64)  # incl. empty bag
+        got = np.asarray(m.apply(p, idx, offsets=offsets))
+        want = t(torch.from_numpy(idx), torch.from_numpy(offsets)).detach().numpy()
+        # incl. the empty bag: torch returns 0 there for every mode and so
+        # do we (segment_max's -inf identity is masked to 0)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        assert np.isfinite(got).all()
+        with pytest.raises(ValueError, match="offsets"):
+            m.apply(p, idx, offsets=np.array([1, 3], dtype=np.int64))
+
+    def test_embedding_bag_per_sample_weights(self):
+        import jax
+
+        m = ht.nn.EmbeddingBag(7, 4, mode="sum")
+        p = m.init(jax.random.key(0))
+        t = torch.nn.EmbeddingBag(7, 4, mode="sum")
+        with torch.no_grad():
+            t.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+        idx = RNG.integers(0, 7, size=(3, 5)).astype(np.int64)
+        psw = RNG.uniform(size=(3, 5)).astype(np.float32)
+        got = np.asarray(m.apply(p, idx, per_sample_weights=psw))
+        want = t(torch.from_numpy(idx),
+                 per_sample_weights=torch.from_numpy(psw)).detach().numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        with pytest.raises(ValueError, match="mode='sum'"):
+            ht.nn.EmbeddingBag(7, 4, mode="mean").apply(p, idx, per_sample_weights=psw)
+
+    @pytest.mark.parametrize("kwargs", [
+        dict(), dict(stride=2), dict(padding=1), dict(dilation=2),
+        dict(stride=2, padding=1, dilation=2),
+    ])
+    def test_unfold_matches_torch(self, kwargs):
+        x = RNG.normal(size=(2, 3, 8, 9)).astype(np.float32)
+        got = np.asarray(ht.nn.Unfold(3, **kwargs).apply((), x))
+        want = torch.nn.Unfold(3, **kwargs)(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_fold_matches_torch(self):
+        x = RNG.normal(size=(2, 3 * 9, 9)).astype(np.float32)  # L = 3x3
+        got = np.asarray(ht.nn.Fold((6, 6), 3, padding=1, stride=2).apply((), x))
+        want = torch.nn.Fold((6, 6), 3, padding=1, stride=2)(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # fold(unfold(x)) sums overlaps — the torch-documented identity
+        img = RNG.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        cols = ht.nn.Unfold(2).apply((), img)
+        back = np.asarray(ht.nn.Fold((4, 4), 2).apply((), cols))
+        wantb = torch.nn.Fold((4, 4), 2)(
+            torch.nn.Unfold(2)(torch.from_numpy(img))).numpy()
+        np.testing.assert_allclose(back, wantb, atol=1e-6)
+
+    def test_triplet_with_distance_matches_torch(self):
+        a = RNG.normal(size=(6, 5)).astype(np.float32)
+        p_ = RNG.normal(size=(6, 5)).astype(np.float32)
+        n = RNG.normal(size=(6, 5)).astype(np.float32)
+        m = ht.nn.TripletMarginWithDistanceLoss(margin=0.7, swap=True)
+        t = torch.nn.TripletMarginWithDistanceLoss(margin=0.7, swap=True)
+        np.testing.assert_allclose(
+            np.asarray(m(a, p_, n)),
+            t(torch.from_numpy(a), torch.from_numpy(p_), torch.from_numpy(n)).numpy(),
+            rtol=1e-4, atol=1e-5)
+        # custom callable distance
+        cos_d = lambda u, v: 1.0 - ht.nn.CosineSimilarity(dim=-1)(u, v)
+        tcos = torch.nn.TripletMarginWithDistanceLoss(
+            distance_function=lambda u, v: 1.0 - torch.nn.functional.cosine_similarity(u, v))
+        mcos = ht.nn.TripletMarginWithDistanceLoss(distance_function=cos_d)
+        np.testing.assert_allclose(
+            np.asarray(mcos(a, p_, n)),
+            tcos(torch.from_numpy(a), torch.from_numpy(p_), torch.from_numpy(n)).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+
 def test_adaptive_divisibility_raises():
     with pytest.raises(ValueError, match="divisible"):
         ht.nn.AdaptiveMaxPool1d(4).apply((), _x(1))  # 9 rows / 4
+
+
+CONVT = [
+    ("ConvTranspose1d", (2, 3, 9), dict(stride=1, padding=0)),
+    ("ConvTranspose1d", (2, 3, 9), dict(stride=2, padding=1, output_padding=1)),
+    ("ConvTranspose2d", (2, 3, 6, 7), dict(stride=1, padding=1)),
+    ("ConvTranspose2d", (2, 3, 6, 7), dict(stride=2, padding=0)),
+    ("ConvTranspose2d", (2, 3, 6, 7), dict(stride=3, padding=2, output_padding=1)),
+    ("ConvTranspose3d", (1, 2, 4, 5, 6), dict(stride=2, padding=1)),
+]
+
+
+@pytest.mark.parametrize("name,shape,kwargs", CONVT,
+                         ids=[f"{n}-{k}" for n, _, k in CONVT])
+def test_conv_transpose_matches_torch(name, shape, kwargs):
+    import jax
+
+    x = RNG.normal(size=shape).astype(np.float32)
+    m = getattr(ht.nn, name)(shape[1], 4, 3, **kwargs)
+    p = m.init(jax.random.key(0))
+    t = getattr(torch.nn, name)(shape[1], 4, 3, **kwargs)
+    with torch.no_grad():
+        t.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+        t.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+    got = np.asarray(m.apply(p, x))
+    want = t(torch.from_numpy(x)).detach().numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_conv_transpose_validation():
+    with pytest.raises(ValueError, match="output_padding"):
+        ht.nn.ConvTranspose2d(3, 4, 3, stride=1, output_padding=1)
+    m = ht.nn.ConvTranspose1d(3, 4, 3, bias=False)
+    import jax
+
+    assert "bias" not in m.init(jax.random.key(0))
+
+
+def test_batchnorm3d_matches_torch():
+    import jax
+
+    x = RNG.normal(size=(2, 3, 4, 5, 6)).astype(np.float32)
+    m = ht.nn.BatchNorm3d(3)
+    p = m.init(jax.random.key(0))
+    t = torch.nn.BatchNorm3d(3)
+    # train-mode normalization (batch statistics)
+    got = np.asarray(m.apply(p, x, train=True))
+    want = t(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    with pytest.raises(ValueError, match="5-D"):
+        m.apply(p, x[0], train=True)
 
 
 def test_negative_padding_crops_like_torch():
