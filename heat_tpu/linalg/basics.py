@@ -107,10 +107,13 @@ def _matmul_result_split(sa: Optional[int], sb: Optional[int], nd_out: int) -> O
 # cached measurements on the 8-device CPU mesh (min of 4-5 reps, both
 # orders): 1024 -> GSPMD 1.32x, 2048 -> GSPMD 1.04-1.14x, 4096 -> SUMMA
 # 1.14x.  r4d's recorded 0.708 at 2048 was a one-shot ordering artifact —
-# the pair is at parity there.  No TPU entry: multi-chip hardware is not
-# measurable in this environment, and GSPMD's collective-matmul fusion is
-# the principled TPU default; bench.py re-measures the pair every round,
-# and `scripts/bench_compare.py` flags drift.
+# the pair is at parity there.  p=4 cpu mesh (same methodology): 1.20 at
+# 1024, 1.01 at 2048/4096 — GSPMD wins or ties everywhere, so no entry
+# (ties go to GSPMD, the fused default).  No TPU entry: multi-chip
+# hardware is not measurable in this environment, and GSPMD's
+# collective-matmul fusion is the principled TPU default; bench.py
+# re-measures the pair every round, and `scripts/bench_compare.py` flags
+# drift.
 _SUMMA_DISPATCH = {("cpu", 8): 4096}
 
 
