@@ -17,7 +17,8 @@ from .modules import Module, _pair
 
 __all__ = [
     "AlphaDropout", "EmbeddingBag", "FeatureAlphaDropout", "Fold",
-    "LPPool1d", "LPPool2d", "LPPool3d", "Unfold",
+    "LPPool1d", "LPPool2d", "LPPool3d", "MaxUnpool1d", "MaxUnpool2d",
+    "MaxUnpool3d", "Unfold",
 ]
 
 
@@ -206,3 +207,66 @@ class Fold(Module):
         _, vjp = jax.vjp(lambda x: self._unfold.apply((), x), x0)
         (out,) = vjp(cols.reshape(n, cols.shape[1], -1))
         return out
+
+
+# ---------------------------------------------------------------------- #
+# MaxUnpool: scatter pooled values back to their argmax positions
+# ---------------------------------------------------------------------- #
+class _MaxUnpool(Module):
+    """Inverse of ``MaxPoolNd(return_indices=True)``: values land at their
+    recorded flat indices, everything else is 0 (torch semantics).  Default
+    output extent per dim is ``(i-1)·stride + kernel``; pass
+    ``output_size=`` at call time to disambiguate (torch contract)."""
+
+    spatial: int = 2
+
+    def __init__(self, kernel_size, stride=None):
+        n = self.spatial
+
+        def _tup(v):
+            return v if isinstance(v, tuple) else (v,) * n
+
+        self.kernel_size = _tup(kernel_size)
+        self.stride = _tup(stride if stride is not None else kernel_size)
+
+    def apply(self, params, x, indices=None, output_size=None, **kw):
+        if indices is None:
+            raise ValueError("MaxUnpool requires the indices from "
+                             "MaxPool(return_indices=True)")
+        n = self.spatial
+        if output_size is None:
+            output_size = tuple(
+                (i - 1) * s + k
+                for i, s, k in zip(x.shape[2:], self.stride, self.kernel_size)
+            )
+        output_size = tuple(output_size)
+        if len(output_size) == x.ndim:  # torch also accepts the full shape
+            output_size = output_size[2:]
+        if len(output_size) != n:
+            raise ValueError(
+                f"output_size must have {n} (spatial) or {n + 2} (full shape) "
+                f"entries, got {len(output_size)}"
+            )
+        N, C = x.shape[:2]
+        from math import prod
+
+        L = prod(output_size)
+        vals = x.reshape(N, C, -1)
+        idx = jnp.asarray(indices).reshape(N, C, -1)
+        out = jnp.zeros((N, C, L), x.dtype)
+        out = out.at[
+            jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None], idx
+        ].set(vals)
+        return out.reshape(N, C, *output_size)
+
+
+class MaxUnpool1d(_MaxUnpool):
+    spatial = 1
+
+
+class MaxUnpool2d(_MaxUnpool):
+    spatial = 2
+
+
+class MaxUnpool3d(_MaxUnpool):
+    spatial = 3

@@ -263,8 +263,52 @@ class _Pool2d(Module):
         self.stride = _pair(stride if stride is not None else kernel_size)
 
 
+def _max_pool_indices(x, kernel, stride, rank):
+    """Max pooling that ALSO returns torch-convention indices: each output
+    position's flat index into its channel's spatial plane (what
+    ``MaxUnpoolNd`` consumes).  The flat index is derived ARITHMETICALLY
+    from the within-window argmax (window start = out_pos·stride, plus the
+    row-major in-window offset), all in integer math — exact at any plane
+    size, and no second patches pass over an index plane."""
+    from math import prod
+
+    dn = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+          3: ("NCDHW", "OIDHW", "NCDHW")}[rank]
+    spatial = x.shape[2:]
+    p = jax.lax.conv_general_dilated_patches(
+        x, kernel, stride, [(0, 0)] * rank, dimension_numbers=dn
+    )
+    px = p.reshape(p.shape[0], x.shape[1], prod(kernel), *p.shape[2:])
+    am = jnp.argmax(px, axis=2)  # (N, C, *out_spatial), row-major in-window
+    vals = jnp.take_along_axis(px, am[:, :, None], axis=2)[:, :, 0]
+
+    # decompose am row-major over the kernel dims (the patches layout)
+    offs, rem = [], am
+    for kd in reversed(kernel):
+        offs.append(rem % kd)
+        rem = rem // kd
+    offs = offs[::-1]
+    idx = jnp.zeros_like(am)
+    plane = 1
+    out_spatial = am.shape[2:]
+    for d in reversed(range(rank)):
+        pos = jnp.arange(out_spatial[d]).reshape(
+            (1, 1) + (1,) * d + (-1,) + (1,) * (rank - 1 - d)
+        )
+        idx = idx + (pos * stride[d] + offs[d]) * plane
+        plane *= spatial[d]
+    return vals, idx.astype(jnp.int32)
+
+
 class MaxPool2d(_Pool2d):
+    def __init__(self, kernel_size: int, stride: Optional[int] = None,
+                 return_indices: bool = False):
+        super().__init__(kernel_size, stride)
+        self.return_indices = return_indices
+
     def apply(self, params, x, **kw):
+        if self.return_indices:
+            return _max_pool_indices(x, self.kernel_size, self.stride, 2)
         return jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max,
             window_dimensions=(1, 1) + self.kernel_size,

@@ -67,6 +67,20 @@ class _Pool1dVia2d(Module):
 class MaxPool1d(_Pool1dVia2d):
     pool2d_cls = MaxPool2d
 
+    def __init__(self, kernel_size: int, stride: Optional[int] = None,
+                 return_indices: bool = False):
+        super().__init__(kernel_size, stride)
+        self.return_indices = return_indices
+        self._k = (int(kernel_size),)
+        self._s = (int(stride if stride is not None else kernel_size),)
+
+    def apply(self, params, x, **kw):
+        if self.return_indices:
+            from .modules import _max_pool_indices
+
+            return _max_pool_indices(x, self._k, self._s, 1)
+        return super().apply(params, x, **kw)
+
 
 class AvgPool1d(_Pool1dVia2d):
     pool2d_cls = AvgPool2d
@@ -220,7 +234,15 @@ class _Pool3d(Module):
 
 
 class MaxPool3d(_Pool3d):
+    def __init__(self, kernel_size, stride=None, return_indices: bool = False):
+        super().__init__(kernel_size, stride)
+        self.return_indices = return_indices
+
     def apply(self, params, x, **kw):
+        if self.return_indices:
+            from .modules import _max_pool_indices
+
+            return _max_pool_indices(x, self.kernel_size, self.stride, 3)
         return jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max,
             window_dimensions=(1, 1) + self.kernel_size,

@@ -82,11 +82,8 @@ _out("the scan-based RNN/LSTM/GRU layers subsume per-step cells; decode paths us
      "explicit carry/caches instead of cell objects",
      ["RNNBase", "RNNCell", "RNNCellBase", "LSTMCell", "GRUCell"])
 
-_out("MaxUnpool needs torch-style argmax indices threaded from the pool, "
-     "FractionalMaxPool is a stochastic-grid pool — no reference-workload "
-     "user for either",
-     ["MaxUnpool1d", "MaxUnpool2d", "MaxUnpool3d",
-      "FractionalMaxPool2d", "FractionalMaxPool3d"])
+_out("FractionalMaxPool is a stochastic-grid pool — no reference-workload "
+     "user", ["FractionalMaxPool2d", "FractionalMaxPool3d"])
 
 _out("remaining long-tail criteria outside the reference's exercised surface; "
      "the _Loss pattern in losses.py makes each a ~10-line addition "

@@ -193,6 +193,43 @@ class TestExtended:
             torch.nn.Unfold(2)(torch.from_numpy(img))).numpy()
         np.testing.assert_allclose(back, wantb, atol=1e-6)
 
+    @pytest.mark.parametrize("rank,shape,k", [
+        (1, (2, 3, 9), 3), (2, (2, 3, 6, 8), 2), (3, (1, 2, 4, 4, 6), 2),
+    ])
+    def test_maxpool_indices_and_unpool_match_torch(self, rank, shape, k):
+        x = RNG.normal(size=shape).astype(np.float32)
+        pool_name = f"MaxPool{rank}d"
+        unpool_name = f"MaxUnpool{rank}d"
+        y, idx = getattr(ht.nn, pool_name)(k, return_indices=True).apply((), x)
+        ty, tidx = getattr(torch.nn, pool_name)(k, return_indices=True)(
+            torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(idx), tidx.numpy())
+        # unpool scatters back to the recorded positions
+        got = np.asarray(getattr(ht.nn, unpool_name)(k).apply(
+            (), np.asarray(y), indices=np.asarray(idx),
+            output_size=x.shape[2:]))
+        want = getattr(torch.nn, unpool_name)(k)(
+            ty, tidx, output_size=x.shape).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_maxunpool_validation_and_default_size(self):
+        x = RNG.normal(size=(2, 3, 6, 8)).astype(np.float32)
+        y, idx = ht.nn.MaxPool2d(2, return_indices=True).apply((), x)
+        out = np.asarray(ht.nn.MaxUnpool2d(2).apply((), np.asarray(y),
+                                                    indices=np.asarray(idx)))
+        assert out.shape == (2, 3, 6, 8)  # (i-1)*s + k
+        # torch also accepts the FULL (N, C, *spatial) shape as output_size
+        out2 = np.asarray(ht.nn.MaxUnpool2d(2).apply(
+            (), np.asarray(y), indices=np.asarray(idx), output_size=x.shape))
+        np.testing.assert_array_equal(out2, out)
+        with pytest.raises(ValueError, match="indices"):
+            ht.nn.MaxUnpool2d(2).apply((), np.asarray(y))
+        with pytest.raises(ValueError, match="entries"):
+            ht.nn.MaxUnpool2d(2).apply((), np.asarray(y),
+                                       indices=np.asarray(idx),
+                                       output_size=(6,))
+
     def test_triplet_with_distance_matches_torch(self):
         a = RNG.normal(size=(6, 5)).astype(np.float32)
         p_ = RNG.normal(size=(6, 5)).astype(np.float32)
