@@ -247,6 +247,15 @@ class _MaxUnpool(Module):
                 f"output_size must have {n} (spatial) or {n + 2} (full shape) "
                 f"entries, got {len(output_size)}"
             )
+        for d, (o, i, s, k) in enumerate(
+            zip(output_size, x.shape[2:], self.stride, self.kernel_size)
+        ):
+            default = (i - 1) * s + k
+            if not default - k <= o <= default + k:  # torch's accepted band
+                raise ValueError(
+                    f"invalid output_size {tuple(output_size)}: dim {d} must "
+                    f"be between {default - k} and {default + k}"
+                )
         N, C = x.shape[:2]
         from math import prod
 

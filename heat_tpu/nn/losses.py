@@ -23,7 +23,7 @@ __all__ = [
     "BCELoss", "BCEWithLogitsLoss", "CTCLoss", "CosineEmbeddingLoss",
     "CrossEntropyLoss", "GaussianNLLLoss", "HingeEmbeddingLoss", "HuberLoss",
     "KLDivLoss", "L1Loss", "MSELoss", "MarginRankingLoss",
-    "MultiLabelSoftMarginLoss", "MultiMarginLoss", "NLLLoss",
+    "MultiLabelMarginLoss", "MultiLabelSoftMarginLoss", "MultiMarginLoss", "NLLLoss",
     "PoissonNLLLoss", "SmoothL1Loss", "SoftMarginLoss", "TripletMarginLoss",
     "TripletMarginWithDistanceLoss",
 ]
@@ -351,4 +351,38 @@ class TripletMarginWithDistanceLoss(_Loss):
         if self.swap:
             d_neg = jnp.minimum(d_neg, d(p_, n))
         v = jnp.maximum(0.0, d_pos - d_neg + self.margin)
+        return F._reduce(v, self.reduction)
+
+
+class MultiLabelMarginLoss(_Loss):
+    """Label-SET margin (torch formula): for each sample,
+    ``Σ_{j∈targets} Σ_{i∉targets} max(0, 1 - (x[y_j] - x[i])) / C`` where
+    the target row lists class indices and the first -1 terminates it."""
+
+    def _fn(self, pred, target):
+        x = F._j(pred)
+        y = F._j(target).astype(jnp.int32)
+        if x.ndim == 1:
+            x, y = x[None], y[None]
+            squeeze = True
+        else:
+            squeeze = False
+        C = x.shape[-1]
+        # valid targets: before the first -1 (torch contract)
+        first_neg = jnp.cumsum(y < 0, axis=-1) > 0
+        valid = ~first_neg
+        y_safe = jnp.where(valid, y, 0)
+        # membership mask: class c is in the sample's target set
+        member = jnp.zeros(x.shape, bool)
+        member = member.at[
+            jnp.arange(x.shape[0])[:, None], y_safe
+        ].max(valid)
+        xy = jnp.take_along_axis(x, y_safe, axis=-1)  # (N, T) target scores
+        # hinge for every (target j, class i) pair, masked to j valid, i not
+        # in the target set
+        h = jnp.maximum(0.0, 1.0 - (xy[:, :, None] - x[:, None, :]))
+        mask = valid[:, :, None] & ~member[:, None, :]
+        v = (h * mask).sum(axis=(1, 2)) / C
+        if squeeze:
+            v = v[0]
         return F._reduce(v, self.reduction)

@@ -87,11 +87,9 @@ _out("FractionalMaxPool is a stochastic-grid pool — no reference-workload "
 
 _out("remaining long-tail criteria outside the reference's exercised surface; "
      "the _Loss pattern in losses.py makes each a ~10-line addition "
-     "(MultiLabelMarginLoss: MultiMarginLoss summed over a label SET; "
-     "AdaptiveLogSoftmax/LinearCrossEntropy: fused softmax variants XLA "
+     "(AdaptiveLogSoftmax/LinearCrossEntropy: fused softmax variants XLA "
      "fuses on its own)",
-     ["AdaptiveLogSoftmaxWithLoss", "LinearCrossEntropyLoss",
-      "MultiLabelMarginLoss"])
+     ["AdaptiveLogSoftmaxWithLoss", "LinearCrossEntropyLoss"])
 
 
 def nn_rows():

@@ -313,6 +313,27 @@ class TestExtendedLosses:
             ht.nn.MultiMarginLoss(p=3)
 
     @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_multilabel_margin(self, reduction):
+        """Label-set margin with -1-terminated target rows (torch contract),
+        incl. an empty target set and a full target set."""
+        x = RNG.normal(size=(4, 5)).astype(np.float32)
+        y = np.array([[2, 4, -1, 0, 0],
+                      [0, 1, 2, 3, 4],
+                      [-1, 2, 3, 0, 0],   # empty set: -1 terminates first
+                      [3, -1, -1, -1, -1]], dtype=np.int64)
+        m = ht.nn.MultiLabelMarginLoss(reduction=reduction)
+        t = torch.nn.MultiLabelMarginLoss(reduction=reduction)
+        np.testing.assert_allclose(
+            np.asarray(m(x, y)),
+            t(torch.from_numpy(x), torch.from_numpy(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        # unbatched 1-D form
+        np.testing.assert_allclose(
+            np.asarray(m(x[0], y[0])),
+            t(torch.from_numpy(x[0]), torch.from_numpy(y[0])).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
     def test_ctc_matches_torch(self, reduction):
         """CTC via optax forward-backward vs torch's native implementation:
         padded 2-D targets, ragged input/target lengths, blank=0."""

@@ -229,6 +229,12 @@ class TestExtended:
             ht.nn.MaxUnpool2d(2).apply((), np.asarray(y),
                                        indices=np.asarray(idx),
                                        output_size=(6,))
+        # out-of-band output_size raises (torch contract), never a silent
+        # partial scatter
+        with pytest.raises(ValueError, match="must be between"):
+            ht.nn.MaxUnpool2d(2).apply((), np.asarray(y),
+                                       indices=np.asarray(idx),
+                                       output_size=(3, 3))
 
     def test_triplet_with_distance_matches_torch(self):
         a = RNG.normal(size=(6, 5)).astype(np.float32)
