@@ -195,15 +195,9 @@ class MultiheadAttention(Module):
         slot).
         """
         E = self.embed_dim
-        idx = cache["index"]
-        # concreteness probe that survives JAX upgrades: int() raises the
-        # public Tracer*Error family on traced values (jax.core.Tracer is a
-        # deprecated access path)
-        try:
-            i = int(idx)
-        except (jax.errors.TracerIntegerConversionError,
-                jax.errors.ConcretizationTypeError, TypeError):
-            i = None
+        from .modules import _concrete_int
+
+        i = _concrete_int(cache["index"])
         if i is not None and i >= cache["k"].shape[2]:
             raise ValueError(
                 f"decode_step past cache capacity: index {i} >= "

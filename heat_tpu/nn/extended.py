@@ -141,7 +141,12 @@ class EmbeddingBag(Module):
         if idx.ndim != 1:
             raise ValueError("with offsets, indices must be 1-D")
         offsets = jnp.asarray(offsets)
-        if offsets.shape[0] and int(offsets[0]) != 0:
+        from .modules import _concrete_int
+
+        # traced offsets (inside jit): the guard can't fire — _concrete_int
+        # returns None there
+        first = _concrete_int(offsets[0]) if offsets.shape[0] else 0
+        if first not in (0, None):
             raise ValueError("offsets[0] has to be 0 (torch contract) — "
                              "leading indices would silently fall outside "
                              "every bag")

@@ -52,6 +52,21 @@ def _pair(v) -> Tuple[int, int]:
     return v if isinstance(v, tuple) else (v, v)
 
 
+def _concrete_int(x):
+    """``int(x)`` when ``x`` is concrete, else ``None`` — the probe jit-safe
+    eager validations share (traced values raise the public Tracer*Error
+    family; ``jax.core.Tracer`` isinstance checks are a deprecated path).
+    Used by the decode-step capacity guard and EmbeddingBag's offsets
+    check."""
+    import jax
+
+    try:
+        return int(x)
+    except (jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError, TypeError):
+        return None
+
+
 def _module_accepts_train(module) -> bool:
     """Whether ``module.apply`` should be called with ``train=``/``key=``.
 

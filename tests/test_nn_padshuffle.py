@@ -153,6 +153,21 @@ class TestExtended:
         with pytest.raises(ValueError, match="offsets"):
             m.apply(p, idx, offsets=np.array([1, 3], dtype=np.int64))
 
+    def test_embedding_bag_offsets_jittable(self):
+        """The offsets form composes under jit: the eager offsets[0]
+        validation steps aside for traced values (like the decode-step
+        capacity guard)."""
+        import jax
+
+        m = ht.nn.EmbeddingBag(9, 4, mode="mean")
+        p = m.init(jax.random.key(0))
+        idx = np.array([1, 2, 3, 4, 5], dtype=np.int64)
+        offs = np.array([0, 2], dtype=np.int64)
+        eager = np.asarray(m.apply(p, idx, offsets=offs))
+        jitted = np.asarray(jax.jit(
+            lambda pp, i, o: m.apply(pp, i, offsets=o))(p, idx, offs))
+        np.testing.assert_allclose(jitted, eager, atol=1e-6)
+
     def test_embedding_bag_per_sample_weights(self):
         import jax
 
