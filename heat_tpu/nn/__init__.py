@@ -11,7 +11,7 @@ from . import activations, extended, losses, padshuffle, spatial
 from .attention import MultiheadAttention, apply_rope
 from .moe import MoE
 from .pipelined import Pipelined
-from .recurrent import GRU, LSTM, RNN
+from .recurrent import GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCell
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from . import functional
 from . import models

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from .modules import Module
 
-__all__ = ["RNN", "LSTM", "GRU"]
+__all__ = ["GRU", "GRUCell", "LSTM", "LSTMCell", "RNN", "RNNCell"]
 
 
 class _Recurrent(Module):
@@ -131,3 +131,46 @@ class GRU(_Recurrent):
         n = jnp.tanh(i_n + r * h_n)
         h = (1.0 - z) * n + z * h
         return h, h
+
+
+class _CellOf(Module):
+    """One step of the corresponding scan layer (torch's ``*Cell`` API):
+    same gate math, same packed parameter layout (``weight_ih`` /
+    ``weight_hh`` / biases as a FLAT dict — exactly one layer of the scan
+    module's params, so state dicts round-trip with torch cells)."""
+
+    layer_cls = None
+
+    def __init__(self, input_size: int, hidden_size: int, bias: bool = True,
+                 **kw):
+        self._layer = self.layer_cls(input_size, hidden_size, 1, bias, **kw)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.bias = bias
+
+    def init(self, key):
+        return self._layer.init(key)[0]
+
+    def apply(self, params, x, hx=None, *, train: bool = False, key=None, **kw):
+        """x (B, input_size); hx = previous state (h, or (h, c) for LSTM).
+        Returns the new state, torch cell semantics."""
+        if kw:
+            # reject stragglers like the scan layer's h0= spelling — a
+            # silently ignored initial state would run from zeros
+            raise TypeError(f"unexpected keyword(s) {sorted(kw)}; the cell "
+                            "takes its previous state as hx=")
+        carry = hx if hx is not None else self._layer._init_carry(x.shape[0])
+        carry, _ = self._layer._cell(params, carry, x)
+        return carry
+
+
+class RNNCell(_CellOf):
+    layer_cls = RNN
+
+
+class LSTMCell(_CellOf):
+    layer_cls = LSTM
+
+
+class GRUCell(_CellOf):
+    layer_cls = GRU

@@ -78,9 +78,8 @@ _out("lazy shape inference is an eager-torch idiom: JAX shapes are static at tra
       "LazyConvTranspose3d", "LazyInstanceNorm1d", "LazyInstanceNorm2d",
       "LazyInstanceNorm3d", "LazyLinear"])
 
-_out("the scan-based RNN/LSTM/GRU layers subsume per-step cells; decode paths use "
-     "explicit carry/caches instead of cell objects",
-     ["RNNBase", "RNNCell", "RNNCellBase", "LSTMCell", "GRUCell"])
+VIA["RNNBase"] = "heat_tpu.nn.recurrent._Recurrent (the scan-layer base)"
+VIA["RNNCellBase"] = "heat_tpu.nn.recurrent._CellOf (the one-step cell base)"
 
 _out("FractionalMaxPool is a stochastic-grid pool — no reference-workload "
      "user", ["FractionalMaxPool2d", "FractionalMaxPool3d"])

@@ -361,3 +361,73 @@ def test_adaptive_output_size_forms():
     np.testing.assert_allclose(got, want, atol=1e-6)
     with pytest.raises(ValueError, match="entries"):
         ht.nn.AdaptiveMaxPool2d((3, 4, 5))
+
+
+class TestRecurrentCells:
+    """RNNCell/LSTMCell/GRUCell vs torch: one step, torch parameter
+    layout (state dicts round-trip with the scan layers')."""
+
+    @pytest.mark.parametrize("name", ["RNNCell", "GRUCell"])
+    def test_simple_cells_match_torch(self, name):
+        import jax
+
+        m = getattr(ht.nn, name)(6, 5)
+        p = m.init(jax.random.key(0))
+        t = getattr(torch.nn, name)(6, 5)
+        with torch.no_grad():
+            t.weight_ih.copy_(torch.from_numpy(np.asarray(p["weight_ih"])))
+            t.weight_hh.copy_(torch.from_numpy(np.asarray(p["weight_hh"])))
+            t.bias_ih.copy_(torch.from_numpy(np.asarray(p["bias_ih"])))
+            t.bias_hh.copy_(torch.from_numpy(np.asarray(p["bias_hh"])))
+        x = RNG.normal(size=(3, 6)).astype(np.float32)
+        h = RNG.normal(size=(3, 5)).astype(np.float32)
+        got = np.asarray(m.apply(p, x, hx=h))
+        want = t(torch.from_numpy(x), torch.from_numpy(h)).detach().numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # default zero state
+        got0 = np.asarray(m.apply(p, x))
+        want0 = t(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(got0, want0, atol=1e-5)
+
+    def test_lstm_cell_matches_torch(self):
+        import jax
+
+        m = ht.nn.LSTMCell(6, 5)
+        p = m.init(jax.random.key(0))
+        t = torch.nn.LSTMCell(6, 5)
+        with torch.no_grad():
+            t.weight_ih.copy_(torch.from_numpy(np.asarray(p["weight_ih"])))
+            t.weight_hh.copy_(torch.from_numpy(np.asarray(p["weight_hh"])))
+            t.bias_ih.copy_(torch.from_numpy(np.asarray(p["bias_ih"])))
+            t.bias_hh.copy_(torch.from_numpy(np.asarray(p["bias_hh"])))
+        x = RNG.normal(size=(3, 6)).astype(np.float32)
+        h = RNG.normal(size=(3, 5)).astype(np.float32)
+        c = RNG.normal(size=(3, 5)).astype(np.float32)
+        gh, gc = m.apply(p, x, hx=(h, c))
+        wh, wc = t(torch.from_numpy(x), (torch.from_numpy(h), torch.from_numpy(c)))
+        np.testing.assert_allclose(np.asarray(gh), wh.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gc), wc.detach().numpy(), atol=1e-5)
+
+    def test_cell_rejects_h0_spelling(self):
+        import jax
+
+        cell = ht.nn.GRUCell(4, 5)
+        p = cell.init(jax.random.key(0))
+        x = RNG.normal(size=(2, 4)).astype(np.float32)
+        with pytest.raises(TypeError, match="hx="):
+            cell.apply(p, x, h0=np.zeros((2, 5), np.float32))
+
+    def test_cell_agrees_with_scan_layer(self):
+        """Stepping the cell S times == the scan layer on the sequence."""
+        import jax
+
+        layer = ht.nn.GRU(4, 5)
+        cell = ht.nn.GRUCell(4, 5)
+        lp = layer.init(jax.random.key(0))
+        x = RNG.normal(size=(2, 7, 4)).astype(np.float32)
+        out, _ = layer.apply(lp, x)
+        h = None
+        for t_ in range(7):
+            h = cell.apply(lp[0], x[:, t_], hx=h)
+        np.testing.assert_allclose(np.asarray(h), np.asarray(out[:, -1]),
+                                   atol=1e-5)
