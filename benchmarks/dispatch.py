@@ -1,0 +1,280 @@
+"""Op-dispatch microbenchmark: program-cache latency, hit rate, donation.
+
+The zero-copy dispatch claim, measured (ISSUE 1 acceptance):
+
+- ``dispatch_cached_latency_us`` — wall time of a repeated same-signature
+  binary op through the sharding-keyed program cache (one compiled
+  executable per ``(op, avals, split)``, output sharding compiled in);
+- ``dispatch_eager_reference_latency_us`` — the SEED dispatch tail for the
+  same op (eager jnp call + post-hoc ``comm.shard`` placement + metadata
+  recompute), timed side by side so the speedup is self-contained;
+- ``dispatch_overhead_us`` / ``dispatch_eager_reference_overhead_us`` —
+  the same two paths with the compiled-program floor (a pre-built jitted
+  add on the raw arrays, timed in-run) subtracted: pure Python dispatch
+  cost, independent of how fast this host executes the op itself.  The
+  seed measured ~230-470 us/op here; the cached path ~50 us/op
+  (interleaved A/B on the 8-device host mesh, 2026-08-03);
+- ``recompilations_100_ops`` / ``cache_hit_rate`` — program-cache misses
+  across 100 repeated same-signature ops after warmup (target: 0 / ≥0.99);
+- ``resplit_inplace_latency_us`` vs ``resplit_copy_latency_us`` and the
+  peak-RSS of a large in-place redistribution with the source buffer
+  donated vs the copying form.
+
+Run: python benchmarks/dispatch.py [--out PATH] [--size N] [--reps R]
+Writes a ``scripts/bench_compare.py``-consumable payload (committed
+capture: ``BENCH_DISPATCH.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _time_interleaved(fns, sync, reps, batch=20):
+    """Per-round per-call wall times (µs) of each fn, measured in
+    INTERLEAVED rounds so drifting host load hits every path equally (the
+    round-5 lesson: ordered one-shot timings produced phantom winners).
+    Each round dispatches ``batch`` calls and syncs once.  Returns a list
+    of per-round sample lists, one per fn."""
+    samples = [[] for _ in fns]
+    for _ in range(reps):
+        for i, fn in enumerate(fns):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(batch):
+                out = fn()
+            sync(out)
+            samples[i].append((time.perf_counter() - t0) / batch * 1e6)
+    return samples
+
+
+def _mins(samples):
+    return [min(s) for s in samples]
+
+
+def _paired_delta(a, b):
+    """Median of the PER-ROUND differences a_i − b_i: the two paths ran
+    back-to-back each round, so host-load swings cancel pairwise — the
+    robust estimator of pure overhead above a measured floor."""
+    d = sorted(x - y for x, y in zip(a, b))
+    return max(d[len(d) // 2], 0.0)
+
+
+def _time_op(fn, sync, reps):
+    return _mins(_time_interleaved([fn], sync, reps))[0]
+
+
+def _peak_rss_subprocess(mode: str, size: int) -> float:
+    """Peak RSS (MB) of one resplit of a (size, size) f32 array, measured in
+    a fresh process so allocator history doesn't pollute the peak."""
+    code = f"""
+import os, resource, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import heat_tpu as ht
+x = ht.zeros(({size}, {size}), split=0)
+x += 1.0  # touch every page
+if {mode!r} == "inplace":
+    x.resplit_(1)       # donating path
+    out = x
+else:
+    out = x.resplit(1)  # copying path (source stays live)
+ht.utils.profiler.sync(out)
+print(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0)
+"""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
+        )
+        return float(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        return float("nan")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="write payload JSON here")
+    ap.add_argument("--size", type=int, default=256, help="square op size")
+    ap.add_argument("--reps", type=int, default=40)
+    ap.add_argument("--skip-rss", action="store_true",
+                    help="skip the subprocess peak-memory captures")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.utils import profiler
+
+    comm = ht.communication.get_comm()
+    n_dev = comm.size
+    platform = comm.mesh.devices.flat[0].platform
+    sync = profiler.sync
+    n = args.size
+
+    x = ht.random.randn(n, n, split=0)
+    y = ht.random.randn(n, n, split=0)
+
+    # --- compiled-program floor ---------------------------------------- #
+    # a pre-built jitted (add + placement) on the raw arrays: the fastest
+    # any dispatch layer could possibly go on this host.  Subtracted from
+    # the measured paths to isolate pure Python dispatch overhead.
+    j1, j2 = x._jarray, y._jarray
+    floor_prog = jax.jit(lambda a, b: comm.shard(jnp.add(a, b), 0))
+
+    # the seed dispatch, measured in-process: _FORCE_SLOW routes _binary_op
+    # through its general path, which is the pre-cache implementation
+    # preserved verbatim (metadata recompute + eager jnp op + post-hoc
+    # placement + full wrap)
+    from heat_tpu.core import _operations
+
+    def eager_reference():
+        _operations._FORCE_SLOW = True
+        try:
+            return x + y
+        finally:
+            _operations._FORCE_SLOW = False
+
+    floor_prog(j1, j2)
+    _ = x + y  # build + compile the cached program once
+    eager_reference()
+    s_floor, s_cached, s_eager = _time_interleaved(
+        [lambda: floor_prog(j1, j2), lambda: x + y, eager_reference],
+        sync,
+        args.reps,
+    )
+    floor_us, cached_us, eager_us = (min(s_floor), min(s_cached), min(s_eager))
+    overhead_us = _paired_delta(s_cached, s_floor)
+    eager_overhead_us = _paired_delta(s_eager, s_floor)
+
+    # --- zero-recompilation across >=100 repeated same-signature ops --- #
+    for _ in range(2):  # warm every signature used below
+        _ = x + y, x * y, ht.exp(x), ht.sum(x, axis=0), ht.cumsum(x, axis=1)
+    profiler.reset_cache_stats()
+    for _ in range(25):
+        _ = x + y
+        _ = x * y
+        _ = ht.exp(x)
+        _ = ht.sum(x, axis=0)
+        _ = ht.cumsum(x, axis=1)
+    stats = profiler.cache_stats()
+    hit_rate = profiler.cache_hit_rate()
+
+    # --- reduction + matmul cached latencies --------------------------- #
+    reduce_us = _time_op(lambda: ht.sum(x, axis=0), sync, args.reps)
+    mm_a = ht.random.randn(n, n, split=0)
+    mm_b = ht.random.randn(n, n, split=1)
+    _ = mm_a @ mm_b
+    matmul_us = _time_op(lambda: mm_a @ mm_b, sync, args.reps)
+
+    # --- in-place donation surfaces ------------------------------------ #
+    z = ht.random.randn(n, n, split=0)
+    z += 1.0  # warm the donating program
+    iadd_us = _time_op((lambda: z.__iadd__(1.0)), sync, max(args.reps // 2, 5))
+    prog_alias = "unknown"
+    try:
+        from heat_tpu.core import _cache as _c
+
+        table = z.comm.__dict__["_compiled_programs"][_c._DISPATCH_SLOT]
+        donating = [v for k, v in table.items() if k[0] == "binary" and k[4]]
+        hlo = donating[-1][0].lower(z._jarray, 1.0).compile().as_text()
+        prog_alias = "input_output_alias" in hlo
+    except Exception:
+        pass
+
+    # both variants alternate 0→1 and 1→0 so each per-call figure is the
+    # same direction mix
+    r = ht.random.randn(n, n, split=0)
+    r.resplit_(1)  # warm both directions
+    r.resplit_(0)
+
+    def flip():
+        r.resplit_(1 if r.split == 0 else 0)
+        return r
+
+    rc0 = ht.random.randn(n, n, split=0)
+    rc1 = rc0.resplit(1)
+    copy_state = [0]
+
+    def copy_flip():
+        copy_state[0] ^= 1
+        return (rc0.resplit(1) if copy_state[0] else rc1.resplit(0))
+
+    # batch=1 (sync every call): in-place resplits form a serial dependency
+    # chain, so batching would let only the copy variant overlap transfers
+    resplit_us, resplit_copy_us = _mins(
+        _time_interleaved([flip, copy_flip], sync, args.reps, batch=1)
+    )
+
+    rss_inplace = rss_copy = float("nan")
+    if not args.skip_rss:
+        rss_size = 2048
+        rss_inplace = _peak_rss_subprocess("inplace", rss_size)
+        rss_copy = _peak_rss_subprocess("copy", rss_size)
+
+    # Row-name scheme (scripts/bench_compare.py infers direction by name):
+    # the TRACKED contract rows are the host-portable ratios (*_speedup,
+    # higher-better); absolute µs figures carry a *_snapshot suffix — no
+    # latency/overhead fragment — so they are reported but never flagged:
+    # they swing ±2x between hosts and runs, and a same-payload comparison
+    # must not fail CI on scheduler noise.
+    payload = {
+        "metric": "dispatch_overhead_speedup",
+        "value": round(max(eager_overhead_us, 1.0) / max(overhead_us, 1.0), 3),
+        "unit": "x (seed dispatch overhead / cached dispatch overhead)",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform,
+            "n_devices": n_dev,
+            "op_size": n,
+            "dispatch_walltime_speedup": round(eager_us / cached_us, 3)
+            if cached_us
+            else None,
+            "recompilations_100_ops": stats["misses"],
+            "cache_hits_100_ops": stats["hits"],
+            "cache_hit_rate": round(hit_rate, 4),
+            "iadd_donation_aliased": prog_alias,
+            "dispatch_floor_us_snapshot": round(floor_us, 2),
+            "dispatch_cached_us_snapshot": round(cached_us, 2),
+            "dispatch_seed_path_us_snapshot": round(eager_us, 2),
+            "dispatch_cost_above_floor_us_snapshot": round(max(overhead_us, 1.0), 2),
+            "seed_cost_above_floor_us_snapshot": round(
+                max(eager_overhead_us, 1.0), 2
+            ),
+            "reduce_cached_us_snapshot": round(reduce_us, 2),
+            "matmul_cached_us_snapshot": round(matmul_us, 2),
+            "iadd_donating_us_snapshot": round(iadd_us, 2),
+            "resplit_inplace_us_snapshot": round(resplit_us, 2),
+            "resplit_copy_us_snapshot": round(resplit_copy_us, 2),
+            "resplit_peak_rss_mb_inplace": round(rss_inplace, 1),
+            "resplit_peak_rss_mb_copy": round(rss_copy, 1),
+            "provenance": "benchmarks/dispatch.py on the host mesh "
+                          "(seed row = the pre-cache dispatch path, forced "
+                          "via _FORCE_SLOW and measured in-run, interleaved)",
+        },
+    }
+    print(json.dumps(payload, indent=1))
+    # hits >= 100 guards the guard: misses==0 alone would also hold if every
+    # signature fell through to the eager path (counted as "slow", not hits)
+    ok = stats["misses"] == 0 and hit_rate >= 0.99 and stats["hits"] >= 100
+    if not ok:
+        print(f"WARNING: cache contract violated: {stats}", file=sys.stderr)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

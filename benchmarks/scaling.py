@@ -112,7 +112,9 @@ if n_dev >= 2:
     dp = ht.nn.DataParallel(_mlp(), optimizer=ht.optim.DataParallelOptimizer("sgd", lr=0.01))
     dp.init(key=_jax.random.key(0))
     opt_state = dp.optimizer.init_state(dp.parameters)
-    dp_step = dp.make_train_step(_loss)
+    # donate=False: the timed reps call the step repeatedly with the SAME
+    # params/opt_state trees — donation would delete them on the first call
+    dp_step = dp.make_train_step(_loss, donate=False)
     jxb = dp.comm.shard(_jnp.asarray(xb), 0)
     jyb = dp.comm.shard(_jnp.asarray(yb), 0)
     dp_step(dp.parameters, opt_state, jxb, jyb)  # compile

@@ -23,7 +23,73 @@ from __future__ import annotations
 import functools
 from collections import OrderedDict
 
-__all__ = ["comm_cached"]
+__all__ = [
+    "comm_cached",
+    "cached_program",
+    "cache_stats",
+    "reset_cache_stats",
+]
+
+# ---------------------------------------------------------------------- #
+# global hit/miss accounting for every program table (dispatch cache +
+# comm_cached shard_map pipelines).  Exposed through utils.profiler so
+# benchmarks can assert "zero recompilations across N repeated ops".
+# ---------------------------------------------------------------------- #
+_STATS = {"hits": 0, "misses": 0, "slow": 0}
+
+# negative-cache sentinel: a builder may return SLOW to record "this
+# signature must take the general (eager) path".  Lookups that find SLOW
+# count under the separate "slow" stat — NOT as hits — so a 100% hit rate
+# genuinely means compiled programs were reused, not that everything fell
+# through to the eager path.
+SLOW = object()
+
+
+def cache_stats() -> dict:
+    """Snapshot of the program-cache counters: ``hits``/``misses`` for real
+    compiled-program reuse/builds, ``slow`` for negative-cache lookups."""
+    return dict(_STATS)
+
+
+def reset_cache_stats() -> None:
+    _STATS["hits"] = 0
+    _STATS["misses"] = 0
+    _STATS["slow"] = 0
+
+
+# the shared dispatch table's slot name and bound.  One slot (not one per
+# op) so the LRU bound caps TOTAL dispatch executables per comm: signatures
+# derive from user data shapes, and an unbounded table on the
+# process-lifetime world comm would accumulate executables forever.
+_DISPATCH_SLOT = f"{__name__}.dispatch"
+_DISPATCH_MAXSIZE = 1024
+
+
+def cached_program(comm, key, builder):
+    """Fetch-or-build a compiled program in ``comm``'s dispatch table.
+
+    The zero-copy dispatch core: jitted executables are keyed on
+    ``(op identity, input avals, split, static kwargs, donation)`` — the
+    mesh fingerprint is implicit because the table lives ON the comm
+    instance (same lifetime discipline as :func:`comm_cached`).  ``key``
+    must be hashable; ``builder()`` is called once per distinct key and
+    must return the compiled callable.  Hits and misses feed the global
+    :func:`cache_stats` counters.
+    """
+    tables = comm.__dict__.setdefault("_compiled_programs", {})
+    table = tables.get(_DISPATCH_SLOT)
+    if table is None:
+        table = tables[_DISPATCH_SLOT] = OrderedDict()
+    prog = table.get(key)
+    if prog is None:
+        _STATS["misses"] += 1
+        prog = table[key] = builder()
+        if len(table) > _DISPATCH_MAXSIZE:
+            table.popitem(last=False)
+    else:
+        _STATS["slow" if prog is SLOW else "hits"] += 1
+        table.move_to_end(key)
+    return prog
 
 
 def comm_cached(fn=None, *, maxsize: int = 32, key=None):
@@ -55,10 +121,12 @@ def comm_cached(fn=None, *, maxsize: int = 32, key=None):
         k = key(*args) if key is not None else args
         prog = table.get(k)
         if prog is None:
+            _STATS["misses"] += 1
             prog = table[k] = fn(comm, *args)
             if len(table) > maxsize:
                 table.popitem(last=False)
         else:
+            _STATS["hits"] += 1
             table.move_to_end(k)
         return prog
 

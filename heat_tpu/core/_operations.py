@@ -6,10 +6,21 @@ globally-shaped sharded ``jax.Array``s and XLA's SPMD partitioner emits any
 required communication.  What remains is *metadata propagation* — computing
 the result ``split`` under broadcasting and reductions, and reconciling
 mismatched splits (an explicit reshard, with the reference's perf warning).
+
+Zero-copy dispatch: each helper's compute tail (op + output-sharding
+placement) runs through a sharding-keyed program cache
+(``_cache.cached_program``): one jitted executable per ``(op, avals, split)``
+signature per comm, with the output sharding compiled in as a
+``with_sharding_constraint`` — so a repeated op never re-traces, re-lowers,
+or pays an eager post-op ``device_put``.  The in-place dunders additionally
+donate their left operand's buffer to the executable (``donate_argnums``),
+letting XLA alias input and output storage.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import warnings
 from typing import Callable, Optional, Tuple, Union
 
@@ -17,12 +28,73 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import _complexsafe, sanitation, types
+from . import _cache, _complexsafe, sanitation, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
 
 __all__ = ["_local_op", "_binary_op", "_reduce_op", "_cum_op"]
+
+# set by the in-place dunders (``__iadd__`` etc. via ``arithmetics._iop``):
+# the next _binary_op donates its first operand's buffer to the compiled
+# program — numpy's in-place contract realized as XLA buffer aliasing
+_DONATE_T1 = contextvars.ContextVar("heat_tpu_donate_t1", default=False)
+
+
+@contextlib.contextmanager
+def donate_first_operand():
+    """Donate the first operand of the next ``_binary_op`` (in-place dunders)."""
+    token = _DONATE_T1.set(True)
+    try:
+        yield
+    finally:
+        _DONATE_T1.reset(token)
+
+
+def _sig(j) -> Tuple:
+    """Aval signature of a concrete array: (shape, dtype)."""
+    return (j.shape, j.dtype)
+
+
+def _cacheable(*js) -> bool:
+    """True when every array may go through a cached mesh-sharded program:
+    concrete (not a tracer — traced dispatch belongs to the surrounding jit)
+    and not a hosted-complex array (which must stay OFF the mesh)."""
+    for j in js:
+        if isinstance(j, jax.core.Tracer) or not isinstance(j, jax.Array):
+            return False
+    if not _complexsafe.native_complex_supported():  # lru-cached, cheap
+        for j in js:
+            if _complexsafe.is_complex(j):
+                return False
+    return True
+
+
+def _hashable(obj) -> bool:
+    try:
+        hash(obj)
+    except TypeError:
+        return False
+    return True
+
+
+# jnp.add/multiply/... are module-level jnp.ufunc singletons (no
+# __qualname__) — stable identities, always cacheable
+_UFUNC_TYPES = tuple(t for t in (getattr(jnp, "ufunc", None),) if t is not None)
+
+
+def _stable_op(op) -> bool:
+    """True when ``op``'s identity can key a program cache: a module-level
+    function (or jnp.ufunc singleton) whose identity is the same on every
+    call.  Per-call lambdas / closures (``lambda a: jnp.clip(a, lo, hi)``)
+    get a fresh identity each call — caching them would miss every time,
+    churn the LRU, and pin any closure-captured device arrays — so they
+    take the eager path."""
+    qn = getattr(op, "__qualname__", None)
+    if qn is None:
+        # partial()s and exotic callables may be per-call too
+        return isinstance(op, _UFUNC_TYPES)
+    return "<lambda>" not in qn and "<locals>" not in qn
 
 
 def _reduce_kinds():
@@ -87,8 +159,25 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
                 x.comm,
                 x.balanced,
             )
-    result = op(x._jarray, **kwargs)
-    result = x.comm.shard(result, x.split if x.split is not None and x.split < result.ndim else None)
+    comm = x.comm
+    j = x._jarray
+    if (
+        out is None
+        and not x._pad
+        and _stable_op(op)
+        and _cacheable(j)
+        and _hashable(kw := tuple(sorted(kwargs.items())))
+    ):
+        entry = _cache.cached_program(
+            comm,
+            ("local", op, _sig(j), x.split, kw),
+            lambda: _build_local(comm, op, j, x.split, kwargs),
+        )
+        if entry is not _SLOW:
+            prog, rshape, rdtype, rsplit = entry
+            return DNDarray._from_parts(prog(j), rshape, rdtype, rsplit, x.device, comm)
+    result = op(j, **kwargs)
+    result = comm.shard(result, x.split if x.split is not None and x.split < result.ndim else None)
     if out is not None:
         sanitation.sanitize_out(out, result.shape, x.split, x.device)
         out._jarray = result.astype(out.dtype.jax_dtype())
@@ -102,6 +191,25 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
         x.comm,
         x.balanced,
     )
+
+
+def _compile_tail(comm, compute, j, want_split):
+    """Shared compile tail of the unary fast paths (_local/_reduce/_cum):
+    resolve the result signature of ``compute`` by eval_shape, clamp the
+    split, refuse ragged results (``_SLOW`` — pad bookkeeping belongs to
+    the general path), and jit (compute + canonical output placement).
+    Returns ``(program, result shape, heat dtype, split)`` or ``_SLOW``."""
+    aval = jax.eval_shape(compute, j)
+    rshape = tuple(aval.shape)
+    rsplit = want_split if want_split is not None and want_split < len(rshape) else None
+    if rsplit is not None and comm.size > 1 and rshape[rsplit] % comm.size:
+        return _SLOW
+    prog = jax.jit(lambda a: comm.shard(compute(a), rsplit))
+    return prog, rshape, types.canonical_heat_type(aval.dtype), rsplit
+
+
+def _build_local(comm, op, j, split, kwargs):
+    return _compile_tail(comm, lambda a: op(a, **kwargs), j, split)
 
 
 def _result_split(
@@ -128,6 +236,46 @@ def _binary_op(
 ) -> DNDarray:
     """Broadcasting binary op with split reconciliation (reference __binary_op)."""
     from . import factories
+
+    # ---- planned fast path ------------------------------------------- #
+    # ONE dict lookup replaces the whole dispatch prologue: the plan keyed
+    # on (op, operand descriptors, donate) pre-resolved broadcasting, split
+    # alignment and the result metadata, and holds the compiled executable.
+    # Ineligible signatures (pads, mismatched splits, hosted complex,
+    # tracers) are negative-cached as _SLOW and take the general path below.
+    if out is None and where is None and not fn_kwargs and not _FORCE_SLOW and _stable_op(op):
+        d1 = isinstance(t1, DNDarray)
+        proto = t1 if d1 else t2 if isinstance(t2, DNDarray) else None
+        if proto is not None:
+            comm = proto.comm
+            k1 = _plan_desc(t1, comm)
+            k2 = _plan_desc(t2, comm)
+            if k1 is not None and k2 is not None:
+                donate = (
+                    _DONATE_T1.get()
+                    and d1
+                    and not (
+                        isinstance(t2, DNDarray) and t1._parray is t2._parray
+                    )  # one buffer may not be donated and read in one call
+                )
+                entry = _cache.cached_program(
+                    comm,
+                    ("binary", op, k1, k2, donate),
+                    lambda: _plan_binary(op, t1, t2, donate, comm),
+                )
+                if entry is not _SLOW:
+                    prog, rshape, rdtype, rsplit = entry
+                    return DNDarray._from_parts(
+                        prog(
+                            t1._jarray if d1 else t1,
+                            t2._jarray if isinstance(t2, DNDarray) else t2,
+                        ),
+                        rshape,
+                        rdtype,
+                        rsplit,
+                        proto.device,
+                        comm,
+                    )
 
     fn_kwargs = fn_kwargs or {}
     if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
@@ -233,6 +381,91 @@ def _binary_op(
     )
 
 
+# negative-cache sentinel: this signature must take the general path
+# (lookups that find it count under cache_stats()["slow"], not as hits)
+_SLOW = _cache.SLOW
+
+# benchmarking hook (benchmarks/dispatch.py): True forces every _binary_op
+# through the general path — the seed's dispatch, preserved verbatim below —
+# so the cached-vs-seed comparison is measured in one process
+_FORCE_SLOW = False
+
+
+def _plan_desc(t, comm):
+    """Plan-cache key for one operand, or None when the operand can't key a
+    plan (tracer, hosted complex, foreign comm, numpy/list coercions)."""
+    if isinstance(t, DNDarray):
+        if t.comm is not comm:
+            return None
+        j = t._parray
+        if isinstance(j, jax.core.Tracer) or not isinstance(j, jax.Array):
+            return None
+        return (t.shape, t.dtype, t.split, t._pad)
+    if np.isscalar(t) and not isinstance(t, np.generic):
+        # python scalars ride as weak-typed RUNTIME args of the program —
+        # promotion matches eager, and the executable is never specialized
+        # on the scalar's value
+        return type(t)
+    return None
+
+
+def _plan_binary(op, t1, t2, donate, comm):
+    """Resolve broadcasting/split metadata for one signature and compile its
+    executable — or ``_SLOW`` when the signature needs the general path."""
+    d1, d2 = isinstance(t1, DNDarray), isinstance(t2, DNDarray)
+    if (d1 and t1._pad) or (d2 and t2._pad):
+        return _SLOW  # ragged operands: the pad fast path owns these
+    j1 = t1._jarray if d1 else t1
+    j2 = t2._jarray if d2 else t2
+    if not _cacheable(*(j for j, d in ((j1, d1), (j2, d2)) if d)):
+        return _SLOW
+    if not _complexsafe.native_complex_supported() and any(
+        isinstance(s, complex) for s in (j1, j2) if not isinstance(s, jax.Array)
+    ):
+        return _SLOW  # hosted-complex mode: scalar-complex ops stay eager
+    sh1 = t1.shape if d1 else ()
+    sh2 = t2.shape if d2 else ()
+    s1 = t1.split if d1 else None
+    s2 = t2.split if d2 else None
+    out_shape = broadcast_shape(sh1, sh2)
+    out_ndim = len(out_shape)
+    if (
+        s1 is not None
+        and s2 is not None
+        and s1 + (out_ndim - len(sh1)) != s2 + (out_ndim - len(sh2))
+    ):
+        return _SLOW  # mismatched splits: per-call reshard + warning
+    res_split = _result_split(((sh1, s1), (sh2, s2)), out_ndim)
+    donate = donate and d1 and out_shape == sh1
+    plan = _build_binary(comm, op, j1, j2, res_split, donate, {})
+    rshape, rsplit = plan[1], plan[3]
+    if rsplit is not None and comm.size > 1 and rshape[rsplit] % comm.size:
+        return _SLOW  # ragged result: pad bookkeeping belongs to __init__
+    return plan
+
+
+def _build_binary(comm, op, j1, j2, res_split, donate, fn_kwargs):
+    """Compile the (op + output placement) tail of ``_binary_op`` for one
+    signature pair; ``donate`` aliases the first operand's buffer into the
+    output (the in-place dunders' zero-copy path)."""
+    aval = jax.eval_shape(lambda a, b: op(a, b, **fn_kwargs), j1, j2)
+    rsplit = res_split if res_split is not None and res_split < len(aval.shape) else None
+    # donate only when the result provably replaces the operand's buffer
+    # (same shape AND dtype): a shape/dtype-changing result could never
+    # alias, and XLA would warn 'donated buffers were not usable' on every
+    # such signature — donation is aliasing, not a hint
+    donate = (
+        donate
+        and tuple(aval.shape) == tuple(j1.shape)
+        and aval.dtype == j1.dtype
+    )
+    prog = jax.jit(
+        lambda a, b: comm.shard(op(a, b, **fn_kwargs), rsplit),
+        donate_argnums=(0,) if donate else (),
+    )
+    return prog, tuple(aval.shape), types.canonical_heat_type(aval.dtype), rsplit
+
+
 def _reduce_op(
     op: Callable,
     x: DNDarray,
@@ -292,7 +525,25 @@ def _reduce_op(
                 new_split, x.device, x.comm, True,
             )
 
-    result = op(x._jarray, axis=axis, keepdims=keepdims, **kwargs)
+    j = x._jarray
+    axkey = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    if (
+        out is None
+        and not x._pad
+        and _stable_op(op)
+        and _cacheable(j)
+        and _hashable(kw := tuple(sorted(kwargs.items())))
+    ):
+        dkey = None if dtype is None else types.canonical_heat_type(dtype)
+        entry = _cache.cached_program(
+            x.comm,
+            ("reduce", op, _sig(j), axkey, keepdims, dkey, new_split, kw),
+            lambda: _build_reduce(x.comm, op, j, axis, keepdims, dkey, new_split, kwargs),
+        )
+        if entry is not _SLOW:
+            prog, rshape, rdtype, rsplit = entry
+            return DNDarray._from_parts(prog(j), rshape, rdtype, rsplit, x.device, x.comm)
+    result = op(j, axis=axis, keepdims=keepdims, **kwargs)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
     if new_split is not None and new_split >= result.ndim:
@@ -311,6 +562,16 @@ def _reduce_op(
         x.comm,
         True,
     )
+
+
+def _build_reduce(comm, op, j, axis, keepdims, dtype, new_split, kwargs):
+    jdt = None if dtype is None else dtype.jax_dtype()
+
+    def compute(a):
+        r = op(a, axis=axis, keepdims=keepdims, **kwargs)
+        return r if jdt is None else r.astype(jdt)
+
+    return _compile_tail(comm, compute, j, new_split)
 
 
 def _cum_op(
@@ -336,14 +597,24 @@ def _cum_op(
                 phys, x.shape, types.canonical_heat_type(phys.dtype),
                 x.split, x.device, x.comm, True,
             )
+    j = x._jarray
+    split = None if axis is None else x.split
+    if out is None and not x._pad and _stable_op(op) and _cacheable(j):
+        dkey = None if dtype is None else types.canonical_heat_type(dtype)
+        entry = _cache.cached_program(
+            x.comm,
+            ("cum", op, _sig(j), axis, dkey, split),
+            lambda: _build_cum(x.comm, op, j, axis, dkey, split),
+        )
+        if entry is not _SLOW:
+            prog, rshape, rdtype, rsplit = entry
+            return DNDarray._from_parts(prog(j), rshape, rdtype, rsplit, x.device, x.comm)
     if axis is None:
         # numpy semantics: flatten
-        flat = x._jarray.reshape(-1)
+        flat = j.reshape(-1)
         result = op(flat, axis=0)
-        split = None
     else:
-        result = op(x._jarray, axis=axis)
-        split = x.split
+        result = op(j, axis=axis)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
     result = x.comm.shard(result, split)
@@ -360,3 +631,13 @@ def _cum_op(
         x.comm,
         True,
     )
+
+
+def _build_cum(comm, op, j, axis, dtype, split):
+    jdt = None if dtype is None else dtype.jax_dtype()
+
+    def compute(a):
+        r = op(a.reshape(-1), axis=0) if axis is None else op(a, axis=axis)
+        return r if jdt is None else r.astype(jdt)
+
+    return _compile_tail(comm, compute, j, split)

@@ -312,7 +312,15 @@ DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
 
 def _iop(fn):
     def inner(self, other):
-        res = fn(self, other)
+        # donate self's buffer to the compiled op: an in-place update never
+        # holds two live copies (XLA aliases in/out storage when the result
+        # signature matches).  _binary_op only honors the donation when the
+        # result provably replaces self (same shape, not self-referencing),
+        # so the shape guard below can still fire safely on the slow path.
+        from ._operations import donate_first_operand
+
+        with donate_first_operand():
+            res = fn(self, other)
         if tuple(res.shape) != tuple(self.shape):
             raise ValueError(
                 f"output shape {res.shape} of in-place operation does not match "

@@ -34,12 +34,30 @@ def init_distributed(
         # idempotent: callers that had to initialize before importing the
         # package (jax.distributed must run before ANY backend touch, and
         # importing heat_tpu resolves the default device) are fine
-        if not jax.distributed.is_initialized():
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
+        # jax<0.5 has no is_initialized(); probe the internal client state,
+        # and treat "already initialized" from initialize() as success so
+        # the call stays idempotent even when no probe is available
+        def _inited() -> bool:
+            probe = getattr(jax.distributed, "is_initialized", None)
+            if probe is not None:
+                return bool(probe())
+            try:
+                from jax._src import distributed as _dist
+
+                return getattr(_dist.global_state, "client", None) is not None
+            except Exception:
+                return False
+
+        if not _inited():
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            except RuntimeError as e:
+                if "already" not in str(e).lower():
+                    raise
     from . import devices
     from .devices import make_mesh, use_mesh
 

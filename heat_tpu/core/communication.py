@@ -51,6 +51,34 @@ __all__ = [
 ]
 
 
+def _array_from_callback(host: "np.ndarray", sh: NamedSharding) -> jax.Array:
+    """Global array from host data, one slice per addressable device.
+
+    The explicit dtype matters on sub-meshes that leave this process with
+    ZERO addressable shards (inference has no data there), but the kwarg is
+    newer than some supported jax versions — fall back to inference, which
+    is correct whenever at least one shard is local."""
+    try:
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx], dtype=host.dtype
+        )
+    except TypeError:
+        return jax.make_array_from_callback(host.shape, sh, lambda idx: host[idx])
+
+
+def _jax_shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions: the public entry point (with
+    ``check_vma``) when present, else the pre-0.5 experimental one (where
+    the same knob is named ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma)
+
+
 class Communication:
     """A communicator: a device mesh axis over which arrays are sharded.
 
@@ -183,8 +211,20 @@ class Communication:
         return PartitionSpec(*(self.__axis if i == split else None for i in range(ndim)))
 
     def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
-        """The ``NamedSharding`` realizing ``split`` over this communicator."""
-        return NamedSharding(self.__mesh, self.spec(ndim, split))
+        """The ``NamedSharding`` realizing ``split`` over this communicator.
+
+        Memoized per ``(ndim, split)`` on the instance: the dispatch layer
+        asks for the canonical sharding on EVERY op, and returning the same
+        object each time makes the placement-equality checks in
+        ``DNDarray._enforce_placement``/``shard`` an identity comparison
+        instead of a structural one.
+        """
+        cache = self.__dict__.setdefault("_sharding_cache", {})
+        key = (ndim, split)
+        sh = cache.get(key)
+        if sh is None:
+            sh = cache[key] = NamedSharding(self.__mesh, self.spec(ndim, split))
+        return sh
 
     @staticmethod
     def host_fetch(array) -> "np.ndarray":
@@ -243,11 +283,7 @@ class Communication:
             # build the global array from per-device slices instead (found
             # by the -m mp lane: nansum's ht.array([1, nan, 3]))
             host = np.asarray(array)
-            # explicit dtype: a sub-mesh can leave this process with
-            # ZERO addressable shards, where inference has no data
-            return jax.make_array_from_callback(
-                host.shape, sh, lambda idx: host[idx], dtype=host.dtype
-            )
+            return _array_from_callback(host, sh)
         return jax.device_put(array, sh)
 
     def pad_shard(self, array: jax.Array, split: int) -> jax.Array:
@@ -290,11 +326,7 @@ class Communication:
         if self.n_processes > 1 and getattr(array, "is_fully_addressable", True):
             # same NaN-vs-assert_equal hazard as shard() (see there)
             host = np.asarray(array)
-            # explicit dtype: a sub-mesh can leave this process with
-            # ZERO addressable shards, where inference has no data
-            return jax.make_array_from_callback(
-                host.shape, sh, lambda idx: host[idx], dtype=host.dtype
-            )
+            return _array_from_callback(host, sh)
         return jax.device_put(array, sh)
 
     def split_of(self, array: jax.Array) -> Optional[int]:
@@ -311,15 +343,51 @@ class Communication:
     # ------------------------------------------------------------------ #
     # redistribution — the reference's Alltoallv-based resplit_
     # ------------------------------------------------------------------ #
-    def resplit(self, array: jax.Array, split: Optional[int]) -> jax.Array:
+    def resplit(
+        self, array: jax.Array, split: Optional[int], donate: bool = False
+    ) -> jax.Array:
         """Redistribute a global array to a new split axis.
 
         XLA lowers the sharding change to an all-to-all over ICI (the
         memory-efficient reshard of arXiv 2112.01075); the reference does the
         same thing by hand with derived datatypes + ``Alltoallv``
         (``DNDarray.resplit_``, SURVEY §3.3).
+
+        ``donate=True`` (the in-place ``resplit_`` path) hands the source
+        buffer to the transfer (``jax.device_put(..., donate=True)``): the
+        runtime may alias input and output storage (layout permitting) and
+        can free the source as soon as the all-to-all has consumed it, so
+        peak memory stays at ~one copy instead of two.  The caller must not
+        use ``array`` afterwards.  Donation falls back to the plain path
+        for tracers, hosted-complex arrays, ragged extents and
+        multi-process meshes (where placement goes through host assembly
+        anyway).
         """
+        if donate and self._donatable(array, split):
+            sh = self.sharding(array.ndim, split)
+            if getattr(array, "sharding", None) == sh:
+                return array
+            try:
+                return jax.device_put(array, sh, donate=True)
+            except TypeError:  # jax without the donate kwarg
+                return jax.device_put(array, sh)
         return self.shard(array, split)
+
+    def _donatable(self, array, split: Optional[int]) -> bool:
+        """True when the donating reshard program may be used for ``array``."""
+        from ._complexsafe import guard
+
+        if isinstance(array, jax.core.Tracer) or not isinstance(array, jax.Array):
+            return False
+        if guard(array) is not None:
+            return False  # hosted complex: stays off the mesh
+        if self.n_processes > 1:
+            return False  # placement goes through host assembly (see shard())
+        if split is not None and (
+            array.ndim == 0 or array.shape[split % array.ndim] % self.size != 0
+        ):
+            return False  # ragged: split stays logical, no canonical target
+        return True
 
     # ------------------------------------------------------------------ #
     # functional collectives — valid ONLY inside shard_map over this mesh.
@@ -500,7 +568,7 @@ class Communication:
 
         in_specs = jax.tree.map(to_spec, in_splits, is_leaf=is_leaf)
         out_specs = jax.tree.map(to_spec, out_splits, is_leaf=is_leaf)
-        return jax.shard_map(
+        return _jax_shard_map(
             fn, mesh=self.__mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
         )
 

@@ -113,6 +113,29 @@ class DNDarray:
                 )
         self.__array = array
 
+    @classmethod
+    def _from_parts(cls, array, gshape, dtype, split, device, comm) -> "DNDarray":
+        """Wrap a dispatch-cache program output WITHOUT re-validation.
+
+        The cached executables compile the canonical output sharding in
+        (``with_sharding_constraint``) and their plans pre-resolve shape,
+        heat dtype and split — re-running ``__init__``'s placement
+        enforcement and pad bookkeeping per call would re-derive facts the
+        plan already guarantees.  Callers must guarantee: ``gshape`` is a
+        tuple of ints matching ``array.shape``, ``split`` is in range (or
+        None), and the split axis is mesh-divisible (pad-free).
+        """
+        self = object.__new__(cls)
+        self._DNDarray__gshape = gshape
+        self._DNDarray__dtype = dtype
+        self._DNDarray__split = split
+        self._DNDarray__device = device
+        self._DNDarray__comm = comm
+        self._DNDarray__pad = 0
+        self._DNDarray__unpadded = None
+        self._DNDarray__array = array
+        return self
+
     @staticmethod
     def _enforce_placement(array, comm, split):
         """No DNDarray may claim a split its sharding doesn't have: place
@@ -464,7 +487,11 @@ class DNDarray:
         """In-place redistribution to a new split axis (reference SURVEY §3.3).
 
         Lowered by XLA to an all-to-all (split↔split) or allgather (→None);
-        ragged axes are re-padded along the new split axis.
+        ragged axes are re-padded along the new split axis.  In-place means
+        in-place: the old buffer is DONATED to the reshard program (layout
+        permitting, XLA aliases or early-frees it), so other DNDarrays
+        sharing this array's buffer — ``astype(copy=False)`` views — must
+        not be read afterwards.  Use ``resplit()`` for the copying form.
         """
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
@@ -474,11 +501,11 @@ class DNDarray:
         self.__pad = 0
         self.__unpadded = None
         if axis is None:
-            self.__array = self.__comm.resplit(logical, None)
+            self.__array = self.__comm.resplit(logical, None, donate=True)
         else:
             self._renormalize(logical)
             if self.__pad == 0:
-                self.__array = self.__comm.resplit(self.__array, axis)
+                self.__array = self.__comm.resplit(self.__array, axis, donate=True)
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:

@@ -123,9 +123,25 @@ def _iter_hyperslabs(x: DNDarray):
         return
     n = x.shape[split]
     seen = set()
-    for sh in sorted(
+    shards = sorted(
         x._parray.addressable_shards, key=lambda s: s.index[split].start or 0
-    ):
+    )
+
+    # overlap, ONE shard ahead: start shard k+1's device→host copy while
+    # shard k is being written to disk, so np.asarray finds the data
+    # resident without a blocking fetch per chunk.  Never prefetch more —
+    # the whole point of hyperslab iteration is that peak host memory
+    # stays at ~one chunk, not the full array.
+    def _prefetch(i):
+        if i < len(shards):
+            try:
+                shards[i].data.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+
+    _prefetch(0)
+    for si, sh in enumerate(shards):
+        _prefetch(si + 1)
         idx = sh.index
         start = idx[split].start or 0
         stop = idx[split].stop
@@ -674,12 +690,16 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
 # §5.4: tensorstore/zarr with per-shard writes; here one .npy per shard
 # chunk + a json manifest, dependency-free)
 # ---------------------------------------------------------------------- #
-def save_array_checkpoint(x: DNDarray, directory: str) -> None:
+def save_array_checkpoint(x: DNDarray, directory: str, donate: bool = False) -> None:
     """Checkpoint a (possibly huge) DNDarray as per-shard chunk files.
 
     Each shard is fetched and written individually — host memory stays at
     one chunk, so checkpointable size is disk-bound.  Layout:
     ``meta.json`` (gshape, dtype, split, chunk starts) + ``chunk_<start>.npy``.
+
+    ``donate=True`` releases the array's device buffers as soon as the write
+    completes (the checkpoint-and-swap pattern: evacuate state, then reuse
+    the memory for the next resident) — ``x`` must not be used afterwards.
     """
     if not isinstance(x, DNDarray):
         x = factories.array(x)
@@ -715,6 +735,12 @@ def save_array_checkpoint(x: DNDarray, directory: str) -> None:
     with open(tmp, "w") as fh:
         fh.write(f"v{version}")
     os.replace(tmp, os.path.join(directory, "LATEST"))  # atomic flip
+    if donate:
+        # the write is durable (post-flip): free the device storage now
+        try:
+            x._parray.delete()
+        except (AttributeError, RuntimeError):
+            pass
     import shutil
 
     for old in existing:
@@ -807,12 +833,15 @@ def save_checkpoint(tree, path: str) -> None:
     import jax
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    # ONE batched device→host transfer for the whole tree: per-leaf
+    # np.asarray would issue a blocking round-trip per parameter, turning a
+    # checkpoint into hundreds of serial host syncs
+    leaves = jax.device_get([leaf for _, leaf in flat])
     arrays = {}
     keys = []
-    for i, (p, leaf) in enumerate(flat):
-        k = f"leaf_{i}"
+    for i, ((p, _), host) in enumerate(zip(flat, leaves)):
         keys.append(jax.tree_util.keystr(p))
-        arrays[k] = np.asarray(leaf)
+        arrays[f"leaf_{i}"] = np.asarray(host)
     np.savez(path, __keys__=np.asarray(json.dumps(keys)), **arrays)
 
 

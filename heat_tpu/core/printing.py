@@ -81,6 +81,12 @@ def _edge_fetch(x) -> np.ndarray:
 
 
 def __str__(x) -> str:
+    # host-sync audit: printing is an EXPLICIT materialization point, but a
+    # repr reached during tracing (a print inside a jitted user function, a
+    # debugger hitting a traced DNDarray) must not try to fetch values — it
+    # would raise a TracerArrayConversionError mid-trace.  Show the aval.
+    if isinstance(x._parray, jax.core.Tracer):
+        return f"Traced<shape={x.shape}, dtype={x.dtype.__name__}>"
     opt = get_printoptions()
     threshold = opt["threshold"]
     with np.printoptions(

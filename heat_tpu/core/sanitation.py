@@ -1,4 +1,13 @@
-"""Input/output sanitation (reference: ``heat/core/sanitation.py``)."""
+"""Input/output sanitation (reference: ``heat/core/sanitation.py``).
+
+Host-sync contract (zero-copy dispatch audit): every check in this module
+is METADATA-ONLY — shapes, dtypes, splits, types.  No function here may
+read array *values* (no ``item()``/``np.asarray``/comparisons on device
+data): sanitation runs on every op dispatch, and a value-dependent check
+would be a blocking device→host sync in the middle of an async pipeline.
+Value-dependent validation belongs behind explicit materialization points
+(``numpy()``, ``item()``, printing) or inside the computation itself.
+"""
 
 from __future__ import annotations
 

@@ -20,7 +20,8 @@ import numpy as np
 from jax import lax
 
 from ..core import types
-from ..core._cache import comm_cached
+from ..core import _operations
+from ..core._cache import cached_program, comm_cached
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from ..core.stride_tricks import sanitize_axis
@@ -151,11 +152,11 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False,
         return matmul_summa(a, b)
     if a.ndim == 1 and b.ndim == 1:
         return dot(a, b)
-    res = jnp.matmul(a._jarray, b._jarray)
-    nd = res.ndim
-    if nd == 0:
-        return _wrap(res, None, a)
-    # vector cases
+    # result rank is a pure function of the operand ranks (vector operands
+    # drop their axis; both-1-D went to dot() above, so nd >= 1), so the
+    # split table resolves BEFORE dispatch and the (matmul + output
+    # placement) pair compiles into one cached program
+    nd = max(a.ndim, b.ndim) - (a.ndim == 1) - (b.ndim == 1)
     if a.ndim == 1:
         split = None if b.split is None else (nd - 1 if b.split == b.ndim - 1 else None)
     elif b.ndim == 1:
@@ -164,7 +165,21 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False,
         sa = None if a.split is None else (0 if a.split == a.ndim - 2 else (1 if a.split == a.ndim - 1 else None))
         sb = None if b.split is None else (0 if b.split == b.ndim - 2 else (1 if b.split == b.ndim - 1 else None))
         split = _matmul_result_split(sa, sb, nd)
-    return _wrap(res, split, a)
+    ja, jb = a._jarray, b._jarray
+    if not a._pad and not b._pad and _operations._cacheable(ja, jb):
+        comm = a.comm
+        entry = cached_program(
+            comm,
+            ("matmul", _operations._sig(ja), _operations._sig(jb), split),
+            lambda: _operations._build_binary(comm, jnp.matmul, ja, jb, split, False, {}),
+        )
+        prog, rshape, rdtype, rsplit = entry
+        if rsplit is None or comm.size <= 1 or rshape[rsplit] % comm.size == 0:
+            return DNDarray._from_parts(
+                prog(ja, jb), rshape, rdtype, rsplit, a.device, comm
+            )
+        return DNDarray(prog(ja, jb), rshape, rdtype, rsplit, a.device, comm, True)
+    return _wrap(jnp.matmul(ja, jb), split, a)
 
 
 def matmul_summa(a: DNDarray, b: DNDarray) -> DNDarray:
@@ -246,8 +261,17 @@ def _summa_program(comm):
 def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
     """Dot product: 1-D·1-D → scalar (implicit Allreduce); else matmul."""
     if a.ndim == 1 and b.ndim == 1:
-        res = jnp.dot(a._jarray, b._jarray)
-        r = _wrap(res, None, a)
+        ja, jb = a._jarray, b._jarray
+        if not a._pad and not b._pad and _operations._cacheable(ja, jb):
+            comm = a.comm
+            prog, rshape, rdtype, rsplit = cached_program(
+                comm,
+                ("dot", _operations._sig(ja), _operations._sig(jb)),
+                lambda: _operations._build_binary(comm, jnp.dot, ja, jb, None, False, {}),
+            )
+            r = DNDarray._from_parts(prog(ja, jb), rshape, rdtype, rsplit, a.device, comm)
+        else:
+            r = _wrap(jnp.dot(ja, jb), None, a)
         if out is not None:
             out._jarray = r._jarray
             return out

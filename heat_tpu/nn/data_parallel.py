@@ -112,7 +112,8 @@ class DataParallel:
     __call__ = forward
 
     # -- fused train step ----------------------------------------------- #
-    def make_train_step(self, loss_fn: Callable, with_rng: bool = False):
+    def make_train_step(self, loss_fn: Callable, with_rng: bool = False,
+                        donate: bool = True):
         """Build a jitted (params, opt_state, x, y[, key]) →
         (params, opt_state, loss) step.  The batch arrives sharded; the mean
         loss over the GLOBAL batch makes XLA emit the gradient psum (the
@@ -121,9 +122,20 @@ class DataParallel:
         ``with_rng=True`` adds a PRNG-key argument, required for stochastic
         layers (Dropout) — without it, a Dropout layer raises so that
         regularization can never be silently inactive during training.
+
+        ``donate=True`` (default) donates params and opt_state to the step:
+        XLA aliases the updated state onto the incoming buffers, so training
+        holds ONE copy of the model state instead of two.  The train loop
+        must rebind — ``params, state, l = step(params, state, x, y)`` — and
+        anything still pointing at the pre-step tree (e.g. this wrapper's
+        ``.parameters`` from ``init()``) is consumed; reassign
+        ``dp.parameters = params`` before calling ``forward`` again.
         """
         if self.optimizer is None:
             raise RuntimeError("make_train_step requires an attached optimizer")
+        import functools
+
+        _jit = functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         apply = self.module.apply
         opt = self.optimizer
 
@@ -143,7 +155,7 @@ class DataParallel:
 
         if with_rng:
 
-            @jax.jit
+            @_jit
             def step(params, opt_state, jx, jy, key):
                 def loss(p):
                     return loss_fn(_forward(p, jx, key), jy)
@@ -154,7 +166,7 @@ class DataParallel:
 
         else:
 
-            @jax.jit
+            @_jit
             def step(params, opt_state, jx, jy):
                 def loss(p):
                     return loss_fn(_forward(p, jx, None), jy)

@@ -40,7 +40,6 @@ class _LPPool(Module):
         self.stride = _tup(stride if stride is not None else kernel_size)
 
     def apply(self, params, x, **kw):
-        n = self.spatial
         p = self.norm_type
         s = jax.lax.reduce_window(
             x ** p, 0.0, jax.lax.add,
@@ -48,8 +47,10 @@ class _LPPool(Module):
             window_strides=(1, 1) + self.stride,
             padding="VALID",
         )
-        # torch: relu before the root (negative window sums at odd p)
-        return jnp.maximum(s, 0.0) ** (1.0 / p)
+        # torch semantics exactly (ADVICE r5 #1): the signed window sum goes
+        # straight into the root — norm_type=1 returns the signed sum, and a
+        # negative sum at fractional 1/p yields NaN, just like torch.pow
+        return s ** (1.0 / p)
 
 
 class LPPool1d(_LPPool):
@@ -256,10 +257,12 @@ class _MaxUnpool(Module):
             zip(output_size, x.shape[2:], self.stride, self.kernel_size)
         ):
             default = (i - 1) * s + k
-            if not default - k <= o <= default + k:  # torch's accepted band
+            # torch's strict ±stride band (_unpool_output_size):
+            # min_size < o < max_size with min/max = default ∓ stride
+            if not default - s < o < default + s:
                 raise ValueError(
                     f"invalid output_size {tuple(output_size)}: dim {d} must "
-                    f"be between {default - k} and {default + k}"
+                    f"be between {default - s} and {default + s}"
                 )
         N, C = x.shape[:2]
         from math import prod
@@ -267,10 +270,29 @@ class _MaxUnpool(Module):
         L = prod(output_size)
         vals = x.reshape(N, C, -1)
         idx = jnp.asarray(indices).reshape(N, C, -1)
+        # recorded indices may exceed a smaller-than-default output plane
+        # (and negatives are out-of-bounds in drop-mode scatter); torch
+        # raises for both, and silent relocation is never acceptable —
+        # validate eagerly when concrete (ONE fused device fetch for both
+        # bounds), scatter with mode='drop' under trace so out-of-range
+        # indices vanish instead of clipping to L-1
+        if idx.size:
+            try:
+                mn, mx = (int(v) for v in jnp.stack([idx.min(), idx.max()]))
+            except (jax.errors.TracerIntegerConversionError,
+                    jax.errors.ConcretizationTypeError, TypeError):
+                mn = mx = 0  # traced: drop-mode scatter is the guard
+            if mx >= L:
+                raise ValueError(
+                    f"found an invalid max index {mx} for output size "
+                    f"{tuple(output_size)} (flat plane {L})"
+                )
+            if mn < 0:
+                raise ValueError(f"found an invalid (negative) index {mn}")
         out = jnp.zeros((N, C, L), x.dtype)
         out = out.at[
             jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None], idx
-        ].set(vals)
+        ].set(vals, mode="drop")
         return out.reshape(N, C, *output_size)
 
 

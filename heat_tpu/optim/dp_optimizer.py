@@ -275,7 +275,9 @@ class DASO:
             in_specs = [P("dcn"), P("dcn"), P("dcn", "ici"), P("dcn", "ici")]
             if with_keys:
                 in_specs.append(P("dcn", "ici"))
-            return jax.shard_map(
+            from ..core.communication import _jax_shard_map
+
+            return _jax_shard_map(
                 fn,
                 mesh=mesh,
                 in_specs=tuple(in_specs),
@@ -283,13 +285,20 @@ class DASO:
                 check_vma=False,
             )
 
-        @jax.jit
+        import functools
+
+        # params/opt_state are DONATED: each step's replicas alias (or free
+        # early into) the previous step's buffers, so training never holds
+        # two full copies of the model state — the donate_argnums discipline
+        # of a production train loop.  self._params/_opt_state are rebound
+        # immediately on return, so nothing reads the consumed buffers.
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, xs, ys):
             return _smap(
                 lambda p, s, x, y: shard_step(p, s, x, y, None), with_keys=False
             )(params, opt_state, xs, ys)
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step_rng(params, opt_state, xs, ys, keys):
             # keys: (n_groups, ici) key array; each mesh cell gets its (1,1) block
             def fn(p, s, x, y, k):
@@ -297,11 +306,14 @@ class DASO:
 
             return _smap(fn, with_keys=True)(params, opt_state, xs, ys, keys)
 
+        # NOT donated: step() reads params again after dispatching the average
         @jax.jit
         def global_average(params):
             return jax.tree.map(lambda p: jnp.mean(p, axis=0, keepdims=True), params)
 
-        @jax.jit
+        # the blend CONSUMES the pre-blend replicas (donated); avg is kept —
+        # a pending stale average must survive if the same tree is reused
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def blend(params, avg, w):
             return jax.tree.map(
                 lambda p, a: (1.0 - w) * p + w * jnp.broadcast_to(a, p.shape), params, avg
@@ -362,7 +374,11 @@ class DASO:
                     self._params = self._blend(self._params, avg, self.staleness_weight)
                 else:
                     self._pending = (avg, t + self.stale_steps)
-        return float(jnp.mean(losses))
+        # asynchronous loss: a 0-d device array (duck-types float) — the old
+        # float(...) here was a blocking host sync on EVERY step, serializing
+        # the train loop on the slowest collective.  Callers that need the
+        # number call float() at their own materialization point.
+        return jnp.mean(losses)
 
     def epoch_loss_logic(self, epoch_loss) -> int:
         """Adaptive skip schedule — call once per epoch with the epoch's mean

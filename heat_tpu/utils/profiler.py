@@ -15,7 +15,27 @@ from typing import Optional
 import jax
 import numpy as np
 
-__all__ = ["trace", "timer", "sync", "annotate", "timeit_min"]
+from ..core._cache import cache_stats, reset_cache_stats
+
+__all__ = [
+    "trace",
+    "timer",
+    "sync",
+    "annotate",
+    "timeit_min",
+    "cache_stats",
+    "reset_cache_stats",
+    "cache_hit_rate",
+]
+
+
+def cache_hit_rate() -> float:
+    """Hit rate of the sharding-keyed program caches since the last
+    ``reset_cache_stats()`` — 1.0 means every dispatched op reused a
+    compiled executable (zero recompilation)."""
+    s = cache_stats()
+    total = s["hits"] + s["misses"]
+    return s["hits"] / total if total else 1.0
 
 
 def timeit_min(fn, reps: int = 3) -> float:

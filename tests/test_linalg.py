@@ -10,7 +10,11 @@ from test_suites.basic_test import TestCase
 SPLITS_2D = [None, 0, 1]
 
 
+# first mp batch for the linalg lane (VERDICT r5 weak #6): matmul + QR run
+# SPMD across OS processes in the -m mp tier — data is seeded numpy / seeded
+# ht.random, so every rank collects and computes identically
 class TestMatmul(TestCase):
+    pytestmark = pytest.mark.mp
     def test_matmul_split_cases(self):
         rng = np.random.default_rng(1)
         a = rng.normal(size=(16, 8)).astype(np.float32)
@@ -124,6 +128,8 @@ class TestMatmul(TestCase):
 
 
 class TestQR(TestCase):
+    pytestmark = pytest.mark.mp
+
     def test_tsqr_tall_skinny(self):
         rng = np.random.default_rng(4)
         a = rng.normal(size=(64, 8)).astype(np.float32)

@@ -92,6 +92,30 @@ class TestExtended:
         want = getattr(torch.nn, name)(*args)(torch.from_numpy(x)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("name,spatial", [
+        ("LPPool1d", 1), ("LPPool2d", 2),
+    ])
+    def test_lppool_signed_norm2_matches_torch(self, name, spatial):
+        # signed inputs at norm_type=2: x^2 kills the sign, both agree
+        x = _x(spatial)
+        got = np.asarray(getattr(ht.nn, name)(2.0, 2).apply((), x))
+        want = getattr(torch.nn, name)(2.0, 2)(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_lppool_norm1_signed_sum_matches_torch(self):
+        # ADVICE r5 #1 pinned: norm_type=1 is the SIGNED window sum (no relu
+        # clamp) — torch([-3., -1.]) stays negative and so do we
+        x = -np.ones((1, 1, 4), np.float32)
+        got = np.asarray(ht.nn.LPPool1d(1.0, 2).apply((), x))
+        want = torch.nn.LPPool1d(1.0, 2)(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        assert (got < 0).all(), "norm_type=1 must return the signed sum"
+        # odd fractional root of a negative window sum: NaN, like torch.pow
+        got3 = np.asarray(ht.nn.LPPool1d(3.0, 2).apply((), x))
+        want3 = torch.nn.LPPool1d(3.0, 2)(torch.from_numpy(x)).numpy()
+        np.testing.assert_array_equal(np.isnan(got3), np.isnan(want3))
+        assert np.isnan(got3).all()
+
     def test_alpha_dropout_statistics(self):
         import jax
 
@@ -250,6 +274,33 @@ class TestExtended:
             ht.nn.MaxUnpool2d(2).apply((), np.asarray(y),
                                        indices=np.asarray(idx),
                                        output_size=(3, 3))
+
+    def test_maxunpool_strict_stride_band_matches_torch(self):
+        # ADVICE r5 #2: torch's _unpool_output_size accepts default ± stride
+        # EXCLUSIVE — with kernel != stride, the old ±kernel band admitted
+        # sizes torch rejects
+        x = RNG.normal(size=(1, 1, 10)).astype(np.float32)
+        y, idx = ht.nn.MaxPool1d(4, 2, return_indices=True).apply((), x)
+        ty, tidx = torch.nn.MaxPool1d(4, 2, return_indices=True)(
+            torch.from_numpy(x))
+        default = (np.asarray(y).shape[2] - 1) * 2 + 4  # (i-1)*s + k
+        bad = default - 3  # inside ±kernel(4), outside ±stride(2)
+        with pytest.raises(ValueError, match="must be between"):
+            torch.nn.MaxUnpool1d(4, 2)(ty, tidx, output_size=(bad,))
+        with pytest.raises(ValueError, match="must be between"):
+            ht.nn.MaxUnpool1d(4, 2).apply(
+                (), np.asarray(y), indices=np.asarray(idx), output_size=(bad,))
+
+    def test_maxunpool_out_of_range_index_raises(self):
+        # a legal-band but smaller-than-default output_size can leave the
+        # recorded argmax positions outside the plane; torch raises and the
+        # old .at[].set default silently clipped them onto the last slot
+        x = np.array([[[0., 1., 0., 1., 0., 1.]]], np.float32)
+        y, idx = ht.nn.MaxPool1d(2, return_indices=True).apply((), x)
+        assert int(np.asarray(idx).max()) == 5
+        with pytest.raises(ValueError, match="invalid max index"):
+            ht.nn.MaxUnpool1d(2).apply(
+                (), np.asarray(y), indices=np.asarray(idx), output_size=(5,))
 
     def test_triplet_with_distance_matches_torch(self):
         a = RNG.normal(size=(6, 5)).astype(np.float32)
