@@ -15,18 +15,90 @@ import numpy as np
 __all__ = ["init_distributed", "finalize_distributed", "local_device_count", "device_count"]
 
 
+def _coordinator_retryable(e: BaseException) -> bool:
+    """True for failures that mean "the coordinator is not up YET" — the
+    conditions a pod bring-up races against (jobs of one slice start before
+    the coordinator's container is scheduled) — as opposed to genuine
+    misconfiguration, which must surface immediately."""
+    from ..utils import faults as _flt
+
+    if isinstance(e, _flt.TransientFault):
+        return True
+    msg = str(e).lower()
+    return any(
+        t in msg
+        for t in (
+            "deadline",
+            "timed out",
+            "timeout",
+            "unavailable",
+            "connection refused",
+            "failed to connect",
+            "connect failed",
+            "barrier",
+        )
+    )
+
+
+def _retrying_initialize(
+    initialize,
+    kwargs: dict,
+    retries: int = 5,
+    base_delay: float = 0.5,
+    max_delay: float = 10.0,
+    sleep=None,
+) -> None:
+    """Call ``initialize(**kwargs)`` with backoff retries while the
+    coordinator is unreachable (fault site ``dist.init`` fires per attempt;
+    "already initialized" counts as success for idempotency).  Factored out
+    of :func:`init_distributed` so the retry policy is unit-testable without
+    a real multi-process world."""
+    import time
+
+    from ..utils import faults as _flt
+
+    def attempt():
+        _flt.fire("dist.init")
+        try:
+            initialize(**kwargs)
+        except RuntimeError as e:
+            if "already" in str(e).lower():
+                return
+            raise
+
+    _flt.call_with_retries(
+        attempt,
+        "dist.init",
+        retries=retries,
+        base_delay=base_delay,
+        max_delay=max_delay,
+        retry_on=(_flt.TransientFault, RuntimeError),
+        retry_if=_coordinator_retryable,
+        sleep=sleep if sleep is not None else time.sleep,
+    )
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     mesh_shape: Optional[Tuple[int, ...]] = None,
     axis_names: Sequence[str] = ("x",),
+    connect_timeout: float = 120.0,
+    connect_retries: int = 5,
 ) -> None:
     """Initialize multi-host JAX (if configured) and install the default mesh.
 
     With no arguments, honors the standard JAX env bootstrap (TPU pods
     auto-discover their coordinator) when several processes are configured;
     single-process runs skip straight to mesh installation.
+
+    Bring-up is retried: when the coordinator is not reachable yet (slices
+    of a pod start at different times), each connect attempt is bounded by
+    ``connect_timeout`` and retried up to ``connect_retries`` times with
+    jittered exponential backoff (fault site ``dist.init``; attempts visible
+    as ``utils.profiler`` counter ``retry.dist.init``).  Misconfiguration
+    errors are NOT retried.
     """
     import jax
 
@@ -49,15 +121,22 @@ def init_distributed(
                 return False
 
         if not _inited():
-            try:
-                jax.distributed.initialize(
-                    coordinator_address=coordinator_address,
-                    num_processes=num_processes,
-                    process_id=process_id,
-                )
-            except RuntimeError as e:
-                if "already" not in str(e).lower():
-                    raise
+            kwargs = dict(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            # bound each connect attempt when this jax supports it (the
+            # kwarg is newer than some supported versions)
+            def _initialize(**kw):
+                try:
+                    jax.distributed.initialize(
+                        **kw, initialization_timeout=connect_timeout
+                    )
+                except TypeError:
+                    jax.distributed.initialize(**kw)
+
+            _retrying_initialize(_initialize, kwargs, retries=connect_retries)
     from . import devices
     from .devices import make_mesh, use_mesh
 
@@ -86,13 +165,17 @@ def init_distributed(
 
 
 def finalize_distributed() -> None:
-    """Shut down the multi-host runtime (reference: implicit MPI_Finalize)."""
+    """Shut down the multi-host runtime (reference: implicit MPI_Finalize).
+
+    Idempotent by contract: calling it twice, or without a preceding
+    ``init_distributed``, is a no-op — teardown paths (atexit handlers, test
+    fixtures, crash handlers) may all call it without coordinating."""
     import jax
 
     try:
         jax.distributed.shutdown()
-    except RuntimeError:
-        pass  # not initialized
+    except (RuntimeError, ValueError):
+        pass  # not initialized / already shut down
 
 
 def local_device_count() -> int:

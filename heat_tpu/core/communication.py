@@ -239,15 +239,29 @@ class Communication:
         on replicated data stays legal — PROVIDED this process holds a
         replica: an array on a sub-mesh of purely remote devices is
         "replicated" yet unreadable locally, and must allgather (found by
-        the -m mp lane's sub-mesh sweep)."""
-        if getattr(array, "is_fully_addressable", True) or (
-            getattr(array, "is_fully_replicated", False)
-            and len(array.addressable_shards) > 0
-        ):
-            return np.asarray(jax.device_get(array))
-        from jax.experimental import multihost_utils
+        the -m mp lane's sub-mesh sweep).
 
-        return np.asarray(multihost_utils.process_allgather(array, tiled=True))
+        Fault site ``comm.host_fetch``: transient injected faults are
+        retried with short backoff (every process fires the site the same
+        number of times — fault countdowns are process-local and the call
+        pattern is SPMD, so retries stay collective-aligned)."""
+        from ..utils import faults as _flt  # lazy: core imports before utils
+
+        def _fetch():
+            _flt.fire("comm.host_fetch")
+            if getattr(array, "is_fully_addressable", True) or (
+                getattr(array, "is_fully_replicated", False)
+                and len(array.addressable_shards) > 0
+            ):
+                return np.asarray(jax.device_get(array))
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(array, tiled=True))
+
+        return _flt.call_with_retries(
+            _fetch, "comm.host_fetch", retries=3, base_delay=0.02, max_delay=0.5,
+            retry_on=(_flt.TransientFault,),
+        )
 
     def shard(self, array: jax.Array, split: Optional[int]) -> jax.Array:
         """Place/constrain ``array`` to the sharding of ``split``.

@@ -11,8 +11,11 @@ subsystem).
 from __future__ import annotations
 
 import csv as _csv
+import io as _pyio
 import json
 import os
+import warnings
+import zlib
 from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -20,6 +23,9 @@ import numpy as np
 from . import devices, factories, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
+
+# stdlib-only module; safe to import from the innermost write paths
+from ..utils import faults as _faults
 
 __all__ = [
     "load",
@@ -39,7 +45,66 @@ __all__ = [
     "save_checkpoint",
     "save_array_checkpoint",
     "load_array_checkpoint",
+    "CheckpointCorruptionError",
 ]
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification: checksum mismatch,
+    missing/truncated chunk files, or unreadable metadata."""
+
+
+# retry policy for transient checkpoint-I/O faults (flaky disk, injected
+# TransientFault); tests shrink the delays — the schedule itself is unit
+# tested against a fake clock in tests/test_faults.py
+IO_RETRY = {"retries": 4, "base_delay": 0.02, "max_delay": 0.5, "jitter": 0.5}
+
+
+def _retry(fn, site: str, **over):
+    return _faults.call_with_retries(fn, site, **{**IO_RETRY, **over})
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so its entries (new files, renames) are durable —
+    file fsync alone does not persist the directory entry pointing at it."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        _faults.fire("io.fsync", path=path)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _durable_write(path: str, payload: bytes) -> None:
+    """Write ``payload`` to ``path`` and fsync the file handle, retrying the
+    whole write on transient faults (a partially-written attempt is simply
+    overwritten by the next one).  Fault sites: ``io.write`` (after the
+    bytes hit the file, before fsync — the corrupt mode flips a byte of the
+    on-disk file there) and ``io.fsync``."""
+
+    def attempt():
+        with open(path, "wb") as fh:
+            fh.write(payload)
+            fh.flush()
+            _faults.fire("io.write", path=path)
+            _faults.fire("io.fsync", path=path)
+            os.fsync(fh.fileno())
+
+    _retry(attempt, "io.write")
+
+
+def _read_file(path: str, site: str = "io.read") -> bytes:
+    """Read a whole file with transient-fault retry (missing files are NOT
+    retried — absence is a layout error, not a transient condition)."""
+
+    def attempt():
+        _faults.fire(site, path=path)
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    return _retry(
+        attempt, site, retry_if=lambda e: not isinstance(e, FileNotFoundError)
+    )
 
 # diagnostics: counts individual hyperslab writes so tests can prove writes
 # are chunked (peak host memory = one shard) rather than a full gather
@@ -690,19 +755,36 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
 # §5.4: tensorstore/zarr with per-shard writes; here one .npy per shard
 # chunk + a json manifest, dependency-free)
 # ---------------------------------------------------------------------- #
-def save_array_checkpoint(x: DNDarray, directory: str, donate: bool = False) -> None:
+def save_array_checkpoint(
+    x: DNDarray, directory: str, donate: bool = False, keep_versions: int = 1
+) -> None:
     """Checkpoint a (possibly huge) DNDarray as per-shard chunk files.
 
     Each shard is fetched and written individually — host memory stays at
     one chunk, so checkpointable size is disk-bound.  Layout:
-    ``meta.json`` (gshape, dtype, split, chunk starts) + ``chunk_<start>.npy``.
+    ``meta.json`` (gshape, dtype, split, chunk starts, per-chunk CRC32
+    checksums) + ``chunk_<start>.npy``.
+
+    Durability contract (see design.md "Failure model & recovery"): every
+    chunk file, ``meta.json`` and the version directory are fsynced BEFORE
+    the atomic ``LATEST`` rename makes the version visible, and the parent
+    directory is fsynced after the flip — a crash at any point leaves either
+    the previous complete version or the new complete version, never a torn
+    mix.  Transient write faults are retried with jittered exponential
+    backoff (``utils.profiler`` counter ``retry.io.write``).
 
     ``donate=True`` releases the array's device buffers as soon as the write
     completes (the checkpoint-and-swap pattern: evacuate state, then reuse
     the memory for the next resident) — ``x`` must not be used afterwards.
+
+    ``keep_versions`` retains that many complete versions after the flip
+    (default 1: only the new one — the seed behavior).  Keeping >= 2 lets
+    :func:`load_array_checkpoint` fall back to the previous version when the
+    latest is later found corrupted (bit rot, partial loss).
     """
     if not isinstance(x, DNDarray):
         x = factories.array(x)
+    keep_versions = max(int(keep_versions), 1)
     os.makedirs(directory, exist_ok=True)
     # crash-safe layout: each save goes into a fresh v<k>/ subdirectory and
     # LATEST is flipped atomically afterwards — an interrupted re-save can
@@ -718,23 +800,33 @@ def save_array_checkpoint(x: DNDarray, directory: str, donate: bool = False) -> 
     vdir = os.path.join(directory, f"v{version}")
     os.makedirs(vdir, exist_ok=True)
     split = x.split
-    starts = []
+    starts, checksums, chunk_bytes = [], {}, {}
     for slices, chunk in _iter_hyperslabs(x):
         start = slices[split].start if split is not None else 0
         starts.append(int(start))
-        np.save(os.path.join(vdir, f"chunk_{start}.npy"), chunk)
+        # serialize to memory first: the checksum is computed from what the
+        # writer MEANT to write, so later on-disk corruption is detectable
+        buf = _pyio.BytesIO()
+        np.save(buf, chunk)
+        payload = buf.getvalue()
+        checksums[str(start)] = zlib.crc32(payload)
+        chunk_bytes[str(start)] = len(payload)
+        _durable_write(os.path.join(vdir, f"chunk_{start}.npy"), payload)
     meta = {
         "gshape": list(x.shape),
         "dtype": str(x.dtype.np_dtype().name),
         "split": split,
         "starts": sorted(starts),
+        "checksums": checksums,
+        "chunk_bytes": chunk_bytes,
     }
-    with open(os.path.join(vdir, "meta.json"), "w") as fh:
-        json.dump(meta, fh)
+    _durable_write(os.path.join(vdir, "meta.json"), json.dumps(meta).encode())
+    _fsync_dir(vdir)        # chunk/meta directory entries durable
     tmp = os.path.join(directory, ".LATEST.tmp")
-    with open(tmp, "w") as fh:
-        fh.write(f"v{version}")
+    _durable_write(tmp, f"v{version}".encode())
+    _fsync_dir(directory)   # v<k>/ and the tmp file durable BEFORE the flip
     os.replace(tmp, os.path.join(directory, "LATEST"))  # atomic flip
+    _fsync_dir(directory)   # the flip itself durable
     if donate:
         # the write is durable (post-flip): free the device storage now
         try:
@@ -743,7 +835,7 @@ def save_array_checkpoint(x: DNDarray, directory: str, donate: bool = False) -> 
             pass
     import shutil
 
-    for old in existing:
+    for old in sorted(existing, reverse=True)[keep_versions - 1 :]:
         shutil.rmtree(os.path.join(directory, f"v{old}"), ignore_errors=True)
     # legacy flat-format files (pre-versioned layout) stay valid until the
     # flip, then must go: globbing consumers would read stale data
@@ -755,6 +847,82 @@ def save_array_checkpoint(x: DNDarray, directory: str, donate: bool = False) -> 
                 pass
 
 
+def _verify_version(vdir: str) -> dict:
+    """Integrity-check one checkpoint version directory; returns its meta.
+
+    Raises :class:`CheckpointCorruptionError` on unreadable metadata, a
+    chunk set that does not match ``meta['starts']`` (naming exactly which
+    chunks are absent), a truncated chunk, or a CRC32 mismatch.  Checksums
+    are verified one chunk at a time — peak memory stays at one chunk.
+    Pre-checksum (legacy) versions verify layout only.
+    """
+    meta_path = os.path.join(vdir, "meta.json")
+    if not os.path.exists(meta_path):
+        raise CheckpointCorruptionError(f"no meta.json under {vdir!r}")
+    try:
+        meta = json.loads(_read_file(meta_path).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptionError(f"unreadable meta.json under {vdir!r}: {e}") from e
+    for key in ("gshape", "dtype", "starts"):
+        if key not in meta:
+            raise CheckpointCorruptionError(f"meta.json under {vdir!r} lacks {key!r}")
+    expected = {f"chunk_{s}.npy" for s in meta["starts"]}
+    present = {f for f in os.listdir(vdir) if f.startswith("chunk_") and f.endswith(".npy")}
+    missing = sorted(expected - present)
+    if missing:
+        raise CheckpointCorruptionError(
+            f"checkpoint {vdir!r} is missing chunk files {missing} "
+            f"(meta lists starts {meta['starts']}, found {sorted(present)})"
+        )
+    checksums = meta.get("checksums")
+    if checksums:
+        sizes = meta.get("chunk_bytes", {})
+        for s in meta["starts"]:
+            path = os.path.join(vdir, f"chunk_{s}.npy")
+            payload = _read_file(path)
+            want_n = sizes.get(str(s))
+            if want_n is not None and len(payload) != int(want_n):
+                raise CheckpointCorruptionError(
+                    f"chunk {path!r} is truncated: {len(payload)} bytes on disk, "
+                    f"{want_n} recorded at save time"
+                )
+            crc = zlib.crc32(payload)
+            if crc != int(checksums[str(s)]):
+                raise CheckpointCorruptionError(
+                    f"chunk {path!r} fails its checksum: crc32 {crc:#010x} != "
+                    f"recorded {int(checksums[str(s)]):#010x}"
+                )
+    return meta
+
+
+def _checkpoint_candidates(directory: str):
+    """Version directories to try, most-preferred first: the one ``LATEST``
+    points at, then remaining versions newest-first, then the legacy flat
+    layout (pre-versioned checkpoints kept meta.json at the top level)."""
+    latest_target = None
+    latest = os.path.join(directory, "LATEST")
+    if os.path.exists(latest):
+        latest_target = _read_file(latest).decode().strip()
+    versions = sorted(
+        (
+            int(d[1:]) for d in os.listdir(directory)
+            if d.startswith("v") and d[1:].isdigit()
+            and os.path.isdir(os.path.join(directory, d))
+        ),
+        reverse=True,
+    )
+    out = []
+    if latest_target is not None and os.path.isdir(os.path.join(directory, latest_target)):
+        out.append((os.path.join(directory, latest_target), latest_target))
+    for v in versions:
+        name = f"v{v}"
+        if name != latest_target:
+            out.append((os.path.join(directory, name), name))
+    if os.path.exists(os.path.join(directory, "meta.json")):
+        out.append((directory, "<legacy flat layout>"))
+    return out
+
+
 def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
     """Restore a DNDarray saved by :func:`save_array_checkpoint`.
 
@@ -764,15 +932,45 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
     a checkpoint that was too big to gather on save is loadable too.  The
     loader's mesh size may differ from the saver's (chunk boundaries are
     re-cut to the loader's ceil-div grid).
+
+    Every candidate version is integrity-checked before assembly (chunk set
+    vs ``meta['starts']``, per-chunk CRC32): if the version ``LATEST`` points
+    at fails verification, the loader falls back to the newest older version
+    that verifies (with a warning naming why) — a corrupted latest version
+    degrades to the previous checkpoint instead of a crash.  When nothing
+    verifies, :class:`CheckpointCorruptionError` reports every candidate's
+    failure.
     """
     import jax
 
-    latest = os.path.join(directory, "LATEST")
-    if os.path.exists(latest):
-        with open(latest) as fh:
-            directory = os.path.join(directory, fh.read().strip())
-    with open(os.path.join(directory, "meta.json")) as fh:
-        meta = json.load(fh)
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"checkpoint directory {directory!r} does not exist")
+    candidates = _checkpoint_candidates(directory)
+    if not candidates:
+        raise FileNotFoundError(
+            f"no checkpoint versions under {directory!r} (no LATEST, no v<k>/ "
+            "directories, no legacy meta.json)"
+        )
+    meta, chosen, failures = None, None, []
+    for vdir, label in candidates:
+        try:
+            meta = _verify_version(vdir)
+            chosen = (vdir, label)
+            break
+        except CheckpointCorruptionError as e:
+            failures.append(f"{label}: {e}")
+    if chosen is None:
+        raise CheckpointCorruptionError(
+            f"no loadable checkpoint under {directory!r}; every version failed "
+            "verification: " + " | ".join(failures)
+        )
+    if failures:
+        warnings.warn(
+            f"checkpoint version {candidates[0][1]} under {directory!r} failed "
+            f"verification ({failures[0]}); falling back to {chosen[1]}",
+            stacklevel=2,
+        )
+    directory = chosen[0]
     gshape = tuple(meta["gshape"])
     split = meta["split"]
     np_dtype = np.dtype(meta["dtype"])
@@ -829,9 +1027,17 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
 # pytree checkpointing (estimator/NN state; SURVEY §5.4 orbax-style dump)
 # ---------------------------------------------------------------------- #
 def save_checkpoint(tree, path: str) -> None:
-    """Save a pytree of arrays (params/opt state) to an .npz + structure json."""
+    """Save a pytree of arrays (params/opt state) to an .npz + structure json.
+
+    The write is ATOMIC: the archive is serialized to memory, written to a
+    ``<path>.tmp`` sibling, fsynced, and renamed over the destination (then
+    the directory is fsynced) — a crash mid-save can never destroy an
+    existing checkpoint, which the previous in-place ``np.savez`` could.
+    Transient write faults are retried with backoff (``retry.io.write``).
+    """
     import jax
 
+    final = path if path.endswith(".npz") else path + ".npz"
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     # ONE batched device→host transfer for the whole tree: per-leaf
     # np.asarray would issue a blocking round-trip per parameter, turning a
@@ -842,24 +1048,91 @@ def save_checkpoint(tree, path: str) -> None:
     for i, ((p, _), host) in enumerate(zip(flat, leaves)):
         keys.append(jax.tree_util.keystr(p))
         arrays[f"leaf_{i}"] = np.asarray(host)
-    np.savez(path, __keys__=np.asarray(json.dumps(keys)), **arrays)
+    tmp = final + ".tmp"
+
+    def attempt():
+        # stream the archive straight into the tmp file: no second full
+        # in-memory copy of the model on top of the device_get'd leaves
+        with open(tmp, "wb") as fh:
+            np.savez(fh, __keys__=np.asarray(json.dumps(keys)), **arrays)
+            fh.flush()
+            _faults.fire("io.write", path=tmp)
+            _faults.fire("io.fsync", path=tmp)
+            os.fsync(fh.fileno())
+
+    _retry(attempt, "io.write")
+    os.replace(tmp, final)  # atomic: readers see the old or the new file
+    _fsync_dir(os.path.dirname(os.path.abspath(final)))
 
 
 def load_checkpoint(tree_like, path: str):
     """Restore a pytree saved by :func:`save_checkpoint` into the structure
-    of ``tree_like`` (structure paths are validated against the checkpoint —
-    a refactored/reordered tree raises instead of silently misassigning)."""
+    of ``tree_like``.
+
+    Three layers of validation, each with an error naming the file:
+
+    - the archive must exist and be readable (a truncated/corrupt ``.npz``
+      raises :class:`CheckpointCorruptionError`, not a bare zipfile error);
+    - structure paths must match ``tree_like`` (a refactored/reordered tree
+      raises instead of silently misassigning);
+    - every leaf's shape and dtype must match its ``tree_like`` counterpart
+      (a reshaped layer raises instead of silently loading wrong weights).
+    """
+    import zipfile
+
     import jax
     import jax.numpy as jnp
 
-    data = np.load(path if path.endswith(".npz") else path + ".npz", allow_pickle=False)
+    p = path if path.endswith(".npz") else path + ".npz"
+    if not os.path.exists(p):
+        raise FileNotFoundError(
+            f"checkpoint file {p!r} does not exist"
+            + (f" (given path {path!r})" if p != path else "")
+        )
+    try:
+        data = np.load(p, allow_pickle=False)
+        saved_keys = json.loads(str(data["__keys__"]))
+    except KeyError as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint {p!r} has no '__keys__' entry — not a heat_tpu pytree "
+            "checkpoint, or truncated mid-write"
+        ) from e
+    except (zipfile.BadZipFile, OSError, ValueError) as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint {p!r} is unreadable (truncated or corrupt): {e}"
+        ) from e
     flat_p, treedef = jax.tree_util.tree_flatten_with_path(tree_like)
-    saved_keys = json.loads(str(data["__keys__"]))
-    live_keys = [jax.tree_util.keystr(p) for p, _ in flat_p]
+    live_keys = [jax.tree_util.keystr(kp) for kp, _ in flat_p]
     if saved_keys != live_keys:
         raise ValueError(
             "checkpoint structure mismatch: saved paths "
             f"{saved_keys[:3]}... != target paths {live_keys[:3]}..."
         )
-    leaves = [jnp.asarray(data[f"leaf_{i}"]) for i in range(len(flat_p))]
+    leaves = []
+    for i, (kp, like) in enumerate(flat_p):
+        name = jax.tree_util.keystr(kp)
+        try:
+            arr = data[f"leaf_{i}"]
+        except KeyError as e:
+            raise CheckpointCorruptionError(
+                f"checkpoint {p!r} lacks leaf_{i} ({name}) — truncated archive"
+            ) from e
+        except (zipfile.BadZipFile, zlib.error, OSError) as e:
+            raise CheckpointCorruptionError(
+                f"checkpoint {p!r}: leaf_{i} ({name}) is corrupt: {e}"
+            ) from e
+        want_shape = getattr(like, "shape", None)
+        if want_shape is not None and tuple(arr.shape) != tuple(want_shape):
+            raise ValueError(
+                f"checkpoint {p!r}: leaf {name} has shape {tuple(arr.shape)} "
+                f"but the target tree expects {tuple(want_shape)} — refusing "
+                "to load a reshaped parameter"
+            )
+        want_dtype = getattr(like, "dtype", None)
+        if want_dtype is not None and np.dtype(arr.dtype) != np.dtype(want_dtype):
+            raise ValueError(
+                f"checkpoint {p!r}: leaf {name} has dtype {np.dtype(arr.dtype)} "
+                f"but the target tree expects {np.dtype(want_dtype)}"
+            )
+        leaves.append(jnp.asarray(arr))
     return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -123,6 +123,12 @@ class DataParallel:
         layers (Dropout) — without it, a Dropout layer raises so that
         regularization can never be silently inactive during training.
 
+        The optimizer's non-finite guard (``guard_nonfinite=True``, the
+        default) is compiled INTO this step: a NaN/Inf gradient makes the
+        jitted program keep params and optimizer state unchanged and bump
+        the device-resident skip counter — no host sync, no poisoned model;
+        inspect via ``optimizer.guard_stats(opt_state)``.
+
         ``donate=True`` (default) donates params and opt_state to the step:
         XLA aliases the updated state onto the incoming buffers, so training
         holds ONE copy of the model state instead of two.  The train loop

@@ -17,7 +17,8 @@ blend, giving the reference's fire-and-forget overlap without request objects.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+import os
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +27,91 @@ import optax
 from ..core import devices
 from ..core.communication import Communication
 
-__all__ = ["DataParallelOptimizer", "DASO", "SGD", "Adam", "AdamW"]
+__all__ = [
+    "DataParallelOptimizer",
+    "DASO",
+    "SGD",
+    "Adam",
+    "AdamW",
+    "nonfinite_guard",
+    "NonFiniteGuardState",
+]
+
+
+class NonFiniteGuardState(NamedTuple):
+    """State of :func:`nonfinite_guard`: the wrapped optimizer's state plus
+    DEVICE-RESIDENT step/skip counters (0-d int32 — reading them is the only
+    host sync, and it happens at reporting time, never on the step path)."""
+
+    inner_state: Any
+    steps: Any
+    skipped: Any
+
+
+def nonfinite_guard(inner: "optax.GradientTransformation") -> "optax.GradientTransformation":
+    """Wrap ``inner`` so a non-finite gradient skips the whole update ON
+    DEVICE (SURVEY §5.4 guarded training): one all-reduced finite flag —
+    under data parallelism the gradients arriving here are already the
+    cross-replica mean, so any replica's NaN/Inf has propagated into every
+    replica's copy and the flag agrees SPMD-wide — selects between the
+    updated and the previous params/optimizer state with ``jnp.where``.  No
+    host sync, no ``float()``: a NaN blow-up costs one skipped step, not a
+    poisoned model.  Skip/step counters ride in the state and surface via
+    ``DataParallelOptimizer.guard_stats`` / ``DASO.skip_stats`` /
+    ``utils.profiler.counters()``."""
+
+    def init_fn(params):
+        return NonFiniteGuardState(
+            inner.init(params), jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)
+        )
+
+    def update_fn(updates, state, params=None):
+        leaves = jax.tree_util.tree_leaves(updates)
+        if leaves:
+            finite = jnp.all(
+                jnp.stack([jnp.all(jnp.isfinite(u)) for u in leaves])
+            )
+        else:
+            finite = jnp.asarray(True)
+        new_updates, new_inner = inner.update(updates, state.inner_state, params)
+
+        def sel(new, old):
+            try:
+                return jnp.where(finite, new, old)
+            except TypeError:
+                return new  # non-numeric state leaf: keep the update
+
+        guarded = jax.tree.map(lambda u: sel(u, jnp.zeros_like(u)), new_updates)
+        inner_sel = jax.tree.map(sel, new_inner, state.inner_state)
+        return guarded, NonFiniteGuardState(
+            inner_sel,
+            state.steps + 1,
+            state.skipped + jnp.where(finite, 0, 1).astype(jnp.int32),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _guard_counters(opt_state) -> dict:
+    """{'steps': int, 'skipped': int} summed over any leading replica axes
+    (DASO broadcasts the counters per dcn group).  Syncs the two 0-d/1-d
+    counter arrays — call at reporting boundaries only."""
+    if not isinstance(opt_state, NonFiniteGuardState):
+        return {}
+    try:
+        steps, skipped = jax.device_get((opt_state.steps, opt_state.skipped))
+    except RuntimeError as e:
+        if "deleted" not in str(e).lower():
+            raise
+        # the tracked tree was DONATED to a jitted step (make_train_step's
+        # default) — the live state is whatever the train loop rebound
+        raise RuntimeError(
+            "optimizer state buffers were donated to the train step; pass "
+            "the current state explicitly: guard_stats(opt_state)"
+        ) from e
+    import numpy as _np
+
+    return {"steps": int(_np.max(steps)), "skipped": int(_np.sum(skipped))}
 
 
 def _nontrainable_mask(params):
@@ -86,13 +171,20 @@ class DataParallelOptimizer:
 
     Accepts an optax GradientTransformation, or a name ('sgd' | 'adam' |
     'adamw') + kwargs, mirroring ``ht.optim.DataParallelOptimizer(torch_opt)``.
+
+    ``guard_nonfinite`` (default True) compiles a non-finite guard into every
+    update — a NaN/Inf gradient skips the step on device (params and inner
+    optimizer state unchanged, skip counter incremented) instead of poisoning
+    the model; see :func:`nonfinite_guard`.  Counters: :meth:`guard_stats`.
     """
 
-    def __init__(self, optimizer, blocking: bool = False, **kwargs):
+    def __init__(self, optimizer, blocking: bool = False, guard_nonfinite: bool = True, **kwargs):
         if isinstance(optimizer, str):
             optimizer = _named_optimizer(optimizer, **kwargs)
         # buffers (BatchNorm running stats) get neither updates nor decay
-        self.optax_optimizer = _mask_buffers(optimizer)
+        base = _mask_buffers(optimizer)
+        self.guarded = bool(guard_nonfinite)
+        self.optax_optimizer = nonfinite_guard(base) if self.guarded else base
         self.blocking = blocking
         self._dp = None
         self._opt_state = None
@@ -126,6 +218,13 @@ class DataParallelOptimizer:
     def zero_grad(self) -> None:
         """No-op: JAX gradients are functional (kept for API parity)."""
 
+    def guard_stats(self, opt_state=None) -> dict:
+        """{'steps', 'skipped'} of the non-finite guard.  Pass the state your
+        train loop threads through a jitted step; defaults to the eagerly
+        tracked one.  Syncs two scalars — call at reporting boundaries."""
+        s = opt_state if opt_state is not None else self._opt_state
+        return _guard_counters(s) or {"steps": 0, "skipped": 0}
+
 
 class DASO:
     """Hierarchical async data parallelism on a ('dcn', 'ici') mesh.
@@ -152,6 +251,8 @@ class DASO:
         total_epochs: Optional[int] = None,
         plateau_tol: float = 0.05,
         mesh=None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
     ):
         if isinstance(local_optimizer, DataParallelOptimizer):
             self.local_optimizer = local_optimizer
@@ -191,6 +292,20 @@ class DASO:
         self._pending = None  # (dispatched global average, due_step)
         self._train_step = None
         self._sync_step = None
+        # opt-in durable auto-checkpoint: every K steps the full training
+        # state (per-group params + opt state + step count) is written
+        # atomically; resume() restores it after a preemption/crash
+        if checkpoint_every is not None and checkpoint_dir is None:
+            raise ValueError("checkpoint_every requires checkpoint_dir")
+        self.checkpoint_every = int(checkpoint_every) if checkpoint_every else None
+        self.checkpoint_dir = checkpoint_dir
+        from ..utils import profiler as _profiler
+
+        # unique per instance ("daso", "daso2", ...): concurrent optimizers
+        # never shadow each other's counters in profiler.counters()
+        self.profiler_key = _profiler.register_counter_provider(
+            "daso", self._counter_snapshot
+        )
 
     @staticmethod
     def _default_ici(n: int) -> int:
@@ -374,6 +489,8 @@ class DASO:
                     self._params = self._blend(self._params, avg, self.staleness_weight)
                 else:
                     self._pending = (avg, t + self.stale_steps)
+        if self.checkpoint_every and t % self.checkpoint_every == 0:
+            self.checkpoint()
         # asynchronous loss: a 0-d device array (duck-types float) — the old
         # float(...) here was a blocking host sync on EVERY step, serializing
         # the train loop on the slowest collective.  Callers that need the
@@ -433,3 +550,81 @@ class DASO:
 
     def zero_grad(self) -> None:
         """No-op (API parity)."""
+
+    # ------------------------------------------------------------------ #
+    # failure hardening: skip counters + durable checkpoint/resume
+    # ------------------------------------------------------------------ #
+    def skip_stats(self) -> dict:
+        """{'steps': train steps taken, 'skipped': group-updates suppressed
+        by the non-finite guard}.  The skip counter lives ON DEVICE inside
+        the optimizer state (no host sync on the step path); reading here
+        syncs it."""
+        counters = _guard_counters(getattr(self, "_opt_state", None))
+        return {"steps": self._step_count, "skipped": counters.get("skipped", 0)}
+
+    def _counter_snapshot(self) -> dict:
+        """utils.profiler counter provider (polled at reporting time)."""
+        s = self.skip_stats()
+        return {"steps": s["steps"], "skipped_steps": s["skipped"]}
+
+    _CKPT_NAME = "daso_state.npz"
+
+    def checkpoint(self, directory: Optional[str] = None) -> str:
+        """Atomically checkpoint the full training state (per-group params,
+        optimizer state incl. guard counters, step count) to
+        ``<dir>/daso_state.npz`` via the durable pytree writer; returns the
+        path.  Called automatically every ``checkpoint_every`` steps."""
+        from ..core import io as _io
+
+        d = directory or self.checkpoint_dir
+        if d is None:
+            raise ValueError("no checkpoint directory configured")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, self._CKPT_NAME)
+        tree = {
+            "params": self._params,
+            "opt_state": self._opt_state,
+            "step": jnp.asarray(self._step_count, jnp.int32),
+        }
+        _io.save_checkpoint(tree, path)
+        return path
+
+    def resume(self, directory: Optional[str] = None) -> bool:
+        """Restore the newest auto-checkpoint (False when none exists yet).
+        Call after :meth:`init` — the live params/opt-state tree provides the
+        structure, dtypes and shardings the loaded leaves are validated
+        against and placed back onto.  Any in-flight global average is
+        dropped (it refers to pre-crash state)."""
+        from ..core import io as _io
+
+        d = directory or self.checkpoint_dir
+        if d is None:
+            raise ValueError("no checkpoint directory configured")
+        path = os.path.join(d, self._CKPT_NAME)
+        if not os.path.exists(path):
+            return False
+        if not hasattr(self, "_params"):
+            raise RuntimeError("call init() before resume(): the live tree "
+                               "provides the structure to restore into")
+        tree_like = {
+            "params": self._params,
+            "opt_state": self._opt_state,
+            "step": jnp.asarray(0, jnp.int32),
+        }
+        loaded = _io.load_checkpoint(tree_like, path)
+        from jax.sharding import NamedSharding
+
+        def place(new, old):
+            # restore mesh shardings (params live sharded over 'dcn');
+            # everything else stays UNcommitted like init() leaves it, so
+            # jit remains free to co-locate it with the params
+            sh = getattr(old, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.device_put(jnp.asarray(new), sh)
+            return jnp.asarray(new)
+
+        self._params = jax.tree.map(place, loaded["params"], self._params)
+        self._opt_state = jax.tree.map(place, loaded["opt_state"], self._opt_state)
+        self._step_count = int(loaded["step"])
+        self._pending = None
+        return True

@@ -1,4 +1,5 @@
 """Utilities (reference: ``heat/utils/``)."""
 
 from . import data
+from . import faults
 from . import profiler

@@ -1,0 +1,264 @@
+"""Deterministic fault injection + bounded retry (the failure-hardening core).
+
+A production run survives torn writes, flaky storage and slow coordinators
+only if every recovery path is *testable on CPU*; this module provides the
+two halves of that story:
+
+- **fault sites**: named points threaded through the runtime where a test
+  (or a chaos job) can deterministically inject a failure.  The catalog
+  lives in ``doc/source/design.md`` ("Failure model & recovery"):
+
+  ========================  ====================================================
+  site                      fired from
+  ========================  ====================================================
+  ``io.write``              every durable checkpoint file write (chunk files,
+                            ``meta.json``, ``LATEST`` tmp, pytree ``.npz``)
+  ``io.read``               checkpoint verification/assembly reads
+  ``io.fsync``              every fsync of a checkpoint file or directory
+  ``comm.host_fetch``       ``Communication.host_fetch`` (device→host fetches)
+  ``dist.init``             each ``jax.distributed.initialize`` attempt in
+                            ``bootstrap.init_distributed``
+  ========================  ====================================================
+
+- **retry with backoff**: :func:`call_with_retries` — capped, jittered
+  exponential backoff around transient faults, with attempt counters pushed
+  into ``utils.profiler`` (``retry.<site>``) so recoveries are observable.
+
+Faults are armed either in-process::
+
+    with faults.inject("io.write", fail=2):
+        ht.save_array_checkpoint(x, d)   # first two chunk writes fail, then heal
+
+or across a process boundary via the environment (the chaos lane's SIGKILL
+tests configure the victim subprocess this way)::
+
+    HEAT_TPU_FAULTS="io.write:delay=0.3;io.fsync:fail=1"
+
+Modes per site (combinable):
+
+- ``fail=N``     raise :class:`TransientFault` on the first N firings
+  (``N=-1``: every firing); ``exc=`` overrides the exception type.
+- ``delay=S``    sleep S seconds on every firing — widens crash windows so a
+  SIGKILL deterministically lands inside a write loop.
+- ``corrupt=N``  flip one byte of the file passed as ``fire(..., path=)`` on
+  the first N firings — models bit rot / torn sectors *after* the writer
+  computed its checksum.
+
+Everything here is stdlib-only on purpose: the registry is imported from the
+innermost I/O and bootstrap paths, where a heavy import would be a cycle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+__all__ = [
+    "InjectedFault",
+    "TransientFault",
+    "FaultSpec",
+    "inject",
+    "fire",
+    "trip_count",
+    "reset_trips",
+    "parse_spec",
+    "backoff_schedule",
+    "call_with_retries",
+]
+
+
+class InjectedFault(Exception):
+    """Base class of every injected failure."""
+
+
+class TransientFault(InjectedFault, OSError):
+    """An injected failure that models a *transient* condition (flaky disk,
+    slow coordinator) — the retry layer treats it as retryable.  Subclasses
+    ``OSError`` so code with real-world ``except OSError`` handling exercises
+    the same path the genuine failure would take."""
+
+
+class FaultSpec:
+    """Armed behavior of one site.  ``fail``/``corrupt`` are countdowns
+    (mutated as the site fires; ``-1`` = unlimited); ``delay`` applies to
+    every firing."""
+
+    __slots__ = ("site", "fail", "delay", "corrupt", "exc")
+
+    def __init__(
+        self,
+        site: str,
+        fail: int = 0,
+        delay: float = 0.0,
+        corrupt: int = 0,
+        exc: type = TransientFault,
+    ):
+        self.site = site
+        self.fail = int(fail)
+        self.delay = float(delay)
+        self.corrupt = int(corrupt)
+        self.exc = exc
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"FaultSpec({self.site!r}, fail={self.fail}, delay={self.delay}, "
+            f"corrupt={self.corrupt})"
+        )
+
+
+def parse_spec(text: str) -> Dict[str, FaultSpec]:
+    """Parse the ``HEAT_TPU_FAULTS`` grammar:
+    ``site:key=val,key=val;site2:key=val`` with keys fail/delay/corrupt."""
+    specs: Dict[str, FaultSpec] = {}
+    for entry in filter(None, (e.strip() for e in text.split(";"))):
+        site, _, kvs = entry.partition(":")
+        site = site.strip()
+        if not site:
+            raise ValueError(f"empty fault site in {text!r}")
+        kw: Dict[str, float] = {}
+        for kv in filter(None, (p.strip() for p in kvs.split(","))):
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k not in ("fail", "delay", "corrupt"):
+                raise ValueError(f"unknown fault mode {k!r} for site {site!r}")
+            kw[k] = float(v) if k == "delay" else int(v)
+        specs[site] = FaultSpec(site, **kw)
+    return specs
+
+
+# env-armed specs (subprocess chaos tests) parsed once at import; in-process
+# tests use the contextvar so parallel/nested scopes stay isolated
+_ENV: Dict[str, FaultSpec] = parse_spec(os.environ.get("HEAT_TPU_FAULTS", ""))
+_ctx: contextvars.ContextVar[Optional[Dict[str, FaultSpec]]] = contextvars.ContextVar(
+    "heat_tpu_faults", default=None
+)
+_trips: Dict[str, int] = {}
+
+
+@contextlib.contextmanager
+def inject(
+    site: str,
+    *,
+    fail: int = 0,
+    delay: float = 0.0,
+    corrupt: int = 0,
+    exc: type = TransientFault,
+) -> Iterator[FaultSpec]:
+    """Arm ``site`` for the duration of the block (nests; yields the live
+    spec so tests can inspect the remaining countdown)."""
+    spec = FaultSpec(site, fail=fail, delay=delay, corrupt=corrupt, exc=exc)
+    current = dict(_ctx.get() or {})
+    current[site] = spec
+    token = _ctx.set(current)
+    try:
+        yield spec
+    finally:
+        _ctx.reset(token)
+
+
+def _flip_byte(path: str) -> None:
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    offset = size // 2
+    with open(path, "r+b") as fh:
+        fh.seek(offset)
+        b = fh.read(1)
+        fh.seek(offset)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+def fire(site: str, path: Optional[str] = None) -> None:
+    """Trip ``site`` if armed: delay, then corrupt ``path``, then fail.
+    A disarmed site is a dict miss — cheap enough for hot paths."""
+    ctx = _ctx.get()
+    if ctx is None and not _ENV:
+        return
+    spec = (ctx or {}).get(site) or _ENV.get(site)
+    if spec is None:
+        return
+    _trips[site] = _trips.get(site, 0) + 1
+    if spec.delay:
+        time.sleep(spec.delay)
+    if spec.corrupt != 0 and path is not None:
+        if spec.corrupt > 0:
+            spec.corrupt -= 1
+        _flip_byte(path)
+    if spec.fail != 0:
+        if spec.fail > 0:
+            spec.fail -= 1
+        raise spec.exc(f"injected fault at site {site!r}")
+
+
+def trip_count(site: str) -> int:
+    """How many times ``site`` fired while armed (since :func:`reset_trips`)."""
+    return _trips.get(site, 0)
+
+
+def reset_trips() -> None:
+    _trips.clear()
+
+
+# ---------------------------------------------------------------------- #
+# bounded retry with jittered exponential backoff
+# ---------------------------------------------------------------------- #
+def backoff_schedule(
+    retries: int,
+    base_delay: float = 0.05,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    rand: Optional[Callable[[], float]] = None,
+) -> Iterator[float]:
+    """The delays slept between attempts: ``min(max_delay, base*factor**i)``
+    stretched by up to ``jitter``× a uniform draw (decorrelates the retry
+    storms of many writers hitting one flaky store).  ``rand`` is injectable
+    so tests pin the schedule without sleeping."""
+    if rand is None:
+        import random
+
+        rand = random.random
+    for i in range(retries):
+        yield min(max_delay, base_delay * factor**i) * (1.0 + jitter * rand())
+
+
+def call_with_retries(
+    fn: Callable,
+    site: str,
+    retries: int = 4,
+    base_delay: float = 0.05,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    retry_on: Tuple[type, ...] = (TransientFault, OSError),
+    retry_if: Optional[Callable[[BaseException], bool]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rand: Optional[Callable[[], float]] = None,
+):
+    """Run ``fn()`` with up to ``retries`` backoff retries on transient
+    failures.  Each retry increments the ``retry.<site>`` counter in
+    ``utils.profiler`` so recovered faults stay visible.  ``retry_if``
+    narrows ``retry_on`` (e.g. only coordinator-unreachable RuntimeErrors);
+    ``sleep``/``rand`` are injectable for fake-clock tests."""
+    delays = None
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if retry_if is not None and not retry_if(e):
+                raise
+            if attempt >= retries:
+                raise
+            if delays is None:
+                delays = list(
+                    backoff_schedule(retries, base_delay, factor, max_delay, jitter, rand)
+                )
+            from . import profiler
+
+            profiler.counter_inc(f"retry.{site}")
+            sleep(delays[attempt])
+            attempt += 1

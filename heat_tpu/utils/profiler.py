@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Optional
+import weakref
+from typing import Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -26,7 +27,69 @@ __all__ = [
     "cache_stats",
     "reset_cache_stats",
     "cache_hit_rate",
+    "counter_inc",
+    "counters",
+    "reset_counters",
+    "register_counter_provider",
 ]
+
+
+# ---------------------------------------------------------------------- #
+# generic event counters (retry attempts, skipped train steps, ...)
+# ---------------------------------------------------------------------- #
+# Two sources merge in counters(): plain incremented counters (retry.<site>
+# from utils.faults) and registered *providers* — callbacks polled at read
+# time so device-resident counters (DASO's skip counter is a jax array,
+# updated asynchronously with NO host sync on the step path) only
+# materialize when somebody actually asks.
+_counters: Dict[str, int] = {}
+_providers: Dict[str, Callable[[], Dict[str, int]]] = {}
+
+
+def counter_inc(name: str, n: int = 1) -> None:
+    """Increment a named event counter (host-side, cheap)."""
+    _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def register_counter_provider(name: str, fn: Callable[[], Dict[str, int]]) -> str:
+    """Register a callback polled by :func:`counters`.  Bound methods are
+    held weakly so registering does not pin the owning object alive (a dead
+    provider is pruned at the next :func:`counters` read).  ``name`` is
+    de-duplicated with a numeric suffix — a second registrant never silently
+    replaces the first — and the effective name is returned."""
+    if hasattr(fn, "__self__"):
+        ref = weakref.WeakMethod(fn)
+
+        def fn():  # noqa: F811 — the weak indirection replaces the strong ref
+            m = ref()
+            return m() if m is not None else None  # None: owner was collected
+
+    base, k = name, 2
+    while name in _providers:
+        name = f"{base}{k}"
+        k += 1
+    _providers[name] = fn
+    return name
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of all counters: incremented ones plus every provider's
+    current values.  May sync device-resident counters — call it at
+    reporting boundaries, not inside the hot loop."""
+    out = dict(_counters)
+    for name, fn in list(_providers.items()):
+        vals = fn()
+        if vals is None:  # provider's owner was garbage collected
+            _providers.pop(name, None)
+            continue
+        for k, v in vals.items():
+            out[f"{name}.{k}" if not k.startswith(name) else k] = int(v)
+    return out
+
+
+def reset_counters() -> None:
+    """Clear the incremented counters (providers re-report on next read)."""
+    _counters.clear()
 
 
 def cache_hit_rate() -> float:

@@ -86,6 +86,10 @@ def launch_pytest(timeout: float = 1500.0, n_proc: int = 2,
         env["HEAT_MP_TMP"] = tmpdir
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONUNBUFFERED"] = "1"
+        # rank self-watchdog (see tests/conftest.py): dump stacks + exit
+        # shortly BEFORE this launcher's own deadline, so a wedged
+        # collective yields tracebacks in the rank log, not a silent kill
+        env.setdefault("HEAT_MP_WATCHDOG", str(max(60, int(timeout) - 60)))
         # stream to files (not PIPE): a wedged rank's progress stays
         # inspectable mid-run, and full buffers can't deadlock the child
         log = open(os.path.join(tmpdir, f"rank{pid}.log"), "w+b")
@@ -104,10 +108,10 @@ def launch_pytest(timeout: float = 1500.0, n_proc: int = 2,
         if any(c is not None and c != 0 for c in codes):
             break  # one rank failed: peers will wedge on its collectives
         time.sleep(0.5)
+    _dump_stacks_then_kill(procs)
     results = []
     for p, log in zip(procs, logs):
         if p.poll() is None:
-            p.kill()
             p.wait()
         log.seek(0)
         results.append((p.returncode, log.read().decode(errors="replace")))
@@ -123,10 +127,54 @@ def _free_port() -> int:
     return port
 
 
+def _dump_stacks_then_kill(procs, grace: float = 3.0) -> bool:
+    """Watchdog teardown for wedged workers: SIGUSR1 each live process (the
+    workers registered a faulthandler stack dump on it, so every thread's
+    traceback lands in that rank's output), give them ``grace`` seconds to
+    finish dumping, then kill.  Returns True iff any process had to be
+    reaped — per-process stacks instead of a silent suite hang."""
+    import signal
+    import time
+
+    hung = [p for p in procs if p.poll() is None]
+    if not hung:
+        return False
+    print(
+        f"watchdog: {len(hung)} process(es) still alive at the deadline; "
+        "requesting stack dumps (SIGUSR1) before kill",
+        flush=True,
+    )
+    for p in hung:
+        try:
+            p.send_signal(signal.SIGUSR1)
+        except OSError:
+            pass
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < grace and any(p.poll() is None for p in hung):
+        time.sleep(0.1)
+    for p in hung:
+        if p.poll() is None:
+            p.kill()
+    return True
+
+
 # ---------------------------------------------------------------------- #
 # worker
 # ---------------------------------------------------------------------- #
 def worker(pid: int, port: int, tmpdir: str) -> None:
+    # watchdog (robustness tier): a wedged collective must dump stacks and
+    # die, not hang the suite.  SIGUSR1 lets the launcher demand a stack
+    # dump from a live-but-stuck worker; dump_traceback_later(exit=True) is
+    # the self-watchdog — when a collective never completes, every thread's
+    # stack goes to stderr and the process exits, unwedging the peers' poll
+    # loop instead of riding out the full outer timeout.
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+    faulthandler.dump_traceback_later(
+        float(os.environ.get("MPDRYRUN_WATCHDOG", "450")), exit=True
+    )
     n_proc = int(os.environ.get("MPDRYRUN_NPROC", N_PROC))
     devs = int(os.environ.get("MPDRYRUN_DEVS", DEVS_PER_PROC))
     os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
@@ -289,6 +337,7 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     print(f"[{pid}] pipeline stages (cross-process ppermute): OK", flush=True)
 
     print(f"[{pid}] {MARKER}", flush=True)
+    faulthandler.cancel_dump_traceback_later()
     ht.core.bootstrap.finalize_distributed()
 
 
@@ -336,10 +385,8 @@ def main() -> int:
         ):
             break
         time.sleep(0.5)
-    for q in procs:
-        if q.poll() is None:
-            q.kill()
-            ok = False
+    if _dump_stacks_then_kill(procs):
+        ok = False
     for pid, p in enumerate(procs):
         out, _ = p.communicate()
         text = out.decode(errors="replace")

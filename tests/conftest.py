@@ -47,6 +47,18 @@ jax.config.update(
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 if _MP:
+    # watchdog (robustness tier): a rank wedged in a collective must dump
+    # per-thread stacks into its log and exit instead of hanging the lane —
+    # the launcher (scripts/multiprocess_dryrun.launch_pytest) also sends
+    # SIGUSR1 at ITS deadline to demand a dump from a live-but-stuck rank.
+    import faulthandler as _faulthandler
+    import signal as _signal
+
+    _faulthandler.register(_signal.SIGUSR1)
+    _wd = os.environ.get("HEAT_MP_WATCHDOG")
+    if _wd:
+        _faulthandler.dump_traceback_later(float(_wd), exit=True)
+
     import heat_tpu as _ht
 
     _ht.core.bootstrap.init_distributed(num_processes=_n_proc, process_id=_pid)

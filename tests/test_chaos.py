@@ -1,0 +1,140 @@
+"""Chaos lane: crash-recovery under real process death (ISSUE 2 robustness).
+
+A victim subprocess is SIGKILLed in the middle of ``save_array_checkpoint``
+— the fault site ``io.write`` is armed (via ``HEAT_TPU_FAULTS``) with a
+per-chunk delay so the kill deterministically lands inside the chunk-write
+loop — and the parent then asserts the previous checkpoint version still
+loads bit-exact.  This is the torn-write scenario the fsync +
+version-then-flip discipline exists for; no amount of in-process mocking
+proves it the way a real SIGKILL does.
+
+Marked ``chaos`` (+ ``slow``/``heavy``): runs in the dedicated chaos CI job,
+not in the quick verify lane.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow, pytest.mark.heavy]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the victim: phase "seed" completes a checkpoint; phase "victim" starts a
+# second save (announcing SAVING first so the parent can time its kill)
+VICTIM = """
+import os, sys
+import numpy as np
+ckpt, phase = sys.argv[1], sys.argv[2]
+import heat_tpu as ht
+
+n = 64
+if phase == "seed":
+    ht.save_array_checkpoint(ht.array(np.arange(n, dtype=np.float32) * 1.5, split=0), ckpt)
+    print("SEEDED", flush=True)
+else:
+    x = ht.array(np.arange(n, dtype=np.float32) * -2.0, split=0)
+    print("SAVING", flush=True)
+    ht.save_array_checkpoint(x, ckpt)
+    print("COMPLETED", flush=True)  # must never be reached (killed mid-save)
+"""
+
+
+def _env(faults_spec: str = "") -> dict:
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS",)}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    if faults_spec:
+        env["HEAT_TPU_FAULTS"] = faults_spec
+    else:
+        env.pop("HEAT_TPU_FAULTS", None)
+    return env
+
+
+def _run_victim(script_path, ckpt, phase, faults_spec=""):
+    return subprocess.Popen(
+        [sys.executable, script_path, ckpt, phase],
+        env=_env(faults_spec), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+class TestKillMidSave:
+    def test_sigkill_mid_save_previous_version_survives(self, tmp_path):
+        """Acceptance: after SIGKILL during ``save_array_checkpoint``,
+        ``load_array_checkpoint`` returns the previous version bit-exact."""
+        script = str(tmp_path / "victim.py")
+        with open(script, "w") as fh:
+            fh.write(VICTIM)
+        ckpt = str(tmp_path / "ckpt")
+
+        seed = _run_victim(script, ckpt, "seed")
+        out, _ = seed.communicate(timeout=240)
+        assert seed.returncode == 0 and "SEEDED" in out, out[-2000:]
+        assert open(os.path.join(ckpt, "LATEST")).read().strip() == "v0"
+
+        # 8 chunks x 0.5 s injected delay per write: the save needs >= 4 s
+        # after SAVING — a kill 1 s in lands inside the chunk-write loop
+        victim = _run_victim(script, ckpt, "victim",
+                             faults_spec="io.write:delay=0.5")
+        deadline = time.monotonic() + 240
+        line = ""
+        while time.monotonic() < deadline:
+            line = victim.stdout.readline()
+            if "SAVING" in line or line == "":
+                break
+        assert "SAVING" in line, "victim never reached the save"
+        time.sleep(1.0)
+        victim.send_signal(signal.SIGKILL)
+        rest = victim.communicate(timeout=60)[0]
+        assert victim.returncode == -signal.SIGKILL
+        assert "COMPLETED" not in rest, "kill missed the save window"
+
+        # torn v1 may exist on disk; LATEST must still name the durable v0
+        assert open(os.path.join(ckpt, "LATEST")).read().strip() == "v0"
+
+        import heat_tpu as ht
+
+        back = ht.load_array_checkpoint(ckpt)
+        np.testing.assert_array_equal(
+            back.numpy(), np.arange(64, dtype=np.float32) * 1.5
+        )
+
+    def test_sigkill_then_resave_then_load(self, tmp_path):
+        """After a torn save, the NEXT save must succeed and supersede the
+        wreckage (the torn v-dir is skipped for version numbering and pruned
+        once a complete newer version lands)."""
+        script = str(tmp_path / "victim.py")
+        with open(script, "w") as fh:
+            fh.write(VICTIM)
+        ckpt = str(tmp_path / "ckpt")
+
+        seed = _run_victim(script, ckpt, "seed")
+        out, _ = seed.communicate(timeout=240)
+        assert seed.returncode == 0 and "SEEDED" in out, out[-2000:]
+        victim = _run_victim(script, ckpt, "victim", faults_spec="io.write:delay=0.5")
+        deadline = time.monotonic() + 240
+        line = ""
+        while time.monotonic() < deadline:
+            line = victim.stdout.readline()
+            if "SAVING" in line or line == "":
+                break
+        assert "SAVING" in line, "victim never reached the save"
+        time.sleep(1.0)
+        victim.send_signal(signal.SIGKILL)
+        rest = victim.communicate(timeout=60)[0]
+        assert "COMPLETED" not in rest, "kill missed the save window"
+
+        import heat_tpu as ht
+
+        d3 = np.arange(64, dtype=np.float32) + 7
+        ht.save_array_checkpoint(ht.array(d3, split=0), ckpt)
+        back = ht.load_array_checkpoint(ckpt)
+        np.testing.assert_array_equal(back.numpy(), d3)

@@ -142,8 +142,10 @@ class TestDonation:
         y = x + 1.0
         _ = x + 1.0  # cached path again
         np.testing.assert_allclose(
-            (x + y).numpy(), 2 * x.numpy() + 1.0, rtol=1e-5
-        )  # x still alive and correct
+            (x + y).numpy(), 2 * x.numpy() + 1.0, rtol=1e-5, atol=1e-6
+        )  # x still alive and correct (atol: near-zero elements may differ
+        # by one float32 ulp between the cached program's (x+y) association
+        # and the numpy oracle's 2x+1)
 
     def test_self_referencing_iadd_safe(self):
         # x += x may not donate (one buffer, two args) — falls back cleanly

@@ -1,0 +1,568 @@
+"""Failure-hardening tier-1 tests (ISSUE 2 robustness).
+
+Covers, WITHOUT subprocesses or real sleeps:
+
+- every fault-injection mode of ``utils.faults`` (fail-N, always-fail,
+  delay, corrupt-bytes, env grammar);
+- the backoff schedule against a fake clock, and ``call_with_retries``
+  attempt counting through ``utils.profiler``;
+- durable-checkpoint failure paths: transient write faults healed by retry,
+  checksum corruption detected + version fallback, missing chunks named,
+  atomic pytree saves, validated pytree loads;
+- the non-finite training guard (eager + compiled; params frozen, skip
+  counters device-side);
+- bootstrap bring-up retry and idempotent finalize.
+
+The SIGKILL crash-recovery test lives in tests/test_chaos.py (chaos lane).
+"""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import io as htio
+from heat_tpu.utils import faults, profiler
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    faults.reset_trips()
+    profiler.reset_counters()
+    yield
+
+
+# fast retry policy for tests: no real backoff sleeps in tier-1
+FAST_RETRY = {"retries": 4, "base_delay": 0.0, "max_delay": 0.0, "jitter": 0.0}
+
+
+@pytest.fixture
+def fast_io_retry(monkeypatch):
+    monkeypatch.setattr(htio, "IO_RETRY", FAST_RETRY)
+
+
+class TestFaultModes:
+    def test_disarmed_site_is_noop(self):
+        faults.fire("io.write")
+        assert faults.trip_count("io.write") == 0
+
+    def test_fail_n_times(self):
+        with faults.inject("io.write", fail=2):
+            for _ in range(2):
+                with pytest.raises(faults.TransientFault):
+                    faults.fire("io.write")
+            faults.fire("io.write")  # healed
+        assert faults.trip_count("io.write") == 3
+        faults.fire("io.write")  # disarmed again outside the block
+        assert faults.trip_count("io.write") == 3
+
+    def test_always_fail(self):
+        with faults.inject("comm.host_fetch", fail=-1):
+            for _ in range(5):
+                with pytest.raises(faults.TransientFault):
+                    faults.fire("comm.host_fetch")
+
+    def test_custom_exception(self):
+        class Boom(faults.InjectedFault):
+            pass
+
+        with faults.inject("dist.init", fail=1, exc=Boom):
+            with pytest.raises(Boom):
+                faults.fire("dist.init")
+
+    def test_delay(self):
+        import time
+
+        with faults.inject("io.write", delay=0.05):
+            t0 = time.perf_counter()
+            faults.fire("io.write")
+            assert time.perf_counter() - t0 >= 0.05
+
+    def test_corrupt_flips_one_byte(self, tmp_path):
+        p = str(tmp_path / "blob")
+        payload = bytes(range(64))
+        with open(p, "wb") as fh:
+            fh.write(payload)
+        with faults.inject("io.write", corrupt=1):
+            faults.fire("io.write", path=p)
+            faults.fire("io.write", path=p)  # countdown exhausted: no-op
+        with open(p, "rb") as fh:
+            got = fh.read()
+        diff = [i for i in range(64) if got[i] != payload[i]]
+        assert diff == [32]  # exactly one byte, at the middle offset
+        assert got[32] == payload[32] ^ 0xFF
+
+    def test_transient_fault_is_oserror(self):
+        # real-world `except OSError` handling must catch injected faults
+        assert issubclass(faults.TransientFault, OSError)
+
+    def test_env_grammar(self):
+        specs = faults.parse_spec("io.write:delay=0.25,fail=2; dist.init:fail=-1")
+        assert specs["io.write"].delay == 0.25
+        assert specs["io.write"].fail == 2
+        assert specs["dist.init"].fail == -1
+        with pytest.raises(ValueError):
+            faults.parse_spec("io.write:explode=1")
+        assert faults.parse_spec("") == {}
+
+
+class TestBackoff:
+    def test_schedule_exponential_and_capped(self):
+        delays = list(
+            faults.backoff_schedule(
+                5, base_delay=0.1, factor=2.0, max_delay=0.5, jitter=0.0, rand=lambda: 1.0
+            )
+        )
+        np.testing.assert_allclose(delays, [0.1, 0.2, 0.4, 0.5, 0.5])
+
+    def test_schedule_jitter_bounds(self):
+        lo = list(faults.backoff_schedule(3, base_delay=0.1, jitter=0.5, rand=lambda: 0.0))
+        hi = list(faults.backoff_schedule(3, base_delay=0.1, jitter=0.5, rand=lambda: 1.0))
+        for a, b in zip(lo, hi):
+            assert b == pytest.approx(a * 1.5)
+
+    def test_retries_follow_schedule_fake_clock(self):
+        slept = []
+        with faults.inject("io.write", fail=3):
+            out = faults.call_with_retries(
+                lambda: faults.fire("io.write") or "done",
+                "io.write",
+                retries=4,
+                base_delay=0.1,
+                jitter=0.0,
+                sleep=slept.append,
+                rand=lambda: 0.0,
+            )
+        assert out == "done"
+        np.testing.assert_allclose(slept, [0.1, 0.2, 0.4])
+        assert profiler.counters()["retry.io.write"] == 3
+
+    def test_retry_exhaustion_reraises(self):
+        slept = []
+        with faults.inject("io.write", fail=-1):
+            with pytest.raises(faults.TransientFault):
+                faults.call_with_retries(
+                    lambda: faults.fire("io.write"),
+                    "io.write",
+                    retries=2,
+                    sleep=slept.append,
+                )
+        assert len(slept) == 2  # retried exactly `retries` times, then gave up
+
+    def test_retry_if_narrows(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise FileNotFoundError("gone")
+
+        with pytest.raises(FileNotFoundError):
+            faults.call_with_retries(
+                fn, "io.read", retries=3, sleep=lambda _: None,
+                retry_if=lambda e: not isinstance(e, FileNotFoundError),
+            )
+        assert len(calls) == 1  # not retried: absence is not transient
+
+
+class TestDurableArrayCheckpoint:
+    def test_transient_write_faults_healed_by_backoff(self, ht, tmp_path, fast_io_retry):
+        d = np.arange(32, dtype=np.float32)
+        ckpt = str(tmp_path / "ckpt")
+        with faults.inject("io.write", fail=2):
+            ht.save_array_checkpoint(ht.array(d, split=0), ckpt)
+        back = ht.load_array_checkpoint(ckpt)
+        np.testing.assert_array_equal(back.numpy(), d)
+        # acceptance: backoff attempts visible in utils.profiler counters
+        assert profiler.counters()["retry.io.write"] == 2
+
+    def test_fsync_fault_retried(self, ht, tmp_path, fast_io_retry):
+        d = np.arange(16, dtype=np.float32)
+        ckpt = str(tmp_path / "fs")
+        with faults.inject("io.fsync", fail=1):
+            ht.save_array_checkpoint(ht.array(d, split=0), ckpt)
+        np.testing.assert_array_equal(ht.load_array_checkpoint(ckpt).numpy(), d)
+
+    def test_write_fault_exhaustion_keeps_previous_version(self, ht, tmp_path, fast_io_retry):
+        d1 = np.arange(16, dtype=np.float32)
+        d2 = d1 + 100
+        ckpt = str(tmp_path / "boom")
+        ht.save_array_checkpoint(ht.array(d1, split=0), ckpt)
+        with faults.inject("io.write", fail=-1):
+            with pytest.raises(faults.TransientFault):
+                ht.save_array_checkpoint(ht.array(d2, split=0), ckpt)
+        # the failed save never flipped LATEST: previous version loads intact
+        np.testing.assert_array_equal(ht.load_array_checkpoint(ckpt).numpy(), d1)
+
+    def test_meta_records_checksums(self, ht, tmp_path):
+        d = np.arange(24, dtype=np.float32)
+        ckpt = str(tmp_path / "sums")
+        ht.save_array_checkpoint(ht.array(d, split=0), ckpt)
+        vdir = os.path.join(ckpt, open(os.path.join(ckpt, "LATEST")).read().strip())
+        meta = json.load(open(os.path.join(vdir, "meta.json")))
+        assert set(meta["checksums"]) == {str(s) for s in meta["starts"]}
+        for s in meta["starts"]:
+            path = os.path.join(vdir, f"chunk_{s}.npy")
+            payload = open(path, "rb").read()
+            assert zlib.crc32(payload) == meta["checksums"][str(s)]
+            assert len(payload) == meta["chunk_bytes"][str(s)]
+
+    def test_corrupted_chunk_detected(self, ht, tmp_path):
+        d = np.arange(40, dtype=np.float32)
+        ckpt = str(tmp_path / "rot")
+        # corruption injected at write time (post-checksum, models bit rot)
+        with faults.inject("io.write", corrupt=1):
+            ht.save_array_checkpoint(ht.array(d, split=0), ckpt)
+        with pytest.raises(htio.CheckpointCorruptionError, match="checksum"):
+            ht.load_array_checkpoint(ckpt)
+
+    def test_corruption_falls_back_to_previous_version(self, ht, tmp_path):
+        d1 = np.arange(40, dtype=np.float32)
+        d2 = d1 * 2
+        ckpt = str(tmp_path / "fb")
+        ht.save_array_checkpoint(ht.array(d1, split=0), ckpt)
+        with faults.inject("io.write", corrupt=1):
+            ht.save_array_checkpoint(ht.array(d2, split=0), ckpt, keep_versions=2)
+        # acceptance: checksum detects the flip, loader degrades to v0 (d1)
+        with pytest.warns(UserWarning, match="falling back"):
+            back = ht.load_array_checkpoint(ckpt)
+        np.testing.assert_array_equal(back.numpy(), d1)
+
+    def test_missing_chunk_named(self, ht, tmp_path):
+        d = np.arange(64, dtype=np.float32)
+        ckpt = str(tmp_path / "gone")
+        ht.save_array_checkpoint(ht.array(d, split=0), ckpt)
+        vdir = os.path.join(ckpt, open(os.path.join(ckpt, "LATEST")).read().strip())
+        victims = sorted(f for f in os.listdir(vdir) if f.startswith("chunk_"))
+        os.remove(os.path.join(vdir, victims[1]))
+        with pytest.raises(htio.CheckpointCorruptionError, match=victims[1]):
+            ht.load_array_checkpoint(ckpt)
+
+    def test_keep_versions_retains_history(self, ht, tmp_path):
+        ckpt = str(tmp_path / "hist")
+        for k in range(3):
+            ht.save_array_checkpoint(
+                ht.array(np.full(8, k, np.float32), split=0), ckpt, keep_versions=2
+            )
+        versions = sorted(v for v in os.listdir(ckpt) if v.startswith("v"))
+        assert versions == ["v1", "v2"]
+        np.testing.assert_array_equal(
+            ht.load_array_checkpoint(ckpt).numpy(), np.full(8, 2, np.float32)
+        )
+
+    def test_missing_directory_clear_error(self, ht, tmp_path):
+        with pytest.raises(FileNotFoundError, match="nowhere"):
+            ht.load_array_checkpoint(str(tmp_path / "nowhere"))
+
+    def test_empty_directory_clear_error(self, ht, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(FileNotFoundError, match="no checkpoint versions"):
+            ht.load_array_checkpoint(str(empty))
+
+    def test_host_fetch_transient_fault_retried(self, ht):
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        with faults.inject("comm.host_fetch", fail=1):
+            got = x.numpy()
+        np.testing.assert_array_equal(got, np.arange(16, dtype=np.float32))
+        assert profiler.counters()["retry.comm.host_fetch"] == 1
+
+
+class TestDurablePytreeCheckpoint:
+    def _tree(self):
+        import jax.numpy as jnp
+
+        return {"w": jnp.arange(12, dtype=jnp.float32).reshape(4, 3),
+                "b": jnp.zeros(3, jnp.float32)}
+
+    def test_missing_file_clear_error(self, ht, tmp_path):
+        with pytest.raises(FileNotFoundError, match="ckpt.npz"):
+            ht.core.io.load_checkpoint(self._tree(), str(tmp_path / "ckpt"))
+
+    def test_truncated_npz_clear_error(self, ht, tmp_path):
+        p = str(tmp_path / "trunc.npz")
+        with open(p, "wb") as fh:
+            fh.write(b"PK\x03\x04 definitely not a whole archive")
+        with pytest.raises(htio.CheckpointCorruptionError, match="trunc.npz"):
+            ht.core.io.load_checkpoint(self._tree(), p)
+
+    def test_foreign_npz_clear_error(self, ht, tmp_path):
+        p = str(tmp_path / "foreign.npz")
+        np.savez(p, something=np.zeros(3))
+        with pytest.raises(htio.CheckpointCorruptionError, match="__keys__"):
+            ht.core.io.load_checkpoint(self._tree(), p)
+
+    def test_reshaped_leaf_refused(self, ht, tmp_path):
+        import jax.numpy as jnp
+
+        p = str(tmp_path / "shape")
+        ht.core.io.save_checkpoint(self._tree(), p)
+        reshaped = dict(self._tree(), w=jnp.zeros((3, 4), jnp.float32))
+        with pytest.raises(ValueError, match=r"\(4, 3\)"):
+            ht.core.io.load_checkpoint(reshaped, p)
+
+    def test_wrong_dtype_refused(self, ht, tmp_path):
+        import jax.numpy as jnp
+
+        p = str(tmp_path / "dt")
+        ht.core.io.save_checkpoint(self._tree(), p)
+        cast = dict(self._tree(), b=jnp.zeros(3, jnp.int32))
+        with pytest.raises(ValueError, match="dtype"):
+            ht.core.io.load_checkpoint(cast, p)
+
+    def test_atomic_save_preserves_existing_on_crash(self, ht, tmp_path, fast_io_retry):
+        import jax.numpy as jnp
+
+        p = str(tmp_path / "atomic")
+        tree = self._tree()
+        ht.core.io.save_checkpoint(tree, p)
+        bigger = {"w": tree["w"] + 1, "b": tree["b"] + 1}
+        with faults.inject("io.write", fail=-1):
+            with pytest.raises(faults.TransientFault):
+                ht.core.io.save_checkpoint(bigger, p)
+        # the in-place seed writer would have destroyed the only copy here
+        back = ht.core.io.load_checkpoint(tree, p)
+        np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(tree["w"]))
+
+    def test_roundtrip_still_works(self, ht, tmp_path):
+        p = str(tmp_path / "rt")
+        tree = self._tree()
+        ht.core.io.save_checkpoint(tree, p)
+        back = ht.core.io.load_checkpoint(tree, p)
+        for a, b in zip(np.asarray(back["w"]), np.asarray(tree["w"])):
+            np.testing.assert_array_equal(a, b)
+        assert not os.path.exists(p + ".npz.tmp")  # tmp file renamed away
+
+
+class TestNonFiniteGuard:
+    def _setup(self):
+        import jax
+
+        m = ht.nn.Sequential(ht.nn.Linear(4, 4))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        p = m.init(jax.random.key(0))
+        opt.init_state(p)
+        return m, opt, p
+
+    def test_eager_nan_step_skipped(self):
+        import jax
+        import jax.numpy as jnp
+
+        _, opt, p = self._setup()
+        nan_g = jax.tree.map(lambda q: jnp.full_like(q, jnp.nan), p)
+        p2 = opt.step(p, nan_g)
+        for a, b in zip(jax.tree_util.tree_leaves(p), jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert opt.guard_stats() == {"steps": 1, "skipped": 1}
+        # a finite step afterwards applies normally (state not poisoned)
+        ones_g = jax.tree.map(jnp.ones_like, p)
+        p3 = opt.step(p2, ones_g)
+        assert not np.allclose(
+            np.asarray(jax.tree_util.tree_leaves(p3)[0]),
+            np.asarray(jax.tree_util.tree_leaves(p2)[0]),
+        )
+        assert opt.guard_stats() == {"steps": 2, "skipped": 1}
+
+    def test_inf_also_skipped(self):
+        import jax
+        import jax.numpy as jnp
+
+        _, opt, p = self._setup()
+        inf_g = jax.tree.map(lambda q: jnp.full_like(q, jnp.inf), p)
+        opt.step(p, inf_g)
+        assert opt.guard_stats()["skipped"] == 1
+
+    def test_guard_opt_out(self):
+        import jax
+        import jax.numpy as jnp
+
+        m = ht.nn.Sequential(ht.nn.Linear(4, 4))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1, guard_nonfinite=False)
+        p = m.init(jax.random.key(0))
+        opt.init_state(p)
+        nan_g = jax.tree.map(lambda q: jnp.full_like(q, jnp.nan), p)
+        p2 = opt.step(p, nan_g)
+        assert np.isnan(np.asarray(jax.tree_util.tree_leaves(p2)[0])).any()
+
+    def test_compiled_data_parallel_step_skips_on_device(self):
+        """Acceptance: a NaN batch through the jitted DataParallel step
+        leaves params bit-identical, bumps the device-side skip counter, and
+        the step emits no host sync (its outputs stay jax.Arrays)."""
+        import jax
+        import jax.numpy as jnp
+
+        model = ht.nn.Sequential(ht.nn.Linear(8, 4))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        params = dp.init(jax.random.key(0))
+        state = opt.init_state(params)
+        step = dp.make_train_step(lambda pred, y: jnp.mean((pred - y) ** 2),
+                                  donate=False)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        params1, state1, loss1 = step(params, state, x, y)
+        y_nan = y.at[0, 0].set(jnp.nan)
+        params2, state2, loss2 = step(params1, state1, x, y_nan)
+        assert isinstance(loss2, jax.Array)  # async: no float() in the path
+        for a, b in zip(jax.tree_util.tree_leaves(params1),
+                        jax.tree_util.tree_leaves(params2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert opt.guard_stats(state2) == {"steps": 2, "skipped": 1}
+
+    def test_daso_nan_step_skipped_with_counters(self):
+        """Acceptance: DASO step with an injected NaN gradient — params
+        unchanged, skip counter (device-resident, in the opt state)
+        incremented, loss returned as an async array."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.optim.dp_optimizer import DASO, DataParallelOptimizer
+
+        if len(jax.devices()) % 2:
+            pytest.skip("DASO needs an even device count")
+        daso = DASO(DataParallelOptimizer("sgd", lr=0.1), warmup_steps=0,
+                    global_skip=1000)
+        model = ht.nn.Sequential(ht.nn.Linear(8, 4))
+        daso.init(model, key=jax.random.key(0))
+        loss_fn = lambda pred, y: jnp.mean((pred - y) ** 2)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        daso.step(loss_fn, x, y)
+        assert daso.skip_stats() == {"steps": 1, "skipped": 0}
+        snap = jax.device_get(daso._params)
+        out = daso.step(loss_fn, x, y.at[0, 0].set(jnp.nan))
+        assert isinstance(out, jax.Array)
+        after = jax.device_get(daso._params)
+        for a, b in zip(jax.tree_util.tree_leaves(snap),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        stats = daso.skip_stats()
+        assert stats["steps"] == 2 and stats["skipped"] >= 1
+        # surfaced through the profiler provider (name unique per instance)
+        assert profiler.counters()[f"{daso.profiler_key}.skipped_steps"] == stats["skipped"]
+
+    def test_daso_auto_checkpoint_and_resume(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.optim.dp_optimizer import DASO, DataParallelOptimizer
+
+        if len(jax.devices()) % 2:
+            pytest.skip("DASO needs an even device count")
+        d = str(tmp_path / "daso")
+        model = ht.nn.Sequential(ht.nn.Linear(8, 4))
+        loss_fn = lambda pred, y: jnp.mean((pred - y) ** 2)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+
+        daso = DASO(DataParallelOptimizer("sgd", lr=0.1), warmup_steps=0,
+                    global_skip=1000, checkpoint_every=2, checkpoint_dir=d)
+        daso.init(model, key=jax.random.key(0))
+        assert not daso.resume()  # nothing saved yet
+        for _ in range(4):
+            daso.step(loss_fn, x, y)
+        want = jax.device_get(daso._params)
+
+        fresh = DASO(DataParallelOptimizer("sgd", lr=0.1), warmup_steps=0,
+                     global_skip=1000, checkpoint_every=2, checkpoint_dir=d)
+        fresh.init(model, key=jax.random.key(42))  # different init: must be overwritten
+        assert fresh.resume()
+        assert fresh._step_count == 4
+        got = jax.device_get(fresh._params)
+        for a, b in zip(jax.tree_util.tree_leaves(want),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the restored optimizer keeps training from where it left off
+        fresh.step(loss_fn, x, y)
+        assert fresh._step_count == 5
+
+    def test_checkpoint_every_requires_dir(self):
+        from heat_tpu.optim.dp_optimizer import DASO, DataParallelOptimizer
+
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            DASO(DataParallelOptimizer("sgd", lr=0.1), checkpoint_every=5)
+
+    def test_two_dasos_do_not_shadow_counters(self):
+        from heat_tpu.optim.dp_optimizer import DASO, DataParallelOptimizer
+
+        a = DASO(DataParallelOptimizer("sgd", lr=0.1))
+        b = DASO(DataParallelOptimizer("sgd", lr=0.1))
+        assert a.profiler_key != b.profiler_key
+        c = profiler.counters()
+        assert f"{a.profiler_key}.steps" in c and f"{b.profiler_key}.steps" in c
+
+    def test_guard_stats_on_donated_state_clear_error(self):
+        """make_train_step's donate=True default consumes the eagerly
+        tracked opt state: the no-arg guard_stats() must say so instead of
+        surfacing a bare deleted-buffer RuntimeError."""
+        import jax
+        import jax.numpy as jnp
+
+        model = ht.nn.Sequential(ht.nn.Linear(4, 2))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        params = dp.init(jax.random.key(0))
+        state = opt.init_state(params)
+        step = dp.make_train_step(lambda p, y: jnp.mean((p - y) ** 2))  # donates
+        x = jnp.zeros((8, 4), jnp.float32)
+        y = jnp.zeros((8, 2), jnp.float32)
+        params, state, _ = step(params, state, x, y)
+        with pytest.raises(RuntimeError, match="donated to the train step"):
+            opt.guard_stats()
+        assert opt.guard_stats(state)["steps"] == 1  # the rebound state works
+
+
+class TestBootstrapRobustness:
+    def test_retrying_initialize_heals_coordinator_lag(self):
+        from heat_tpu.core import bootstrap
+
+        calls = []
+
+        def flaky(**kw):
+            calls.append(kw)
+            if len(calls) < 3:
+                raise RuntimeError("coordinator connect failed: connection refused")
+
+        bootstrap._retrying_initialize(flaky, {"num_processes": 2},
+                                       retries=4, sleep=lambda _: None)
+        assert len(calls) == 3
+        assert profiler.counters()["retry.dist.init"] == 2
+
+    def test_misconfiguration_not_retried(self):
+        from heat_tpu.core import bootstrap
+
+        calls = []
+
+        def bad(**kw):
+            calls.append(kw)
+            raise RuntimeError("process_id 7 out of range for num_processes 2")
+
+        with pytest.raises(RuntimeError, match="out of range"):
+            bootstrap._retrying_initialize(bad, {}, retries=4, sleep=lambda _: None)
+        assert len(calls) == 1
+
+    def test_already_initialized_is_success(self):
+        from heat_tpu.core import bootstrap
+
+        def already(**kw):
+            raise RuntimeError("jax.distributed is already initialized")
+
+        bootstrap._retrying_initialize(already, {}, retries=0, sleep=lambda _: None)
+
+    def test_dist_init_fault_site_fires_per_attempt(self):
+        from heat_tpu.core import bootstrap
+
+        with faults.inject("dist.init", fail=2):
+            bootstrap._retrying_initialize(lambda **kw: None, {},
+                                           retries=3, sleep=lambda _: None)
+        assert faults.trip_count("dist.init") == 3
+
+    def test_finalize_distributed_idempotent(self, ht):
+        # single-controller: shutdown without init must be a no-op, twice
+        ht.core.bootstrap.finalize_distributed()
+        ht.core.bootstrap.finalize_distributed()
