@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import time
 import warnings
 from typing import Callable, Optional, Tuple, Union
 
@@ -39,6 +40,27 @@ __all__ = ["_local_op", "_binary_op", "_reduce_op", "_cum_op"]
 # the next _binary_op donates its first operand's buffer to the compiled
 # program — numpy's in-place contract realized as XLA buffer aliasing
 _DONATE_T1 = contextvars.ContextVar("heat_tpu_donate_t1", default=False)
+
+# telemetry hot-path hook: ``utils.telemetry.enable()`` sets this to the
+# telemetry module and ``disable()`` clears it, so the disabled check on
+# every dispatch tail is ONE module-global load — no import, no call, no
+# flag indirection (the telemetry-off overhead contract, ISSUE 3)
+_TELEMETRY = None
+
+
+def _run_prog(tel, name: str, op, prog, args, cache_hit: bool):
+    """Run a cached dispatch executable with the telemetry tail around it
+    (only reached when telemetry is armed): a leaf span named
+    ``dispatch.<kind>`` carrying the op name and cache hit/miss.  ``tel`` is
+    the caller's captured module reference — re-reading the ``_TELEMETRY``
+    global here would race a concurrent ``disable()`` into an AttributeError
+    mid-op (record_dispatch itself re-checks the enabled flag)."""
+    t0 = time.perf_counter()
+    out = prog(*args)
+    tel.record_dispatch(
+        name, t0, time.perf_counter(), getattr(op, "__name__", str(op)), cache_hit
+    )
+    return out
 
 
 @contextlib.contextmanager
@@ -168,6 +190,8 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
         and _cacheable(j)
         and _hashable(kw := tuple(sorted(kwargs.items())))
     ):
+        tel = _TELEMETRY
+        m0 = _cache._STATS["misses"] if tel is not None else 0
         entry = _cache.cached_program(
             comm,
             ("local", op, _sig(j), x.split, kw),
@@ -175,7 +199,12 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
         )
         if entry is not _SLOW:
             prog, rshape, rdtype, rsplit = entry
-            return DNDarray._from_parts(prog(j), rshape, rdtype, rsplit, x.device, comm)
+            res = (
+                prog(j)
+                if tel is None
+                else _run_prog(tel, "dispatch.local", op, prog, (j,), _cache._STATS["misses"] == m0)
+            )
+            return DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, comm)
     result = op(j, **kwargs)
     result = comm.shard(result, x.split if x.split is not None and x.split < result.ndim else None)
     if out is not None:
@@ -258,6 +287,8 @@ def _binary_op(
                         isinstance(t2, DNDarray) and t1._parray is t2._parray
                     )  # one buffer may not be donated and read in one call
                 )
+                tel = _TELEMETRY
+                m0 = _cache._STATS["misses"] if tel is not None else 0
                 entry = _cache.cached_program(
                     comm,
                     ("binary", op, k1, k2, donate),
@@ -265,16 +296,20 @@ def _binary_op(
                 )
                 if entry is not _SLOW:
                     prog, rshape, rdtype, rsplit = entry
+                    args = (
+                        t1._jarray if d1 else t1,
+                        t2._jarray if isinstance(t2, DNDarray) else t2,
+                    )
+                    res = (
+                        prog(*args)
+                        if tel is None
+                        else _run_prog(
+                            tel, "dispatch.binary", op, prog, args,
+                            _cache._STATS["misses"] == m0,
+                        )
+                    )
                     return DNDarray._from_parts(
-                        prog(
-                            t1._jarray if d1 else t1,
-                            t2._jarray if isinstance(t2, DNDarray) else t2,
-                        ),
-                        rshape,
-                        rdtype,
-                        rsplit,
-                        proto.device,
-                        comm,
+                        res, rshape, rdtype, rsplit, proto.device, comm
                     )
 
     fn_kwargs = fn_kwargs or {}
@@ -535,6 +570,8 @@ def _reduce_op(
         and _hashable(kw := tuple(sorted(kwargs.items())))
     ):
         dkey = None if dtype is None else types.canonical_heat_type(dtype)
+        tel = _TELEMETRY
+        m0 = _cache._STATS["misses"] if tel is not None else 0
         entry = _cache.cached_program(
             x.comm,
             ("reduce", op, _sig(j), axkey, keepdims, dkey, new_split, kw),
@@ -542,7 +579,12 @@ def _reduce_op(
         )
         if entry is not _SLOW:
             prog, rshape, rdtype, rsplit = entry
-            return DNDarray._from_parts(prog(j), rshape, rdtype, rsplit, x.device, x.comm)
+            res = (
+                prog(j)
+                if tel is None
+                else _run_prog(tel, "dispatch.reduce", op, prog, (j,), _cache._STATS["misses"] == m0)
+            )
+            return DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
     result = op(j, axis=axis, keepdims=keepdims, **kwargs)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
@@ -601,6 +643,8 @@ def _cum_op(
     split = None if axis is None else x.split
     if out is None and not x._pad and _stable_op(op) and _cacheable(j):
         dkey = None if dtype is None else types.canonical_heat_type(dtype)
+        tel = _TELEMETRY
+        m0 = _cache._STATS["misses"] if tel is not None else 0
         entry = _cache.cached_program(
             x.comm,
             ("cum", op, _sig(j), axis, dkey, split),
@@ -608,7 +652,12 @@ def _cum_op(
         )
         if entry is not _SLOW:
             prog, rshape, rdtype, rsplit = entry
-            return DNDarray._from_parts(prog(j), rshape, rdtype, rsplit, x.device, x.comm)
+            res = (
+                prog(j)
+                if tel is None
+                else _run_prog(tel, "dispatch.cum", op, prog, (j,), _cache._STATS["misses"] == m0)
+            )
+            return DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
     if axis is None:
         # numpy semantics: flatten
         flat = j.reshape(-1)
@@ -641,3 +690,14 @@ def _build_cum(comm, op, j, axis, dtype, split):
         return r if jdt is None else r.astype(jdt)
 
     return _compile_tail(comm, compute, j, split)
+
+
+# telemetry may have been armed before this module finished importing
+# (HEAT_TPU_TELEMETRY=1 enables at utils import time, and import order
+# depends on the entry point) — pick the flag up here instead of missing it
+import sys as _sys  # noqa: E402
+
+_t = _sys.modules.get("heat_tpu.utils.telemetry")
+if _t is not None and _t._ENABLED:
+    _TELEMETRY = _t
+del _sys, _t

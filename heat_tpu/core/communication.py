@@ -50,6 +50,32 @@ __all__ = [
     "world",
 ]
 
+# telemetry is imported lazily (core modules load before utils) and cached;
+# every call below is at collective STAGING time or inside resplit — never
+# the per-op dispatch hot path
+_TELEMETRY_MOD = None
+
+
+def _telemetry():
+    global _TELEMETRY_MOD
+    if _TELEMETRY_MOD is None:
+        from ..utils import telemetry
+
+        _TELEMETRY_MOD = telemetry
+    return _TELEMETRY_MOD
+
+
+def _payload_nbytes(x) -> int:
+    """nbytes of an array OR a tracer (shape/dtype live on the aval, so the
+    collective wrappers can account bytes while being traced)."""
+    try:
+        n = 1
+        for s in x.shape:
+            n *= int(s)
+        return n * np.dtype(x.dtype).itemsize
+    except Exception:
+        return 0
+
 
 def _array_from_callback(host: "np.ndarray", sh: NamedSharding) -> jax.Array:
     """Global array from host data, one slice per addressable device.
@@ -376,16 +402,48 @@ class Communication:
         for tracers, hosted-complex arrays, ragged extents and
         multi-process meshes (where placement goes through host assembly
         anyway).
+
+        Telemetry: every resharding call counts under
+        ``comm.resplit.calls``/``.bytes`` (the all-to-all moves (p-1)/p of
+        the GLOBAL payload — the known hot spot of redistribution traffic),
+        and the eager transfer runs under a ``comm.resplit`` span when
+        telemetry is enabled.  A no-op call (the array already carries the
+        target sharding) moves nothing and is NOT counted — defensive
+        resplit calls must not inflate the traffic metric.
         """
-        if donate and self._donatable(array, split):
-            sh = self.sharding(array.ndim, split)
-            if getattr(array, "sharding", None) == sh:
-                return array
-            try:
-                return jax.device_put(array, sh, donate=True)
-            except TypeError:  # jax without the donate kwarg
-                return jax.device_put(array, sh)
-        return self.shard(array, split)
+        if self._already_placed(array, split):
+            return array
+        self._account("resplit", array, (self.size - 1) / self.size)
+        tel = _telemetry()
+        with tel.span(
+            "comm.resplit",
+            split=split,
+            donate=donate,
+            nbytes=_payload_nbytes(array),
+        ):
+            if donate and self._donatable(array, split):
+                # no already-placed test here: _already_placed() at the top
+                # returned for every case a donatable array could hit
+                sh = self.sharding(array.ndim, split)
+                try:
+                    return jax.device_put(array, sh, donate=True)
+                except TypeError:  # jax without the donate kwarg
+                    return jax.device_put(array, sh)
+            return self.shard(array, split)
+
+    def _already_placed(self, array, split: Optional[int]) -> bool:
+        """True when ``array`` is concrete and already carries exactly the
+        canonical sharding of ``split`` — a resplit of it moves no bytes
+        (the same early-return condition ``shard``/the donate path apply)."""
+        if isinstance(array, jax.core.Tracer) or not isinstance(array, jax.Array):
+            return False
+        if split is not None:
+            split = split % array.ndim if array.ndim else None
+        if split is not None and (
+            array.ndim == 0 or array.shape[split] % self.size != 0
+        ):
+            return False  # ragged: placement is XLA's, not the canonical one
+        return getattr(array, "sharding", None) == self.sharding(array.ndim, split)
 
     def _donatable(self, array, split: Optional[int]) -> bool:
         """True when the donating reshard program may be used for ``array``."""
@@ -411,11 +469,31 @@ class Communication:
     # tests can lower it; 8 ≈ one host's worth of chips)
     GATHER_WARN_THRESHOLD = 8
 
+    def _account(self, name: str, x, factor: float) -> None:
+        """Byte accounting of one staged collective: ``comm.<name>.calls``
+        += 1 and ``comm.<name>.bytes`` += per-shard payload nbytes × the
+        collective's algorithmic traffic factor (the wire cost per shard in
+        payload units — factor table in design.md "Telemetry & metrics").
+
+        Counted at STAGING (trace) time: a cached executable's replays never
+        re-enter these Python wrappers, so ``calls`` counts distinct staged
+        collectives per compilation — a collective inside ``lax.scan``
+        counts once however many iterations run.  Derived collectives
+        (``Reduce``, ``Scatter``) account under the primitive they are
+        built from (``Allreduce``, ``Bcast``)."""
+        _telemetry().account_collective(name, _payload_nbytes(x) * factor)
+
     def _warn_gather_based(self, name: str) -> None:
         """Perf-trap warning (reference: ``warnings.warn`` on implicit-comm
         traps, SURVEY §5.5): this collective is implemented via all_gather, so
         every shard materializes p× the buffer — fine at p≤8, a memory trap at
-        pod scale.  Warned at trace time."""
+        pod scale.  Warned at trace time.  Every call additionally counts
+        under ``comm.gather_fallback.<name>`` so slow-path collective usage
+        is visible in ``telemetry.report()`` even below the warn threshold
+        (where the one-shot warning stays silent)."""
+        from ..utils import profiler as _profiler
+
+        _profiler.counter_inc(f"comm.gather_fallback.{name}")
         if self.size > Communication.GATHER_WARN_THRESHOLD:
             warnings.warn(
                 f"Communication.{name} is gather-based: each shard holds "
@@ -425,6 +503,14 @@ class Communication:
             )
 
     def Allreduce(self, x, op: str = "sum"):
+        p = self.size
+        # prod is realized as a log-p prefix scan + one masked psum — its
+        # true wire cost, accounted here ONCE (the shared _inclusive_scan
+        # helper deliberately does no accounting of its own)
+        factor = 2.0 * (p - 1) / p
+        if op == "prod":
+            factor += float(max(p - 1, 0).bit_length())
+        self._account("Allreduce", x, factor)
         ops = {
             "sum": lax.psum,
             "max": lax.pmax,
@@ -450,9 +536,11 @@ class Communication:
         return ops[op](x, self.__axis)
 
     def Allgather(self, x, axis: int = 0, tiled: bool = True):
+        self._account("Allgather", x, self.size - 1)
         return lax.all_gather(x, self.__axis, axis=axis, tiled=tiled)
 
     def Alltoall(self, x, split_axis: int, concat_axis: int):
+        self._account("Alltoall", x, (self.size - 1) / self.size)
         return lax.all_to_all(
             x, self.__axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
         )
@@ -464,6 +552,8 @@ class Communication:
         the wire cost is one allreduce of the payload and no shard ever holds
         a p× buffer (the reference Bcasts a single buffer too — this is the
         SPMD-collective realization of the same cost)."""
+        p = self.size
+        self._account("Bcast", x, 2.0 * (p - 1) / p)
         mine = lax.axis_index(self.__axis) == root
         contrib = jnp.where(mine, x, jnp.zeros_like(x))
         # psum promotes bool to int32 — restore the caller's dtype
@@ -471,18 +561,23 @@ class Communication:
 
     def Send(self, x, shift: int = 1):
         """Ring shift by ``shift`` (reference Isend/Irecv neighbor exchange)."""
+        self._account("Send", x, 1.0)
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(x, self.__axis, perm)
 
     def ReduceScatter(self, x, axis: int = 0):
+        self._account("ReduceScatter", x, (self.size - 1) / self.size)
         return lax.psum_scatter(x, self.__axis, scatter_dimension=axis, tiled=True)
 
     def _inclusive_scan(self, x, combine, unit):
         """Inclusive prefix combine across shards in O(log p) ``ppermute``
         steps (Hillis–Steele recursive doubling), O(1) memory per shard.
         ``unit`` fills the holes of the partial permutation (ranks below the
-        stride receive nothing)."""
+        stride receive nothing).  No telemetry accounting here: the PUBLIC
+        entry points (Scan, Exscan, Allreduce-prod) each account their own
+        end-to-end cost — accounting in this shared helper would double-count
+        and misattribute (found in review)."""
         idx = lax.axis_index(self.__axis)
         n = self.size
         acc = x
@@ -502,6 +597,8 @@ class Communication:
         computed by recursive doubling, then shifted one rank down the ring
         (rank 0 receives the empty-sum zero) — exact, unlike
         ``inclusive - x`` which reassociates floats."""
+        # ceil(log2 p) doubling rounds + the one-rank down-shift
+        self._account("Exscan", x, float(max(self.size - 1, 0).bit_length()) + 1.0)
         inc = self._inclusive_scan(x, jnp.add, unit=0)
         n = self.size
         perm = [(i, i + 1) for i in range(n - 1)]
@@ -510,6 +607,8 @@ class Communication:
         return jnp.where(idx > 0, shifted, jnp.zeros_like(shifted))
 
     def Scan(self, x):
+        # ceil(log2 p) recursive-doubling rounds, one payload each
+        self._account("Scan", x, float(max(self.size - 1, 0).bit_length()))
         return self._inclusive_scan(x, jnp.add, unit=0)
 
     def Reduce(self, x, root: int = 0, op: str = "sum"):
@@ -537,6 +636,7 @@ class Communication:
         O(p)-memory by definition (every shard materializes the gathered
         buffer before root-masking); see ``_warn_gather_based``."""
         self._warn_gather_based("Gather")
+        self._account("Gather", x, self.size - 1)
         full = lax.all_gather(x, self.__axis, axis=axis, tiled=True)
         mine = lax.axis_index(self.__axis) == root
         return jnp.where(mine, full, jnp.zeros_like(full))

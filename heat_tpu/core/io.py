@@ -24,8 +24,9 @@ from . import devices, factories, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 
-# stdlib-only module; safe to import from the innermost write paths
+# stdlib-only modules; safe to import from the innermost write paths
 from ..utils import faults as _faults
+from ..utils import telemetry as _telemetry
 
 __all__ = [
     "load",
@@ -71,6 +72,7 @@ def _fsync_dir(path: str) -> None:
     try:
         _faults.fire("io.fsync", path=path)
         os.fsync(fd)
+        _telemetry.counter_inc("io.fsync.calls")
     finally:
         os.close(fd)
 
@@ -80,7 +82,9 @@ def _durable_write(path: str, payload: bytes) -> None:
     whole write on transient faults (a partially-written attempt is simply
     overwritten by the next one).  Fault sites: ``io.write`` (after the
     bytes hit the file, before fsync — the corrupt mode flips a byte of the
-    on-disk file there) and ``io.fsync``."""
+    on-disk file there) and ``io.fsync``.  Telemetry: successful writes
+    count under ``io.bytes_written``/``io.fsync.calls`` (retry attempts
+    already count as ``retry.io.write`` in the faults layer)."""
 
     def attempt():
         with open(path, "wb") as fh:
@@ -91,6 +95,8 @@ def _durable_write(path: str, payload: bytes) -> None:
             os.fsync(fh.fileno())
 
     _retry(attempt, "io.write")
+    _telemetry.counter_inc("io.bytes_written", len(payload))
+    _telemetry.counter_inc("io.fsync.calls")
 
 
 def _read_file(path: str, site: str = "io.read") -> bytes:
@@ -755,6 +761,7 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
 # §5.4: tensorstore/zarr with per-shard writes; here one .npy per shard
 # chunk + a json manifest, dependency-free)
 # ---------------------------------------------------------------------- #
+@_telemetry.traced("io.save_array_checkpoint")
 def save_array_checkpoint(
     x: DNDarray, directory: str, donate: bool = False, keep_versions: int = 1
 ) -> None:
@@ -923,6 +930,7 @@ def _checkpoint_candidates(directory: str):
     return out
 
 
+@_telemetry.traced("io.load_array_checkpoint")
 def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
     """Restore a DNDarray saved by :func:`save_array_checkpoint`.
 
@@ -1026,6 +1034,7 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
 # ---------------------------------------------------------------------- #
 # pytree checkpointing (estimator/NN state; SURVEY §5.4 orbax-style dump)
 # ---------------------------------------------------------------------- #
+@_telemetry.traced("io.save_checkpoint")
 def save_checkpoint(tree, path: str) -> None:
     """Save a pytree of arrays (params/opt state) to an .npz + structure json.
 
@@ -1061,10 +1070,16 @@ def save_checkpoint(tree, path: str) -> None:
             os.fsync(fh.fileno())
 
     _retry(attempt, "io.write")
+    try:
+        _telemetry.counter_inc("io.bytes_written", os.path.getsize(tmp))
+    except OSError:
+        pass
+    _telemetry.counter_inc("io.fsync.calls")
     os.replace(tmp, final)  # atomic: readers see the old or the new file
     _fsync_dir(os.path.dirname(os.path.abspath(final)))
 
 
+@_telemetry.traced("io.load_checkpoint")
 def load_checkpoint(tree_like, path: str):
     """Restore a pytree saved by :func:`save_checkpoint` into the structure
     of ``tree_like``.

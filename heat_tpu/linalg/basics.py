@@ -246,7 +246,11 @@ def _summa_program(comm):
             src = (my + i) % size  # which K-rows this rotating block holds
             a_cols = lax.dynamic_slice_in_dim(a_blk, src * kblk, kblk, axis=1)
             acc = acc + a_cols @ rot
-            rot = lax.ppermute(rot, axis, [((j + 1) % size, j) for j in range(size)])
+            # ring shift source j+1 -> dest j == comm.Send(shift=-1); routed
+            # through the Communication wrapper so the rotation shows up in
+            # telemetry's comm.Send byte accounting (staged once per trace —
+            # it lives inside lax.scan)
+            rot = comm.Send(rot, shift=-1)
             return (acc, rot), None
 
         acc0 = jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=jnp.promote_types(a_blk.dtype, b_blk.dtype))

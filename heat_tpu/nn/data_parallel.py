@@ -32,6 +32,31 @@ def _as_jax(x):
     return x._jarray if isinstance(x, DNDarray) else x
 
 
+def _instrumented_step(jitted):
+    """Wrap a jitted train step with the telemetry tail: an ``nn.train_step``
+    span plus the ``nn.train_step_dispatch_s`` latency histogram when
+    telemetry is enabled (dispatch-side wall time — the step stays async,
+    no host sync is added).  Disabled cost: one flag check.  The jitted
+    function's introspection surface (``.lower``) is preserved."""
+    import functools
+    import time
+
+    from ..utils import telemetry as _tel
+
+    @functools.wraps(jitted)
+    def step(*args):
+        if not _tel._ENABLED:
+            return jitted(*args)
+        t0 = time.perf_counter()
+        with _tel.span("nn.train_step"):
+            out = jitted(*args)
+        _tel.observe("nn.train_step_dispatch_s", time.perf_counter() - t0)
+        return out
+
+    step.lower = jitted.lower
+    return step
+
+
 class DataParallel:
     """Wrap a module for synchronous data-parallel training.
 
@@ -181,6 +206,7 @@ class DataParallel:
                 new_params, new_state = opt._update(params, grads, opt_state)
                 return new_params, new_state, lval
 
+        step = _instrumented_step(step)
         self._train_step = step
         return step
 

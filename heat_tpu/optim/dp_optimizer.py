@@ -18,6 +18,7 @@ blend, giving the reference's fire-and-forget overlap without request objects.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -188,6 +189,26 @@ class DataParallelOptimizer:
         self.blocking = blocking
         self._dp = None
         self._opt_state = None
+        from ..utils import profiler as _profiler
+
+        # guard step/skip counters surface in profiler.counters() /
+        # telemetry.report() like DASO's; the provider name is unique per
+        # instance and the bound method is held weakly (dies with self)
+        self.profiler_key = _profiler.register_counter_provider(
+            "optim", self._counter_snapshot
+        )
+
+    def _counter_snapshot(self) -> dict:
+        """Profiler counter provider.  Returns {} (not None — None would
+        deregister) when the eagerly-tracked state is absent or was donated
+        to a jitted step (the live state lives in the caller's loop)."""
+        try:
+            s = _guard_counters(self._opt_state)
+        except RuntimeError:
+            return {}
+        if not s:
+            return {}
+        return {"steps": s["steps"], "skipped_steps": s["skipped"]}
 
     def _attach(self, dp) -> None:
         self._dp = dp
@@ -210,9 +231,18 @@ class DataParallelOptimizer:
 
     def step(self, params, grads):
         """Eager parameter update (gradients already globally averaged by XLA)."""
+        from ..utils import telemetry as _tel
+
         if self._opt_state is None:
             self.init_state(params)
-        new_params, self._opt_state = self._update(params, grads, self._opt_state)
+        if not _tel._ENABLED:
+            new_params, self._opt_state = self._update(params, grads, self._opt_state)
+            return new_params
+        t0 = time.perf_counter()
+        with _tel.span("optim.step"):
+            new_params, self._opt_state = self._update(params, grads, self._opt_state)
+        # dispatch-side latency (JAX is async — no host sync is added here)
+        _tel.observe("optim.step_dispatch_s", time.perf_counter() - t0)
         return new_params
 
     def zero_grad(self) -> None:
@@ -447,7 +477,23 @@ class DASO:
         'dcn' tier); consume it ``stale_steps`` later with the staleness blend.
         During warmup, sync fully every step.  Pass ``key`` when the model
         contains stochastic layers (Dropout): each group receives a split.
+
+        Telemetry (when enabled): each step runs under a ``daso.step`` span
+        and its DISPATCH-side wall time feeds the ``daso.step_dispatch_s``
+        latency histogram — the step stays asynchronous (no host sync is
+        added; the returned loss is still a 0-d device array).
         """
+        from ..utils import telemetry as _tel
+
+        if not _tel._ENABLED:
+            return self._step_impl(loss_fn, x, y, key)
+        t0 = time.perf_counter()
+        with _tel.span("daso.step", step=self._step_count + 1):
+            out = self._step_impl(loss_fn, x, y, key)
+        _tel.observe("daso.step_dispatch_s", time.perf_counter() - t0)
+        return out
+
+    def _step_impl(self, loss_fn, x, y, key=None):
         if self._train_step is None:
             self._build_steps(loss_fn)
         jx = x._jarray if hasattr(x, "_jarray") else jnp.asarray(x)

@@ -3,3 +3,4 @@
 from . import data
 from . import faults
 from . import profiler
+from . import telemetry

@@ -75,15 +75,22 @@ def register_counter_provider(name: str, fn: Callable[[], Dict[str, int]]) -> st
 def counters() -> Dict[str, int]:
     """Snapshot of all counters: incremented ones plus every provider's
     current values.  May sync device-resident counters — call it at
-    reporting boundaries, not inside the hot loop."""
+    reporting boundaries, not inside the hot loop.
+
+    Provider values are namespaced unambiguously under ``<provider>.<key>``
+    (a key already carrying that exact dotted prefix is kept as-is).  The
+    earlier rule — any key merely *starting with* the provider name passed
+    through un-prefixed — let a provider key like ``daso_total`` silently
+    overwrite an identically-named plain counter."""
     out = dict(_counters)
     for name, fn in list(_providers.items()):
         vals = fn()
         if vals is None:  # provider's owner was garbage collected
             _providers.pop(name, None)
             continue
+        prefix = name + "."
         for k, v in vals.items():
-            out[f"{name}.{k}" if not k.startswith(name) else k] = int(v)
+            out[k if k.startswith(prefix) else f"{name}.{k}"] = int(v)
     return out
 
 
@@ -126,13 +133,19 @@ def sync(x=None) -> None:
 
 @contextlib.contextmanager
 def timer(label: str = "", result_holder: Optional[dict] = None, sync_on=None):
-    """Wall-clock a block; forces completion of ``sync_on`` before stopping."""
+    """Wall-clock a block; forces completion of ``sync_on`` before stopping.
+
+    Exception-safe: a raising block still records its elapsed time into
+    ``result_holder`` (and still syncs) — the exception propagates, but the
+    measurement of the partial work is not lost."""
     t0 = time.perf_counter()
-    yield
-    sync(sync_on)
-    dt = time.perf_counter() - t0
-    if result_holder is not None:
-        result_holder[label or "elapsed"] = dt
+    try:
+        yield
+    finally:
+        sync(sync_on)
+        dt = time.perf_counter() - t0
+        if result_holder is not None:
+            result_holder[label or "elapsed"] = dt
 
 
 @contextlib.contextmanager
@@ -143,3 +156,8 @@ def trace(logdir: str = "/tmp/heat_tpu_trace"):
 
 
 annotate = jax.profiler.TraceAnnotation
+
+# the program-cache stats surface in counters() too (counter naming scheme
+# cache.* — see design.md "Telemetry & metrics"), so telemetry.report()
+# carries hit/miss/slow next to comm.*/retry.*/io.* without a second API
+register_counter_provider("cache", lambda: {k: int(v) for k, v in cache_stats().items()})

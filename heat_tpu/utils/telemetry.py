@@ -1,0 +1,560 @@
+"""Runtime telemetry: spans, collective byte accounting, structured export.
+
+The reference framework ships no built-in tracer (SURVEY §5.1 — external
+perun only); this module is the TPU port's first-class story.  Three layers:
+
+- **Spans** — :func:`span` is a nestable context manager that records wall
+  time, carries attributes (op name, shapes, split, bytes), tracks
+  *self-time* (own duration minus children), and forwards its name to
+  ``jax.profiler.TraceAnnotation`` so XProf traces inherit the runtime's
+  vocabulary.  Records land in a bounded ring buffer — telemetry memory is
+  O(ring), never O(run length).
+
+- **Counters & histograms** — byte accounting of every ``Communication``
+  collective (``comm.<name>.calls`` / ``comm.<name>.bytes``, payload nbytes
+  × the collective's algorithmic traffic factor) rides the generic
+  ``utils.profiler`` counter store; latencies go into fixed log-spaced-bin
+  histograms (:class:`Histogram`) with O(1) observation and bounded memory.
+
+- **Export** — :func:`flush` drains the span ring as JSON-lines to a
+  per-rank file (``{dir}/rank{k}.jsonl``) together with counter and
+  histogram snapshots; ``scripts/telemetry_report.py`` merges multi-rank
+  files into one timeline/summary.  :func:`report` returns the in-process
+  merged view (counters ∪ histograms ∪ top spans by self-time).
+
+**Overhead contract.**  Disabled (the default), every instrumentation site
+reduces to one module-global load — the dispatch tails in
+``core._operations`` check a flag that :func:`enable`/:func:`disable` poke
+*into that module*, so the hot path never even calls into here.  Enabled,
+a span costs two clock reads, a ring append and (optionally) a
+TraceAnnotation; the CI telemetry lane gates the enabled cost at <5% of
+dispatch overhead (``benchmarks/dispatch.py --telemetry-gate``).
+
+Arming: ``telemetry.enable()`` in-process, or ``HEAT_TPU_TELEMETRY=1`` in
+the environment (checked once at import).  ``HEAT_TPU_TELEMETRY_DIR``
+additionally registers an atexit flush of the rank file — the multiprocess
+lane's per-rank exports are produced this way.
+
+**Trace-time caveat.**  XLA collectives are *staged*: the Python wrappers
+in ``core.communication`` run at trace time, and a cached executable's
+replays never re-enter them.  ``comm.*.calls`` therefore counts distinct
+*staged* collectives (per compilation), not runtime executions; a
+collective inside ``lax.scan`` counts once however many times the loop
+runs.  Eager sites (``resplit``, checkpoint IO, optimizer steps) count
+per call.  See design.md "Telemetry & metrics".
+
+Stdlib-only at module level on purpose: imported (lazily) from the
+innermost dispatch/comm/IO paths, where a heavy import would be a cycle.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import math
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "enable",
+    "disable",
+    "enabled",
+    "span",
+    "traced",
+    "record_event",
+    "observe",
+    "histogram",
+    "Histogram",
+    "account_collective",
+    "counter_inc",
+    "report",
+    "span_summary",
+    "flush",
+    "reset",
+]
+
+RING_SIZE = 4096
+
+_ENABLED = False
+_ring: deque = deque(maxlen=RING_SIZE)
+_histograms: Dict[str, "Histogram"] = {}
+_hist_lock = threading.Lock()
+_tls = threading.local()
+_flush_dir: Optional[str] = None
+_atexit_registered = False
+_trace_annotation = None  # jax.profiler.TraceAnnotation, resolved at enable()
+_profiler = None  # utils.profiler, resolved on first counter touch
+
+# wall-clock anchor: span timestamps are perf_counter-based for precision
+# but exported in epoch seconds so multi-rank timelines merge on one axis
+_T0_PERF = time.perf_counter()
+_T0_WALL = time.time()
+
+
+def _prof():
+    global _profiler
+    if _profiler is None:
+        from . import profiler
+
+        _profiler = profiler
+    return _profiler
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+# ---------------------------------------------------------------------- #
+# enable / disable
+# ---------------------------------------------------------------------- #
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _poke_dispatch_hook(on: bool) -> None:
+    """Arm/disarm the dispatch hot-path hook: ``core._operations`` reads its
+    own module global (one load, no call) to decide whether to record —
+    set from here so the disabled cost stays at that single load."""
+    mod = sys.modules.get("heat_tpu.core._operations")
+    if mod is not None:
+        mod._TELEMETRY = sys.modules[__name__] if on else None
+
+
+def enable(directory: Optional[str] = None, ring_size: Optional[int] = None) -> None:
+    """Arm telemetry.  ``directory`` (or ``HEAT_TPU_TELEMETRY_DIR``) also
+    registers an atexit :func:`flush` of this process's rank file."""
+    global _ENABLED, _ring, _flush_dir, _atexit_registered, _trace_annotation
+    if ring_size is not None and ring_size != _ring.maxlen:
+        _ring = deque(_ring, maxlen=int(ring_size))
+    if _trace_annotation is None:
+        try:
+            import jax
+
+            _trace_annotation = jax.profiler.TraceAnnotation
+        except Exception:  # pragma: no cover - jax always present in-tree
+            _trace_annotation = None
+    if directory:
+        _flush_dir = directory
+    elif _flush_dir is None:
+        _flush_dir = os.environ.get("HEAT_TPU_TELEMETRY_DIR") or None
+    if _flush_dir and not _atexit_registered:
+        atexit.register(_atexit_flush)
+        _atexit_registered = True
+    _ENABLED = True
+    _poke_dispatch_hook(True)
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+    _poke_dispatch_hook(False)
+
+
+def reset() -> None:
+    """Drop recorded spans and histograms (counters have their own reset in
+    ``utils.profiler``)."""
+    _ring.clear()
+    with _hist_lock:
+        _histograms.clear()
+
+
+def _atexit_flush() -> None:  # pragma: no cover - exercised by the mp lane
+    try:
+        if _ENABLED and _flush_dir:
+            flush(_flush_dir)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------- #
+# spans
+# ---------------------------------------------------------------------- #
+class _NullSpan:
+    """Singleton returned by :func:`span` when telemetry is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "child", "_ta", "_depth")
+
+    def __init__(self, name: str, attrs: dict, xprof: bool):
+        self.name = name
+        self.attrs = attrs
+        self.child = 0.0
+        self._ta = (
+            _trace_annotation(name)
+            if (xprof and _trace_annotation is not None)
+            else None
+        )
+
+    def __enter__(self):
+        stack = _stack()
+        self._depth = len(stack)
+        stack.append(self)
+        if self._ta is not None:
+            self._ta.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        t1 = time.perf_counter()
+        if self._ta is not None:
+            self._ta.__exit__(et, ev, tb)
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        dur = t1 - self.t0
+        if stack:
+            stack[-1].child += dur
+        if et is not None:
+            self.attrs = dict(self.attrs, error=et.__name__)
+        _ring.append(
+            (
+                self.name,
+                _T0_WALL + (self.t0 - _T0_PERF),
+                dur,
+                max(dur - self.child, 0.0),
+                self._depth,
+                self.attrs or None,
+            )
+        )
+        return False
+
+    def set(self, **attrs):
+        """Attach/override attributes mid-span (e.g. bytes known at the end)."""
+        self.attrs = dict(self.attrs, **attrs)
+        return self
+
+
+def span(name: str, xprof: bool = True, **attrs):
+    """Record a named, attributed, nested wall-time span of the block.
+
+    No-op (a shared null object) when telemetry is disabled.  ``xprof=False``
+    skips the ``jax.profiler.TraceAnnotation`` forwarding — for sites hot
+    enough that creating the annotation object is measurable."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _Span(name, attrs, xprof)
+
+
+def traced(name: str):
+    """Decorator form of :func:`span` for whole functions (checkpoint
+    save/load entry points).  Disabled cost: one flag check."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _ENABLED:
+                return fn(*args, **kwargs)
+            with _Span(name, {}, True):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def record_event(name: str, dur_s: float, attrs: Optional[dict] = None) -> None:
+    """Leaf span record for a duration the caller already measured — no
+    enter/exit machinery, no TraceAnnotation."""
+    if not _ENABLED:
+        return
+    stack = _stack()
+    if stack:
+        stack[-1].child += dur_s
+    _ring.append(
+        (
+            name,
+            _T0_WALL + (time.perf_counter() - dur_s - _T0_PERF),
+            dur_s,
+            dur_s,
+            len(stack),
+            attrs or None,
+        )
+    )
+
+
+def record_dispatch(name: str, t0: float, t1: float, op_name: str, cache_hit: bool) -> None:
+    """The dispatch tails' recorder — the leanest path here: the caller
+    supplies both perf_counter readings and the pre-resolved span name, so
+    one call records a leaf span with the op/cache attributes and nothing
+    else happens on the hot path."""
+    if not _ENABLED:
+        return
+    dur = t1 - t0
+    stack = _stack()
+    if stack:
+        stack[-1].child += dur
+    _ring.append(
+        (
+            name,
+            _T0_WALL + (t0 - _T0_PERF),
+            dur,
+            dur,
+            len(stack),
+            {"op": op_name, "cache": "hit" if cache_hit else "miss"},
+        )
+    )
+
+
+def span_summary(top: Optional[int] = None) -> List[dict]:
+    """Spans currently in the ring aggregated by name, sorted by total
+    self-time (descending)."""
+    agg: Dict[str, list] = {}
+    for name, _ts, dur, self_s, _depth, _attrs in list(_ring):
+        row = agg.get(name)
+        if row is None:
+            row = agg[name] = [0, 0.0, 0.0, 0.0]
+        row[0] += 1
+        row[1] += dur
+        row[2] += self_s
+        row[3] = max(row[3], dur)
+    rows = [
+        {
+            "name": name,
+            "count": c,
+            "total_s": round(total, 6),
+            "self_s": round(self_s, 6),
+            "mean_us": round(total / c * 1e6, 2),
+            "max_us": round(mx * 1e6, 2),
+        }
+        for name, (c, total, self_s, mx) in agg.items()
+    ]
+    rows.sort(key=lambda r: -r["self_s"])
+    return rows[:top] if top is not None else rows
+
+
+# ---------------------------------------------------------------------- #
+# counters (delegated to utils.profiler — one store for retry.*, comm.*,
+# io.*, daso.*; telemetry.report() reads them all back)
+# ---------------------------------------------------------------------- #
+def counter_inc(name: str, n: int = 1) -> None:
+    """Increment a named counter in the shared ``utils.profiler`` store."""
+    _prof().counter_inc(name, n)
+
+
+def account_collective(name: str, nbytes: float) -> None:
+    """``comm.<name>.calls`` += 1 and ``comm.<name>.bytes`` += nbytes.
+
+    Always on (two dict increments at collective *staging* time — nowhere
+    near a hot path); ``nbytes`` is payload × algorithmic traffic factor,
+    already computed by the caller."""
+    p = _prof()
+    p.counter_inc(f"comm.{name}.calls")
+    if nbytes:
+        p.counter_inc(f"comm.{name}.bytes", int(round(nbytes)))
+
+
+# ---------------------------------------------------------------------- #
+# histograms — fixed log-spaced bins, bounded memory, O(1) observe
+# ---------------------------------------------------------------------- #
+_H_LO = 1e-6  # 1 µs
+_H_PER_DECADE = 5
+_H_DECADES = 9  # 1 µs .. 1000 s
+_H_NBINS = _H_DECADES * _H_PER_DECADE
+
+
+class Histogram:
+    """Latency histogram over fixed log-spaced bins (1 µs – 1000 s at 5
+    bins/decade, plus under/overflow): memory is a constant 47 ints however
+    many observations arrive — no unbounded sample lists."""
+
+    __slots__ = ("name", "counts", "count", "total", "vmin", "vmax")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.counts = [0] * (_H_NBINS + 2)  # [underflow, bins..., overflow]
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = 0.0
+
+    def observe(self, value_s: float) -> None:
+        v = float(value_s)
+        if not (v > 0.0):  # <=0 and NaN both land in the underflow bin
+            idx = 0
+            v = 0.0
+        else:
+            i = int(math.floor(math.log10(v / _H_LO) * _H_PER_DECADE))
+            idx = min(max(i, -1), _H_NBINS) + 1
+        self.counts[idx] += 1
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def quantile(self, q: float) -> float:
+        """Upper-edge estimate of the ``q``-quantile from the bin counts."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for idx, n in enumerate(self.counts):
+            seen += n
+            if n and seen >= target:
+                if idx == 0:
+                    return self.vmin if self.vmin is not math.inf else 0.0
+                # upper edge of bin idx-1; overflow and the top bin clamp
+                # to the observed max
+                return min(_H_LO * 10 ** (idx / _H_PER_DECADE), self.vmax)
+        return self.vmax
+
+    def summary(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "mean_s": round(self.total / self.count, 9),
+            "min_s": round(0.0 if self.vmin is math.inf else self.vmin, 9),
+            "max_s": round(self.vmax, 9),
+            "p50_s": round(self.quantile(0.50), 9),
+            "p90_s": round(self.quantile(0.90), 9),
+            "p99_s": round(self.quantile(0.99), 9),
+        }
+
+
+def histogram(name: str) -> Histogram:
+    """Get-or-create the named histogram."""
+    h = _histograms.get(name)
+    if h is None:
+        with _hist_lock:
+            h = _histograms.setdefault(name, Histogram(name))
+    return h
+
+
+def observe(name: str, value_s: float) -> None:
+    """Record ``value_s`` (seconds) into the named histogram."""
+    histogram(name).observe(value_s)
+
+
+# ---------------------------------------------------------------------- #
+# report & export
+# ---------------------------------------------------------------------- #
+def report(top: int = 15) -> dict:
+    """In-process merged view: counters ∪ histograms ∪ top spans by
+    self-time.  May sync device-resident counters — reporting boundary
+    only, never the hot loop."""
+    return {
+        "enabled": _ENABLED,
+        "rank": _rank(),
+        "counters": _prof().counters(),
+        "histograms": {n: h.summary() for n, h in sorted(_histograms.items())},
+        "top_spans": span_summary(top),
+    }
+
+
+def _rank() -> int:
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            return int(jax_mod.process_index())
+        except Exception:
+            pass
+    return int(os.environ.get("HEAT_TPU_TELEMETRY_RANK", "0") or 0)
+
+
+def _jsonable(v: Any):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+def flush(directory: Optional[str] = None) -> Optional[str]:
+    """Drain the span ring to ``{dir}/rank{k}.jsonl`` (appending), together
+    with a meta line and current counter/histogram snapshots.  Returns the
+    path written, or None when no directory is configured (arg,
+    ``enable(directory=...)`` or ``HEAT_TPU_TELEMETRY_DIR``)."""
+    directory = directory or _flush_dir or os.environ.get("HEAT_TPU_TELEMETRY_DIR")
+    if not directory:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    rank = _rank()
+    path = os.path.join(directory, f"rank{rank}.jsonl")
+    spans = []
+    while True:
+        try:
+            spans.append(_ring.popleft())
+        except IndexError:
+            break
+    with open(path, "a") as fh:
+        fh.write(
+            json.dumps(
+                {
+                    "type": "meta",
+                    "rank": rank,
+                    "pid": os.getpid(),
+                    "wall_time": time.time(),
+                    "ring_size": _ring.maxlen,
+                }
+            )
+            + "\n"
+        )
+        for name, ts, dur, self_s, depth, attrs in spans:
+            rec = {
+                "type": "span",
+                "rank": rank,
+                "name": name,
+                "ts": round(ts, 6),
+                "dur_s": round(dur, 9),
+                "self_s": round(self_s, 9),
+                "depth": depth,
+            }
+            if attrs:
+                rec["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+            fh.write(json.dumps(rec) + "\n")
+        fh.write(
+            json.dumps(
+                {"type": "counters", "rank": rank, "values": _prof().counters()}
+            )
+            + "\n"
+        )
+        for name, h in sorted(_histograms.items()):
+            fh.write(
+                json.dumps(
+                    {
+                        "type": "hist",
+                        "rank": rank,
+                        "name": name,
+                        "count": h.count,
+                        "total_s": h.total,
+                        "min_s": 0.0 if h.vmin is math.inf else h.vmin,
+                        "max_s": h.vmax,
+                        "lo": _H_LO,
+                        "per_decade": _H_PER_DECADE,
+                        "bins": {str(i): c for i, c in enumerate(h.counts) if c},
+                    }
+                )
+                + "\n"
+            )
+    return path
+
+
+# env arming: one check at import, the documented subprocess story
+if os.environ.get("HEAT_TPU_TELEMETRY", "").strip().lower() in ("1", "true", "on", "yes"):
+    enable()

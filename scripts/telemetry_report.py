@@ -1,0 +1,264 @@
+"""Merge per-rank telemetry JSONL exports into one timeline + summary.
+
+    python scripts/telemetry_report.py DIR_OR_FILE... [--json OUT]
+                                       [--top N] [--timeline N]
+
+Each rank of a run writes ``rank<k>.jsonl`` (``heat_tpu.utils.telemetry
+.flush``; the multiprocess lane and the CI telemetry job arm this via
+``HEAT_TPU_TELEMETRY_DIR``).  This CLI reads any mix of directories
+(``rank*.jsonl`` inside) and explicit files and prints:
+
+- a cross-rank **span summary** aggregated by name, sorted by self-time —
+  where the wall-clock went, per site, over all ranks;
+- **counters** summed over ranks (``comm.*`` byte accounting, ``cache.*``
+  hit/miss, ``retry.*``, ``io.*``, ``daso.*``) — the per-rank LAST counters
+  record wins (counters are cumulative within a rank);
+- merged **histograms** (log-spaced bins sum exactly across ranks; the
+  percentiles are recomputed from the merged bins);
+- a merged **timeline**: the first N spans of all ranks on one wall-clock
+  axis (span timestamps are exported in epoch seconds for this reason).
+
+Deliberately stdlib-only (no jax, no heat_tpu import): it must run
+instantly on a login node against artifacts scp'd from a pod.
+
+Exit code: 0 on success, 1 when no rank files were found/readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import Dict, List
+
+
+def find_rank_files(target: str) -> List[str]:
+    """Rank files under a directory, or the file itself."""
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "rank*.jsonl")))
+    return [target] if os.path.exists(target) else []
+
+
+def _read_records(path: str) -> List[dict]:
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # a torn tail line must not sink the whole report
+    return records
+
+
+def _merge_hist(agg: dict, rec: dict) -> None:
+    """Histograms merge exactly: same fixed bin grid on every rank, so bin
+    counts sum; min/max/total/count fold."""
+    for i, c in rec.get("bins", {}).items():
+        agg["bins"][i] = agg["bins"].get(i, 0) + int(c)
+    agg["count"] += int(rec.get("count", 0))
+    agg["total_s"] += float(rec.get("total_s", 0.0))
+    agg["min_s"] = min(agg["min_s"], float(rec.get("min_s", math.inf) or math.inf))
+    agg["max_s"] = max(agg["max_s"], float(rec.get("max_s", 0.0)))
+    agg["lo"] = float(rec.get("lo", 1e-6))
+    agg["per_decade"] = int(rec.get("per_decade", 5))
+
+
+def _hist_quantile(agg: dict, q: float) -> float:
+    if not agg["count"]:
+        return 0.0
+    target = q * agg["count"]
+    seen = 0
+    for i in sorted(agg["bins"], key=int):
+        n = agg["bins"][i]
+        seen += n
+        if n and seen >= target:
+            idx = int(i)
+            if idx == 0:
+                return 0.0 if agg["min_s"] is math.inf else agg["min_s"]
+            return min(agg["lo"] * 10 ** (idx / agg["per_decade"]), agg["max_s"])
+    return agg["max_s"]
+
+
+def merge_files(paths: List[str]) -> dict:
+    """Fold every rank file into one merged structure (see module docstring
+    for the merge rules)."""
+    spans: List[dict] = []
+    counters_by_rank: Dict[int, dict] = {}
+    hists_by_rank: Dict[int, Dict[str, dict]] = {}
+    ranks = set()
+    for path in paths:
+        for rec in _read_records(path):
+            kind = rec.get("type")
+            rank = int(rec.get("rank", 0))
+            ranks.add(rank)
+            if kind == "span":
+                spans.append(rec)
+            elif kind == "counters":
+                counters_by_rank[rank] = rec.get("values", {})  # last wins
+            elif kind == "hist":
+                # hist records are CUMULATIVE snapshots (like counters): a
+                # rank that flushes twice writes the same observations twice,
+                # so within a rank the LAST snapshot wins; only across ranks
+                # do bins sum
+                hists_by_rank.setdefault(rank, {})[rec["name"]] = rec
+    spans.sort(key=lambda r: r.get("ts", 0.0))
+
+    hists: Dict[str, dict] = {}
+    for per_rank in hists_by_rank.values():
+        for name, rec in per_rank.items():
+            agg = hists.get(name)
+            if agg is None:
+                agg = hists[name] = {
+                    "bins": {}, "count": 0, "total_s": 0.0,
+                    "min_s": math.inf, "max_s": 0.0,
+                    "lo": 1e-6, "per_decade": 5,
+                }
+            _merge_hist(agg, rec)
+
+    by_name: Dict[str, list] = {}
+    for s in spans:
+        row = by_name.setdefault(s["name"], [0, 0.0, 0.0, 0.0, set()])
+        row[0] += 1
+        row[1] += float(s.get("dur_s", 0.0))
+        row[2] += float(s.get("self_s", 0.0))
+        row[3] = max(row[3], float(s.get("dur_s", 0.0)))
+        row[4].add(int(s.get("rank", 0)))
+    span_summary = sorted(
+        (
+            {
+                "name": name,
+                "count": c,
+                "total_s": round(total, 6),
+                "self_s": round(self_s, 6),
+                "max_ms": round(mx * 1e3, 3),
+                "ranks": sorted(rks),
+            }
+            for name, (c, total, self_s, mx, rks) in by_name.items()
+        ),
+        key=lambda r: -r["self_s"],
+    )
+
+    counters: Dict[str, int] = {}
+    for vals in counters_by_rank.values():
+        for k, v in vals.items():
+            counters[k] = counters.get(k, 0) + int(v)
+
+    hist_summary = {}
+    for name, agg in sorted(hists.items()):
+        if not agg["count"]:
+            hist_summary[name] = {"count": 0}
+            continue
+        hist_summary[name] = {
+            "count": agg["count"],
+            "mean_s": round(agg["total_s"] / agg["count"], 9),
+            "p50_s": round(_hist_quantile(agg, 0.50), 9),
+            "p90_s": round(_hist_quantile(agg, 0.90), 9),
+            "p99_s": round(_hist_quantile(agg, 0.99), 9),
+            "max_s": round(agg["max_s"], 9),
+        }
+
+    return {
+        "ranks": sorted(ranks),
+        "files": paths,
+        "n_spans": len(spans),
+        "span_summary": span_summary,
+        "counters": dict(sorted(counters.items())),
+        "counters_per_rank": {str(r): v for r, v in sorted(counters_by_rank.items())},
+        "histograms": hist_summary,
+        "timeline": spans,
+    }
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def render(merged: dict, top: int = 20, timeline: int = 25) -> str:
+    out = []
+    ranks = merged["ranks"]
+    out.append(
+        f"telemetry report: {len(merged['files'])} rank file(s), "
+        f"ranks={ranks}, {merged['n_spans']} spans"
+    )
+    if merged["span_summary"]:
+        out.append("\n-- span summary (by total self-time, all ranks) --")
+        rows = [
+            [r["name"], r["count"], f"{r['total_s'] * 1e3:.3f}",
+             f"{r['self_s'] * 1e3:.3f}", f"{r['max_ms']:.3f}",
+             ",".join(str(x) for x in r["ranks"])]
+            for r in merged["span_summary"][:top]
+        ]
+        out.append(_fmt_table(rows, ["span", "calls", "total_ms", "self_ms", "max_ms", "ranks"]))
+    if merged["counters"]:
+        out.append("\n-- counters (summed over ranks) --")
+        rows = [[k, v] for k, v in merged["counters"].items()]
+        out.append(_fmt_table(rows, ["counter", "value"]))
+    if merged["histograms"]:
+        out.append("\n-- histograms (merged bins) --")
+        rows = []
+        for name, h in merged["histograms"].items():
+            if not h["count"]:
+                continue
+            rows.append([
+                name, h["count"], f"{h['mean_s'] * 1e3:.3f}",
+                f"{h['p50_s'] * 1e3:.3f}", f"{h['p90_s'] * 1e3:.3f}",
+                f"{h['p99_s'] * 1e3:.3f}", f"{h['max_s'] * 1e3:.3f}",
+            ])
+        if rows:
+            out.append(_fmt_table(
+                rows, ["histogram", "n", "mean_ms", "p50_ms", "p90_ms", "p99_ms", "max_ms"]
+            ))
+    if merged["timeline"] and timeline > 0:
+        out.append(f"\n-- timeline (first {min(timeline, len(merged['timeline']))} spans, all ranks) --")
+        t0 = merged["timeline"][0].get("ts", 0.0)
+        rows = []
+        for s in merged["timeline"][:timeline]:
+            rows.append([
+                f"+{(s.get('ts', 0.0) - t0) * 1e3:.3f}ms",
+                s.get("rank", 0),
+                "  " * int(s.get("depth", 0)) + s["name"],
+                f"{float(s.get('dur_s', 0.0)) * 1e3:.3f}",
+            ])
+        out.append(_fmt_table(rows, ["t", "rank", "span", "dur_ms"]))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="+", help="telemetry dirs and/or rank*.jsonl files")
+    ap.add_argument("--json", default=None, help="also write the merged structure here")
+    ap.add_argument("--top", type=int, default=20, help="span-summary rows to print")
+    ap.add_argument("--timeline", type=int, default=25,
+                    help="timeline rows to print (0 disables)")
+    args = ap.parse_args(argv)
+
+    paths = []
+    for t in args.targets:
+        paths.extend(find_rank_files(t))
+    paths = sorted(dict.fromkeys(paths))  # de-dup, stable order
+    if not paths:
+        print(f"no rank*.jsonl files found under {args.targets}", file=sys.stderr)
+        return 1
+    merged = merge_files(paths)
+    print(render(merged, top=args.top, timeline=args.timeline))
+    if args.json:
+        # the timeline can be huge; the JSON artifact keeps it whole (the
+        # text rendering is the bounded view)
+        with open(args.json, "w") as fh:
+            json.dump(merged, fh, indent=1)
+        print(f"\nmerged JSON written to {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

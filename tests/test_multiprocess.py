@@ -54,6 +54,11 @@ def test_n_process_spmd_tier(n_proc, devs):
     for pid in range(n_proc):
         assert f"[{pid}] {mpd.MARKER}" in out, out[-2000:]
         assert f"[{pid}] comm: size=8 rank={pid}/{n_proc}" in out
+        # every rank exported a telemetry jsonl file...
+        assert f"[{pid}] telemetry: rank file exported" in out, out[-2000:]
+    # ...and the launcher merged them into ONE multi-rank report (ISSUE 3
+    # acceptance: scripts/telemetry_report.py folds the mp lane's rank files)
+    assert f"TELEMETRY-MERGED ranks={n_proc}" in out, out[-2000:]
 
 
 @pytest.mark.heavy

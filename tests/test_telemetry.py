@@ -1,0 +1,465 @@
+"""Telemetry layer (ISSUE 3): spans, byte accounting, histograms, export —
+plus the profiler satellite fixes (provider namespacing, exception-safe
+timer, weakref pruning)."""
+
+import gc
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import _operations
+from heat_tpu.utils import profiler, telemetry
+
+# NOT mp-marked: these tests toggle global telemetry state and write rank
+# files into tmp dirs — under the SPMD lane's shared tmp_path both ranks
+# would race on rank{k}.jsonl sets and counter totals.  The multi-rank
+# telemetry path is covered by the dryrun's per-rank export + merge check
+# (scripts/multiprocess_dryrun.py, asserted in test_multiprocess.py).
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts disarmed with empty rings/counters and leaves the
+    process the same way (telemetry state is global by design)."""
+    telemetry.disable()
+    telemetry.reset()
+    profiler.reset_counters()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    profiler.reset_counters()
+
+
+def _ring_names():
+    return [r[0] for r in telemetry._ring]
+
+
+class TestSpans:
+    def test_span_records_nesting_and_self_time(self):
+        telemetry.enable()
+        with telemetry.span("outer", kind="test"):
+            with telemetry.span("inner"):
+                pass
+        recs = {r[0]: r for r in telemetry._ring}
+        assert set(recs) == {"outer", "inner"}
+        name, ts, dur, self_s, depth, attrs = recs["outer"]
+        assert depth == 0 and attrs == {"kind": "test"}
+        assert recs["inner"][4] == 1  # nested depth
+        # parent self-time excludes the child's wall time
+        assert recs["outer"][3] <= recs["outer"][2]
+        assert recs["inner"][2] <= recs["outer"][2]
+
+    def test_span_survives_exceptions_and_tags_error(self):
+        telemetry.enable()
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("x")
+        (rec,) = list(telemetry._ring)
+        assert rec[0] == "boom" and rec[5]["error"] == "ValueError"
+
+    def test_disabled_span_is_shared_null_object(self):
+        assert telemetry.span("a") is telemetry.span("b")
+        with telemetry.span("nope", anything=1) as s:
+            s.set(more=2)  # null span absorbs attribute updates too
+        assert len(telemetry._ring) == 0
+
+    def test_span_attrs_set_midway(self):
+        telemetry.enable()
+        with telemetry.span("s", a=1) as s:
+            s.set(b=2)
+        (rec,) = list(telemetry._ring)
+        assert rec[5] == {"a": 1, "b": 2}
+
+    def test_disabled_noop_under_jit_tracing(self):
+        """Satellite: span() inside a traced function must be a no-op when
+        disabled and must not break tracing when enabled."""
+
+        def f(a):
+            with telemetry.span("traced.block"):
+                return a * 2
+
+        out = jax.jit(f)(jnp.ones(4))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        assert len(telemetry._ring) == 0  # disabled: nothing recorded
+
+        telemetry.enable()
+        out = jax.jit(f)(jnp.ones(8))  # fresh shape -> fresh trace
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        assert "traced.block" in _ring_names()  # recorded at trace time
+
+    def test_ring_is_bounded(self):
+        telemetry.enable()
+        for i in range(telemetry._ring.maxlen + 100):
+            telemetry.record_event("e", 1e-6)
+        assert len(telemetry._ring) == telemetry._ring.maxlen
+
+
+class TestDispatchInstrumentation:
+    def test_dispatch_tail_records_op_and_cache_state(self):
+        x = ht.random.randn(16, 16, split=0)
+        y = ht.random.randn(16, 16, split=0)
+        _ = x + y  # compile outside the armed window
+        telemetry.enable()
+        telemetry.reset()
+        _ = x + y
+        _ = ht.sum(x, axis=0)
+        recs = list(telemetry._ring)
+        kinds = {r[0]: r[5] for r in recs}
+        assert kinds["dispatch.binary"]["op"] == "add"
+        assert kinds["dispatch.binary"]["cache"] == "hit"
+        assert "dispatch.reduce" in kinds
+        # a fresh signature through the armed window records a miss
+        # (mesh-divisible leading extent: ragged shapes take the pad path)
+        z = ht.random.randn(8 * ht.communication.get_comm().size, 4, split=0)
+        telemetry.reset()
+        _ = z + z
+        (rec,) = [r for r in telemetry._ring if r[0] == "dispatch.binary"]
+        assert rec[5]["cache"] == "miss"
+
+    def test_disabled_dispatch_adds_nothing(self):
+        """The telemetry-off contract: the hot-path hook is None and no
+        record is ever created."""
+        assert _operations._TELEMETRY is None
+        x = ht.random.randn(8, 8, split=0)
+        _ = x + x
+        assert len(telemetry._ring) == 0
+        telemetry.enable()
+        assert _operations._TELEMETRY is telemetry
+        telemetry.disable()
+        assert _operations._TELEMETRY is None
+
+
+class TestCollectiveAccounting:
+    def _fresh_comm(self):
+        # a fresh Communication => fresh program caches => the collectives
+        # genuinely re-stage (byte accounting happens at trace time)
+        return ht.core.communication.Communication(ht.communication.get_comm().mesh)
+
+    def test_resplit_bytes_and_calls(self):
+        m = ht.reshape(ht.arange(64, dtype=ht.float32, split=0), (8, 8))
+        m.resplit_(1)
+        c = profiler.counters()
+        assert c["comm.resplit.calls"] >= 1
+        p = m.comm.size
+        # (p-1)/p of the global payload crosses the wire
+        assert c["comm.resplit.bytes"] >= int(64 * 4 * (p - 1) / p)
+
+    def test_noop_resplit_not_counted(self):
+        """A resplit to the sharding the array already carries moves no
+        bytes and must not inflate the redistribution traffic metric."""
+        x = ht.zeros((16, 16), split=0)
+        x._jarray  # force canonical placement
+        before = profiler.counters().get("comm.resplit.calls", 0)
+        _ = x.comm.resplit(x._jarray, 0)
+        _ = x.comm.resplit(x._jarray, 0, donate=True)
+        c = profiler.counters()
+        assert c.get("comm.resplit.calls", 0) == before
+        assert c.get("comm.resplit.bytes", 0) == 0
+
+    def test_allreduce_traffic_factor(self):
+        comm = self._fresh_comm()
+        p = comm.size
+        prog = comm.shard_map(lambda v: comm.Allreduce(v), ((1, 0),), (1, None))
+        out = prog(jnp.ones(8 * p, jnp.float32))
+        np.testing.assert_allclose(np.asarray(out)[:1], p)  # p ones summed
+        c = profiler.counters()
+        assert c["comm.Allreduce.calls"] == 1
+        # per-shard payload 8*4 bytes x ring factor 2(p-1)/p
+        assert c["comm.Allreduce.bytes"] == int(round(8 * 4 * 2 * (p - 1) / p))
+
+    def test_summa_matmul_shows_send_bytes(self):
+        """Acceptance: per-collective calls/bytes for a SUMMA matmul."""
+        comm = self._fresh_comm()
+        rng = np.random.default_rng(0)
+        a = ht.array(rng.standard_normal((32, 32)).astype(np.float32), split=0, comm=comm)
+        b = ht.array(rng.standard_normal((32, 32)).astype(np.float32), split=0, comm=comm)
+        out = ht.linalg.matmul_summa(a, b)
+        np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(), atol=1e-4)
+        c = profiler.counters()
+        assert c["comm.Send.calls"] >= 1  # the K-block ring rotation
+        assert c["comm.Send.bytes"] > 0
+
+    def test_scan_exscan_prod_attribution(self):
+        """Scan/Exscan account under their own names (not the shared
+        helper), and Allreduce-prod accounts ONCE with scan+psum cost."""
+        comm = self._fresh_comm()
+        p = comm.size
+        nb = 8 * 4  # per-shard payload bytes below
+        x = jnp.ones(8 * p, jnp.float32)
+        _ = comm.shard_map(lambda v: comm.Exscan(v), ((1, 0),), (1, 0))(x)
+        _ = comm.shard_map(lambda v: comm.Scan(v), ((1, 0),), (1, 0))(x)
+        _ = comm.shard_map(lambda v: comm.Allreduce(v, "prod"), ((1, 0),), (1, None))(x)
+        c = profiler.counters()
+        logp = max(p - 1, 0).bit_length()
+        assert c["comm.Exscan.calls"] == 1
+        assert c["comm.Exscan.bytes"] == int(round(nb * (logp + 1)))
+        assert c["comm.Scan.calls"] == 1  # Exscan's inner scan not re-counted
+        assert c["comm.Scan.bytes"] == int(round(nb * logp))
+        assert c["comm.Allreduce.calls"] == 1
+        assert c["comm.Allreduce.bytes"] == int(round(nb * (2 * (p - 1) / p + logp)))
+
+    def test_gather_fallback_counter(self):
+        """Satellite: gather-based collectives count under
+        comm.gather_fallback.<name> even below the warn threshold."""
+        comm = self._fresh_comm()
+        p = comm.size
+        prog = comm.shard_map(lambda v: comm.Gather(v), ((1, 0),), (1, 0))
+        _ = prog(jnp.arange(8 * p, dtype=jnp.float32))
+        c = profiler.counters()
+        assert c["comm.gather_fallback.Gather"] >= 1
+        assert c["comm.Gather.calls"] >= 1
+
+    def test_payload_nbytes_on_tracers(self):
+        from heat_tpu.core.communication import _payload_nbytes
+
+        assert _payload_nbytes(jnp.ones((4, 2), jnp.float32)) == 32
+
+        seen = {}
+
+        def f(v):
+            seen["n"] = _payload_nbytes(v)  # v is a tracer here
+            return v
+
+        jax.jit(f)(jnp.ones((4, 2), jnp.float32))
+        assert seen["n"] == 32
+
+
+class TestHistogram:
+    def test_summary_and_quantiles(self):
+        h = telemetry.Histogram("lat")
+        for v in [1e-5] * 50 + [1e-3] * 40 + [1e-1] * 10:
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["min_s"] == pytest.approx(1e-5)
+        assert s["max_s"] == pytest.approx(1e-1)
+        assert s["p50_s"] <= s["p90_s"] <= s["p99_s"] <= s["max_s"] * 1.6
+        # p50 sits in the 10us decade, p99 near the top
+        assert s["p50_s"] < 1e-3
+        assert s["p99_s"] > 1e-2
+
+    def test_bounded_memory_and_degenerate_values(self):
+        h = telemetry.Histogram("x")
+        n_slots = len(h.counts)
+        for i in range(10000):
+            h.observe(i * 1e-7)
+        h.observe(float("nan"))
+        h.observe(-1.0)
+        h.observe(1e9)  # overflow bin
+        assert len(h.counts) == n_slots
+        assert h.count == 10003
+
+    def test_observe_helper_and_report_section(self):
+        telemetry.observe("unit.lat_s", 0.01)
+        telemetry.observe("unit.lat_s", 0.02)
+        rep = telemetry.report()
+        assert rep["histograms"]["unit.lat_s"]["count"] == 2
+
+
+class TestReportAndExport:
+    def test_report_merges_counters_hists_spans(self):
+        telemetry.enable()
+        profiler.counter_inc("unit.events", 3)
+        telemetry.observe("unit.lat_s", 0.5)
+        with telemetry.span("unit.work"):
+            pass
+        rep = telemetry.report()
+        assert rep["enabled"] is True
+        assert rep["counters"]["unit.events"] == 3
+        assert "cache.hits" in rep["counters"]  # cache.* provider rides along
+        assert rep["histograms"]["unit.lat_s"]["count"] == 1
+        assert any(r["name"] == "unit.work" for r in rep["top_spans"])
+
+    def test_flush_and_cli_merge(self, tmp_path):
+        telemetry.enable()
+        with telemetry.span("unit.flushme", tag="t"):
+            pass
+        telemetry.observe("unit.lat_s", 0.002)
+        profiler.counter_inc("unit.flush_counter", 7)
+        d = str(tmp_path / "tel")
+        path = telemetry.flush(d)
+        assert path is not None and os.path.exists(path)
+        lines = [json.loads(line) for line in open(path)]
+        types = {rec["type"] for rec in lines}
+        assert {"meta", "span", "counters", "hist"} <= types
+        assert len(telemetry._ring) == 0  # flush drains the ring
+
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "scripts", "telemetry_report.py"),
+        )
+        trep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(trep)
+        merged = trep.merge_files(trep.find_rank_files(d))
+        assert any(r["name"] == "unit.flushme" for r in merged["span_summary"])
+        assert merged["counters"]["unit.flush_counter"] == 7
+        assert merged["histograms"]["unit.lat_s"]["count"] == 1
+        text = trep.render(merged)
+        assert "unit.flushme" in text and "unit.flush_counter" in text
+        # two-rank merge: fake a second rank's file, counters must SUM
+        second = [dict(rec, rank=1) for rec in lines]
+        with open(os.path.join(d, "rank1.jsonl"), "w") as fh:
+            for rec in second:
+                fh.write(json.dumps(rec) + "\n")
+        merged2 = trep.merge_files(trep.find_rank_files(d))
+        assert merged2["ranks"] == [0, 1]
+        assert merged2["counters"]["unit.flush_counter"] == 14
+        assert merged2["histograms"]["unit.lat_s"]["count"] == 2
+        # CLI entry point end to end
+        out_json = str(tmp_path / "merged.json")
+        assert trep.main([d, "--json", out_json]) == 0
+        assert json.load(open(out_json))["ranks"] == [0, 1]
+        # a SECOND flush of the same rank appends a fresh cumulative
+        # histogram snapshot — within one rank the last snapshot must win
+        # (summing would double-count every observation)
+        telemetry.observe("unit.lat_s", 0.002)
+        telemetry.flush(d)
+        merged3 = trep.merge_files(trep.find_rank_files(d))
+        # rank0 now has 2 observations (last snapshot), fake rank1 has 1
+        assert merged3["histograms"]["unit.lat_s"]["count"] == 3
+
+    def test_flush_without_dir_is_none(self):
+        telemetry.enable()
+        env_dir = os.environ.pop("HEAT_TPU_TELEMETRY_DIR", None)
+        saved = telemetry._flush_dir
+        telemetry._flush_dir = None
+        try:
+            assert telemetry.flush() is None
+        finally:
+            telemetry._flush_dir = saved
+            if env_dir is not None:
+                os.environ["HEAT_TPU_TELEMETRY_DIR"] = env_dir
+
+
+class TestIOInstrumentation:
+    def test_checkpoint_bytes_fsync_and_span(self, tmp_path):
+        telemetry.enable()
+        x = ht.arange(64, dtype=ht.float32, split=0)
+        ht.save_array_checkpoint(x, str(tmp_path / "ckpt"))
+        c = profiler.counters()
+        assert c["io.bytes_written"] > 64 * 4  # chunks + meta + LATEST tmp
+        assert c["io.fsync.calls"] >= 4  # files + dir fsyncs
+        names = _ring_names()
+        assert "io.save_array_checkpoint" in names
+        back = ht.load_array_checkpoint(str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+        assert "io.load_array_checkpoint" in _ring_names()
+
+    def test_pytree_checkpoint_counts_bytes(self, tmp_path):
+        from heat_tpu.core import io as htio
+
+        telemetry.enable()
+        tree = {"w": jnp.ones((8, 8), jnp.float32)}
+        htio.save_checkpoint(tree, str(tmp_path / "t.npz"))
+        c = profiler.counters()
+        assert c["io.bytes_written"] > 0
+        assert "io.save_checkpoint" in _ring_names()
+
+
+class TestOptimInstrumentation:
+    def test_eager_step_histogram_and_guard_provider(self):
+        telemetry.enable()
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        params = {"w": jnp.ones(4, jnp.float32)}
+        grads = {"w": jnp.full(4, 0.5, jnp.float32)}
+        params = opt.step(params, grads)
+        rep = telemetry.report()
+        assert rep["histograms"]["optim.step_dispatch_s"]["count"] == 1
+        assert any(r["name"] == "optim.step" for r in rep["top_spans"])
+        # guard counters surface under the instance's unique provider key
+        assert rep["counters"][f"{opt.profiler_key}.steps"] == 1
+        assert rep["counters"][f"{opt.profiler_key}.skipped_steps"] == 0
+
+    def test_daso_step_histogram(self):
+        if len(jax.devices()) % 2:
+            pytest.skip("DASO needs an even device count")
+        telemetry.enable()
+        from heat_tpu.optim.dp_optimizer import DASO, DataParallelOptimizer
+
+        daso = DASO(DataParallelOptimizer("sgd", lr=0.1), warmup_steps=0)
+        daso.init(ht.nn.Sequential(ht.nn.Linear(8, 4)), key=jax.random.key(0))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        daso.step(lambda pred, t: jnp.mean((pred - t) ** 2), x, y)
+        rep = telemetry.report()
+        assert rep["histograms"]["daso.step_dispatch_s"]["count"] == 1
+        assert any(r["name"] == "daso.step" for r in rep["top_spans"])
+
+    def test_train_step_wrapper_keeps_lower(self):
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        dp = ht.nn.DataParallel(ht.nn.Sequential(ht.nn.Linear(8, 4)), optimizer=opt)
+        params = dp.init(jax.random.key(0))
+        state = opt.init_state(params)
+        step = dp.make_train_step(lambda p, y: jnp.mean((p - y) ** 2))
+        x = jnp.zeros((16, 8), jnp.float32)
+        y = jnp.zeros((16, 4), jnp.float32)
+        assert "input_output_alias" in step.lower(params, state, x, y).compile().as_text()
+        telemetry.enable()
+        params, state, loss = step(params, state, x, y)
+        rep = telemetry.report()
+        assert rep["histograms"]["nn.train_step_dispatch_s"]["count"] == 1
+
+
+class TestProfilerSatellites:
+    def test_provider_prefix_collision_regression(self):
+        """Satellite: a provider key that startswith the provider name must
+        NOT overwrite an identically-named plain counter."""
+        profiler.counter_inc("svc_total", 3)
+
+        key = profiler.register_counter_provider("svc", lambda: {"svc_total": 7})
+        try:
+            c = profiler.counters()
+            assert c["svc_total"] == 3  # the plain counter survives
+            assert c[f"{key}.svc_total"] == 7  # the provider is namespaced
+        finally:
+            profiler._providers.pop(key, None)
+
+    def test_provider_already_dotted_key_not_double_prefixed(self):
+        key = profiler.register_counter_provider("dot", lambda: {"dot.x": 1, "y": 2})
+        try:
+            c = profiler.counters()
+            assert c["dot.x"] == 1 and c["dot.y"] == 2
+            assert "dot.dot.x" not in c
+        finally:
+            profiler._providers.pop(key, None)
+
+    def test_timer_exception_safe(self):
+        """Satellite: a raising block still records its elapsed time."""
+        holder = {}
+        with pytest.raises(RuntimeError):
+            with profiler.timer("t", holder):
+                raise RuntimeError("boom")
+        assert holder["t"] >= 0.0
+
+    def test_timer_normal_path(self):
+        holder = {}
+        with profiler.timer("ok", holder):
+            pass
+        assert holder["ok"] >= 0.0
+
+    def test_provider_weakref_pruned_after_gc(self):
+        """Satellite: a bound-method provider dies with its owner and is
+        dropped at the next counters() read."""
+
+        class Owner:
+            def snapshot(self):
+                return {"alive": 1}
+
+        o = Owner()
+        key = profiler.register_counter_provider("weakowner", o.snapshot)
+        assert profiler.counters()[f"{key}.alive"] == 1
+        assert key in profiler._providers
+        del o
+        gc.collect()
+        c = profiler.counters()
+        assert f"{key}.alive" not in c
+        assert key not in profiler._providers  # pruned, not just skipped
