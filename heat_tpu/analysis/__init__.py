@@ -1,0 +1,48 @@
+"""Static + runtime enforcement of the runtime's distributed invariants.
+
+Two halves, one contract set:
+
+- **heatlint** (:mod:`.framework`, :mod:`.rules`): a plugin-based AST
+  linter (CLI: ``scripts/heatlint.py``) with rules HT101–HT106 encoding
+  the no-host-sync, SPMD-consistency, donation, byte-accounting, broadcast-
+  seeding, and metadata-immutability contracts.  Gates CI against a
+  committed baseline.
+- **runtime sanitizer** (:mod:`heat_tpu.core.sanitation`, armed by
+  ``HEAT_TPU_CHECKS=1``): a metadata-only validator at the dispatch tails
+  and factory/resplit boundaries — the dynamic complement for what the
+  lexical rules cannot see.
+
+See doc/source/design.md "Static contracts".
+"""
+
+from .framework import (
+    Finding,
+    LintContext,
+    Rule,
+    all_rules,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    register,
+    render_json,
+    render_text,
+    split_by_baseline,
+    write_baseline,
+)
+from . import rules  # noqa: F401  — registers the built-in rules on import
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "Rule",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "load_baseline",
+    "register",
+    "render_json",
+    "render_text",
+    "rules",
+    "split_by_baseline",
+    "write_baseline",
+]

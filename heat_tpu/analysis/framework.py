@@ -1,0 +1,411 @@
+"""heatlint — plugin-based AST lint framework for distributed invariants.
+
+The runtime's load-bearing contracts (no host syncs in library code, SPMD-
+consistent control flow, byte-accounted collectives, donate-once buffers,
+broadcast RNG state, immutable DNDarray metadata) are enforced here as
+machine-checked rules instead of conventions.  The design follows the
+MUST/Umpire line of MPI correctness tools and compiler-style lint
+frameworks: each invariant is a :class:`Rule` plugin that walks a parsed
+module and emits :class:`Finding`s; the driver handles discovery, inline
+suppressions, and a committed baseline for grandfathered findings.
+
+Vocabulary:
+
+- **Finding** — one rule violation at one source location, with a stable
+  *fingerprint* (``path:rule:qualname:detail``) that survives unrelated
+  line-number drift.
+- **Suppression** — ``# heatlint: disable=HT101`` trailing comment on the
+  offending line (or ``disable=all``); ``# heatlint: disable-file=HT101``
+  anywhere in a file suppresses the rule for the whole file.
+- **Baseline** — a committed JSON multiset of fingerprints; findings whose
+  fingerprint is covered by the baseline are *grandfathered* (reported,
+  but do not fail the run).  New code must be clean or explicitly
+  suppressed; ``--write-baseline`` regenerates the file.
+
+Rules register themselves with :func:`register`; :mod:`.rules` holds the
+built-in set (HT101–HT106).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "Rule",
+    "register",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "load_baseline",
+    "split_by_baseline",
+    "write_baseline",
+    "render_text",
+    "render_json",
+]
+
+# -------------------------------------------------------------------- #
+# findings
+# -------------------------------------------------------------------- #
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str  # "HT101"
+    path: str  # posix-normalized, as given to the runner
+    line: int
+    col: int
+    message: str
+    qualname: str = "<module>"  # enclosing def/class chain
+    detail: str = ""  # short stable token (offending name), keys the fingerprint
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity used for baseline matching: unrelated
+        edits move lines constantly, but (file, rule, enclosing def,
+        offending token) only changes when the finding itself does."""
+        return f"{self.path}:{self.rule}:{self.qualname}:{self.detail}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "qualname": self.qualname,
+            "detail": self.detail,
+            "fingerprint": self.fingerprint,
+        }
+
+
+# -------------------------------------------------------------------- #
+# per-file context shared by every rule
+# -------------------------------------------------------------------- #
+
+# codes are comma-separated tokens; the capture stops at the first token
+# that isn't followed by a comma, so a trailing free-text reason
+# (`# heatlint: disable=HT101 tolerated here`) doesn't corrupt the codes
+_CODES = r"(?:[A-Za-z0-9_]+\s*,\s*)*[A-Za-z0-9_]+"
+_SUPPRESS_RE = re.compile(rf"#\s*heatlint:\s*disable=({_CODES})")
+_SUPPRESS_FILE_RE = re.compile(rf"#\s*heatlint:\s*disable-file=({_CODES})")
+
+
+class LintContext:
+    """Parsed module + the shared lookups rules need: source lines, parent
+    links, enclosing-scope qualnames, and inline suppressions."""
+
+    def __init__(self, path: str, source: str, tree: Optional[ast.AST] = None):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self._qualnames: Dict[ast.AST, str] = {}
+        self._index(self.tree, None, ())
+        self._line_suppressions: Dict[int, set] = {}
+        self._file_suppressions: set = set()
+        self._scan_suppressions()
+
+    def _index(self, node: ast.AST, parent: Optional[ast.AST], scope: Tuple[str, ...]):
+        if parent is not None:
+            self.parents[node] = parent
+        self._qualnames[node] = ".".join(scope) if scope else "<module>"
+        child_scope = scope
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            child_scope = scope + (node.name,)
+            self._qualnames[node] = ".".join(child_scope)
+        for child in ast.iter_child_nodes(node):
+            self._index(child, node, child_scope)
+
+    def _scan_suppressions(self) -> None:
+        # tokenize so only REAL comments suppress: a docstring that merely
+        # documents the `# heatlint: disable=...` syntax (this framework's
+        # own module docstring, for one) must not disable anything
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [
+                (tok.start[0], tok.string)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT and "heatlint" in tok.string
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = []  # un-tokenizable source: no suppressions
+        for line_no, text in comments:
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self._file_suppressions.update(
+                    c.strip().upper() for c in m.group(1).split(",") if c.strip()
+                )
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self._line_suppressions[line_no] = {
+                    c.strip().upper() for c in m.group(1).split(",") if c.strip()
+                }
+
+    # ---------------- rule-facing helpers ---------------- #
+    def qualname(self, node: ast.AST) -> str:
+        return self._qualnames.get(node, "<module>")
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> List[ast.AST]:
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def enclosing_function(self, node: ast.AST):
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def is_suppressed(self, code: str, line: int) -> bool:
+        code = code.upper()
+        if code in self._file_suppressions or "ALL" in self._file_suppressions:
+            return True
+        on_line = self._line_suppressions.get(line, ())
+        return code in on_line or "ALL" in on_line
+
+    def finding(
+        self, rule: "Rule", node: ast.AST, message: str, detail: str = ""
+    ) -> Optional[Finding]:
+        """Build a Finding for ``node`` unless suppressed on its line."""
+        line = getattr(node, "lineno", 1)
+        if self.is_suppressed(rule.code, line):
+            return None
+        return Finding(
+            rule=rule.code,
+            path=self.path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            qualname=self.qualname(node),
+            detail=detail,
+        )
+
+
+# -------------------------------------------------------------------- #
+# rule plugin protocol + registry
+# -------------------------------------------------------------------- #
+
+
+class Rule:
+    """One invariant.  Subclass, set ``code``/``name``/``description``,
+    implement :meth:`check`, and decorate with :func:`register`."""
+
+    code: str = "HT000"
+    name: str = "unnamed"
+    description: str = ""
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: add a Rule to the global registry (last wins, so a
+    downstream plugin may override a built-in by reusing its code)."""
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate registered rules (ensures built-ins are imported)."""
+    from . import rules as _builtin  # noqa: F401  (import side effect: registration)
+
+    codes = sorted(_REGISTRY)
+    if select:
+        wanted = {c.strip().upper() for c in select}
+        unknown = wanted - set(codes)
+        if unknown:
+            raise ValueError(f"unknown rule code(s): {sorted(unknown)} (have {codes})")
+        codes = [c for c in codes if c in wanted]
+    return [_REGISTRY[c]() for c in codes]
+
+
+# -------------------------------------------------------------------- #
+# driver
+# -------------------------------------------------------------------- #
+
+
+def lint_file(path: str, rules: Sequence[Rule]) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        ctx = LintContext(path, source)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                rule="HT000",
+                path=path.replace(os.sep, "/"),
+                line=exc.lineno or 1,
+                col=exc.offset or 0,
+                message=f"syntax error: {exc.msg}",
+                detail="syntax-error",
+            )
+        ]
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(f for f in rule.check(ctx) if f is not None)
+    return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    # dedup on realpath: overlapping args (`heatlint.py pkg/ pkg/core`, or a
+    # file listed alongside its parent dir) must not lint a file twice —
+    # duplicate findings would overflow the baseline's per-fingerprint count
+    # and report clean code as new
+    seen: set = set()
+    out: List[str] = []
+
+    def add(path: str) -> None:
+        rp = os.path.realpath(path)
+        if rp not in seen:
+            seen.add(rp)
+            out.append(path)
+
+    for p in paths:
+        if os.path.isfile(p):
+            add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", ".git", ".ipynb_checkpoints")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    add(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_paths(
+    paths: Sequence[str], select: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    rules = all_rules(select)
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# -------------------------------------------------------------------- #
+# baseline
+# -------------------------------------------------------------------- #
+
+
+def load_baseline_records(path: str) -> List[dict]:
+    """The baseline's raw finding records ([] when absent)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("findings", []))
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Baseline as a fingerprint → count multiset ({} when absent)."""
+    counts: Dict[str, int] = {}
+    for rec in load_baseline_records(path):
+        fp = rec["fingerprint"]
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def split_by_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[Finding]]:
+    """(new, grandfathered): each baseline fingerprint absorbs up to its
+    count of matching findings; the overflow is new."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "version": 1,
+        "comment": (
+            "heatlint grandfathered findings. Matching is by fingerprint "
+            "(path:rule:qualname:detail), not line number. Regenerate with "
+            "scripts/heatlint.py --write-baseline after intentional changes; "
+            "shrinking this file is always welcome, growing it needs review."
+        ),
+        "findings": [
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "path": f.path,
+                "qualname": f.qualname,
+                "detail": f.detail,
+                "line": f.line,  # informational only — not used for matching
+                "message": f.message,
+            }
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+# -------------------------------------------------------------------- #
+# output
+# -------------------------------------------------------------------- #
+
+
+def render_text(
+    new: Sequence[Finding], grandfathered: Sequence[Finding], verbose_baselined: bool = False
+) -> str:
+    lines = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message} [in {f.qualname}]")
+    if verbose_baselined:
+        for f in grandfathered:
+            lines.append(
+                f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message} [in {f.qualname}] (baselined)"
+            )
+    lines.append(
+        f"heatlint: {len(new) + len(grandfathered)} finding(s) "
+        f"({len(new)} new, {len(grandfathered)} baselined)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(new: Sequence[Finding], grandfathered: Sequence[Finding]) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in grandfathered],
+            "counts": {"new": len(new), "baselined": len(grandfathered)},
+        },
+        indent=2,
+    )

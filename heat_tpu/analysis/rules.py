@@ -1,0 +1,643 @@
+"""Built-in heatlint rules HT101–HT106: the runtime's distributed invariants.
+
+Each rule encodes one contract established by earlier rounds of perf,
+robustness, and telemetry work (see doc/source/design.md "Static
+contracts" for the full table):
+
+- HT101 — no host syncs in library code (the sanitation.py contract)
+- HT102 — no collective lexically inside a rank-conditional branch
+- HT103 — no use of a name after its buffer was donated
+- HT104 — every public collective in communication.py byte-accounts
+- HT105 — no raw process entropy; seeding goes through ht.random
+- HT106 — no DNDarray metadata mutation outside sanctioned modules
+
+All analyses are intentionally *lexical and intra-procedural*: false
+negatives across call boundaries are accepted; false positives are kept
+low enough that the committed baseline stays short and new code rarely
+needs a suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, LintContext, Rule, register
+
+# -------------------------------------------------------------------- #
+# shared AST helpers
+# -------------------------------------------------------------------- #
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'np.random.seed' for Attribute/Name chains, None for anything else."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def last_attr(call: ast.Call) -> Optional[str]:
+    """Final attribute of a call target: 'item' for ``x.y.item()``."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+# calls that END a device-value expression: their result is host data, so a
+# float()/int()/np.asarray around them is not an additional sync
+_MATERIALIZERS = {"host_fetch", "numpy", "tolist", "item"}
+
+
+def subtree_mentions_device_value(node: ast.AST) -> bool:
+    """Heuristic for 'this expression is a device value': it touches the raw
+    jax array plumbing (``._jarray``/``._parray``/``.larray``) or directly
+    calls into jnp/lax/jax.numpy — UNLESS the expression already routes
+    through a sanctioned materialization call (``host_fetch``/``numpy()``),
+    in which case the value is host-side by the time it is consumed."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and last_attr(sub) in _MATERIALIZERS:
+            return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+            "_jarray",
+            "_parray",
+            "larray",
+        ):
+            return True
+        if isinstance(sub, ast.Call):
+            dn = call_name(sub)
+            if dn and (
+                dn.startswith("jnp.") or dn.startswith("lax.") or dn.startswith("jax.numpy.")
+            ):
+                return True
+    return False
+
+
+def module_matches(path: str, suffixes: Tuple[str, ...]) -> bool:
+    return any(path.endswith(s) for s in suffixes)
+
+
+def branch_exclusive(ctx: LintContext, a: ast.AST, b: ast.AST) -> bool:
+    """True when ``a`` and ``b`` sit in mutually exclusive branches of the
+    same ``if``/``try`` — sequential-order reasoning between them is invalid
+    (used by HT103 to avoid flagging the untaken arm)."""
+    chain_a = [a] + ctx.ancestors(a)
+    chain_b = [b] + ctx.ancestors(b)
+    set_b = set(map(id, chain_b))
+    lca = next((n for n in chain_a if id(n) in set_b), None)
+    if lca is None or not isinstance(lca, (ast.If, ast.Try)):
+        return False
+
+    def arm_of(node: ast.AST) -> Optional[str]:
+        # which field of the lca contains this node's ancestor chain
+        chain = [node] + ctx.ancestors(node)
+        idx = [id(n) for n in chain].index(id(lca))
+        if idx == 0:
+            return None  # node IS the lca (e.g. the if test)
+        child = chain[idx - 1]
+        for fieldname in ("body", "orelse", "handlers", "finalbody"):
+            if child in getattr(lca, fieldname, []):
+                return fieldname
+        return None
+
+    fa, fb = arm_of(a), arm_of(b)
+    if fa is None or fb is None:
+        return False
+    if isinstance(lca, ast.Try):
+        # body vs handlers is exclusive-ish; finalbody always runs
+        return fa != fb and "finalbody" not in (fa, fb)
+    return fa != fb
+
+
+# -------------------------------------------------------------------- #
+# HT101 — host sync in library code
+# -------------------------------------------------------------------- #
+
+
+@register
+class HostSyncRule(Rule):
+    """Blocking device→host reads outside sanctioned materialization points.
+
+    Library code runs in the middle of async dispatch pipelines: a
+    ``.item()``, ``jax.device_get``, or ``np.asarray``/``float()``/``int()``
+    of a device value stalls the host on the device stream (the
+    ``sanitation.py`` no-value-reads contract).  Value materialization
+    belongs behind the explicit points: ``numpy()``, ``item()``,
+    ``Communication.host_fetch``, printing, and I/O.
+    """
+
+    code = "HT101"
+    name = "host-sync-in-library"
+    description = "blocking device→host read outside sanctioned materialization points"
+
+    # modules whose JOB is materialization (printing, I/O)
+    SANCTIONED_MODULES = (
+        "core/printing.py",
+        "core/io.py",
+    )
+    # the materialization API itself + host-boundary helpers
+    SANCTIONED_DEFS = {
+        "numpy",
+        "item",
+        "tolist",
+        "host_fetch",
+        "__array__",
+        "__bool__",
+        "__int__",
+        "__float__",
+        "__complex__",
+        "__index__",
+        "__torch_proxy__",
+        "__repr__",
+        "__str__",
+    }
+
+    def _sanctioned(self, ctx: LintContext, node: ast.AST) -> bool:
+        fn = ctx.enclosing_function(node)
+        while fn is not None:
+            if fn.name in self.SANCTIONED_DEFS:
+                return True
+            fn = ctx.enclosing_function(ctx.parent(fn)) if ctx.parent(fn) else None
+        return False
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._sanctioned(ctx, node):
+                continue
+            la = last_attr(node)
+            dn = call_name(node)
+            if la == "item" and isinstance(node.func, ast.Attribute) and not node.args:
+                out.append(
+                    ctx.finding(
+                        self, node,
+                        "`.item()` is a blocking device→host sync; route through a "
+                        "sanctioned materialization point (numpy()/host_fetch) or keep "
+                        "the value on device",
+                        detail="item",
+                    )
+                )
+            elif dn in ("jax.device_get",):
+                out.append(
+                    ctx.finding(
+                        self, node,
+                        "`jax.device_get` in library code is a blocking host sync; use "
+                        "Communication.host_fetch at an explicit materialization point",
+                        detail="device_get",
+                    )
+                )
+            elif dn in ("np.asarray", "numpy.asarray", "np.array", "numpy.array") and node.args:
+                if subtree_mentions_device_value(node.args[0]):
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"`{dn}` of a device value blocks on device→host transfer; "
+                            "materialize via numpy()/host_fetch instead",
+                            detail="np.asarray",
+                        )
+                    )
+            elif dn in ("float", "int", "bool") and len(node.args) == 1:
+                if subtree_mentions_device_value(node.args[0]):
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"`{dn}()` of a device value is an implicit `.item()` host "
+                            "sync; keep the value on device or materialize explicitly",
+                            detail=f"{dn}-cast",
+                        )
+                    )
+        return [f for f in out if f is not None]
+
+
+# -------------------------------------------------------------------- #
+# HT102 — collective inside a rank-conditional branch
+# -------------------------------------------------------------------- #
+
+
+@register
+class RankConditionalCollectiveRule(Rule):
+    """A collective call lexically inside an ``if``/``while`` that branches on
+    process/shard identity diverges the SPMD program: ranks that skip the
+    branch never post the collective and the others deadlock (the round-5
+    rank-conditional hazard class).  Rank-conditional *local* work (logging,
+    file writes) is fine — only collective entry points are flagged."""
+
+    code = "HT102"
+    name = "rank-conditional-collective"
+    description = "collective call inside a rank-conditional branch (SPMD divergence)"
+
+    COLLECTIVES: Set[str] = {
+        # Communication public API (MPI names)
+        "Allreduce", "Allgather", "Alltoall", "Bcast", "Send", "Reduce",
+        "Scatter", "Gather", "ReduceScatter", "Scan", "Exscan",
+        "Iallreduce", "Iallgather", "Ialltoall", "Ibcast", "Isend", "Irecv",
+        "Barrier", "resplit", "resplit_", "redistribute_",
+        # collective-by-contract host boundary (every process must call)
+        "host_fetch", "numpy", "process_allgather", "sync_global_devices",
+        # raw lax collectives
+        "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+        "ppermute", "psum_scatter", "pbroadcast",
+    }
+    # rank-identity markers, by syntactic shape (each tuple drives
+    # _rank_conditional — extend HERE to widen detection)
+    RANK_ATTRS = ("rank",)  # comm.rank, self.rank, ...
+    RANK_CALLS = ("process_index", "axis_index")  # jax.process_index(), ...
+    RANK_NAMES = ("rank", "process_id", "pid")  # bare local variables
+
+    def _rank_conditional(self, test: ast.AST) -> Optional[str]:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr in self.RANK_ATTRS:
+                return dotted_name(sub) or sub.attr
+            if isinstance(sub, ast.Call):
+                la = last_attr(sub)
+                if la in self.RANK_CALLS:
+                    return la
+            if isinstance(sub, ast.Name) and sub.id in self.RANK_NAMES:
+                return sub.id
+        return None
+
+    def _arm_collectives(self, arm) -> dict:
+        """collective name → [call nodes] for one branch arm."""
+        found: dict = {}
+        for stmt in arm:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    la = last_attr(sub)
+                    if la in self.COLLECTIVES:
+                        found.setdefault(la, []).append(sub)
+        return found
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            marker = self._rank_conditional(node.test)
+            if marker is None:
+                continue
+            body = self._arm_collectives(node.body)
+            orelse = self._arm_collectives(node.orelse if isinstance(node, ast.If) else [])
+            for arm, other in ((body, orelse), (orelse, body)):
+                for la, calls in arm.items():
+                    if la in other:
+                        # posted in BOTH arms: every rank attends whichever
+                        # branch it takes — the sanctioned "collective fetch,
+                        # rank-conditional use" idiom (e.g. save_zarr)
+                        continue
+                    for sub in calls:
+                        out.append(
+                            ctx.finding(
+                                self, sub,
+                                f"collective `{la}` inside a branch conditioned "
+                                f"on `{marker}`: ranks that skip the branch never "
+                                "post it (SPMD divergence/deadlock hazard)",
+                                detail=la,
+                            )
+                        )
+        return [f for f in out if f is not None]
+
+
+# -------------------------------------------------------------------- #
+# HT103 — use after donate
+# -------------------------------------------------------------------- #
+
+
+@register
+class UseAfterDonateRule(Rule):
+    """A name whose buffer was donated (``donate=True`` kwarg, or passed in a
+    ``donate_argnums`` position of a locally-jitted function) must not be
+    read afterwards: XLA may have aliased or freed the storage, and the read
+    returns garbage or raises only under certain layouts.  Rebinding the
+    name clears the taint; uses in a mutually exclusive branch don't count."""
+
+    code = "HT103"
+    name = "use-after-donate"
+    description = "name referenced after its buffer was donated"
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(ctx, node))
+        return out
+
+    def _jit_donated_positions(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+        """(positions) when ``call`` is jax.jit/jit with literal donate_argnums."""
+        dn = call_name(call)
+        if dn not in ("jax.jit", "jit"):
+            return None
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Tuple):
+                    pos = tuple(
+                        e.value for e in v.elts if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                    )
+                    return pos
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                return ()  # dynamic donate_argnums: positions unknown, skip
+        return None
+
+    def _check_function(self, ctx: LintContext, fn: ast.AST) -> Iterable[Finding]:
+        # jitted-callable names -> donated positions, discovered on the fly
+        jitted: dict = {}
+        # donation events: (sort key, donated name, donation call node)
+        events: List[Tuple[Tuple[int, int], str, ast.Call]] = []
+
+        own = [
+            n
+            for n in ast.walk(fn)
+            if ctx.enclosing_function(n) is fn or n is fn
+        ]
+        for node in own:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                pos = self._jit_donated_positions(node.value)
+                if pos:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jitted[tgt.id] = pos
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            donated_names: List[str] = []
+            for kw in node.keywords:
+                if kw.arg == "donate" and isinstance(kw.value, ast.Constant) and kw.value.value is True:
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        donated_names.append(node.args[0].id)
+            fname = call_name(node)
+            if fname in jitted:
+                for p in jitted[fname]:
+                    if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                        donated_names.append(node.args[p].id)
+            for name in donated_names:
+                key = (node.end_lineno or node.lineno, node.end_col_offset or 0)
+                events.append((key, name, node))
+
+        if not events:
+            return []
+
+        findings: List[Finding] = []
+        for key, name, call in events:
+            rebound_at: Optional[Tuple[int, int]] = None
+            # the donating statement may itself rebind the name
+            # (x = f(x, donate=True)) — taint never takes effect
+            stmt = call
+            for anc in [call] + ctx.ancestors(call):
+                if isinstance(anc, ast.stmt):
+                    stmt = anc
+                    break
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+            ):
+                continue
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                # `return f(x, donate=True)` — control leaves the function at
+                # the donation itself; no later read in this frame can see
+                # the donated buffer
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and node.id == name
+                    and isinstance(node.ctx, ast.Store)
+                ):
+                    at = (node.lineno, node.col_offset)
+                    if at > key and (rebound_at is None or at < rebound_at):
+                        rebound_at = at
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Name)
+                    and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    continue
+                at = (node.lineno, node.col_offset)
+                if at <= key:
+                    continue
+                if rebound_at is not None and at > rebound_at:
+                    continue
+                if branch_exclusive(ctx, call, node):
+                    continue
+                f = ctx.finding(
+                    self, node,
+                    f"`{name}` is read after its buffer was donated at line "
+                    f"{call.lineno}; the storage may be aliased or freed",
+                    detail=name,
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings
+
+
+# -------------------------------------------------------------------- #
+# HT104 — unaccounted public collective in communication.py
+# -------------------------------------------------------------------- #
+
+
+@register
+class CollectiveAccountingRule(Rule):
+    """Every public collective in ``communication.py`` must byte-account at
+    its entry (``self._account(...)``) or delegate to another public
+    collective that does — the telemetry round's invariant that no staged
+    collective traffic is invisible to ``comm.<name>.calls/.bytes``."""
+
+    code = "HT104"
+    name = "unaccounted-collective"
+    description = "public collective without comm.<name> byte accounting"
+
+    TARGET_SUFFIX = ("communication.py",)
+    # public-but-not-traffic: Wait is a completion fence, Barrier moves one
+    # scalar token (accounting it would pollute the traffic metric)
+    EXEMPT = {"Wait", "Barrier"}
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if not module_matches(ctx.path, self.TARGET_SUFFIX):
+            return []
+        out = []
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                is_mpi_name = fn.name[:1].isupper()
+                if not (is_mpi_name or fn.name == "resplit"):
+                    continue
+                if fn.name in self.EXEMPT:
+                    continue
+                accounted = False
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        dn = call_name(node)
+                        if dn == "self._account":
+                            accounted = True
+                            break
+                        la = last_attr(node)
+                        if (
+                            dn
+                            and dn.startswith("self.")
+                            and la
+                            and la[:1].isupper()
+                            and la != fn.name
+                            and la not in self.EXEMPT
+                        ):
+                            accounted = True  # derived: accounts under its primitive
+                            break
+                if not accounted:
+                    f = ctx.finding(
+                        self, fn,
+                        f"public collective `{fn.name}` never calls self._account(...) "
+                        "nor delegates to an accounted collective — its traffic is "
+                        "invisible to comm.<name>.calls/.bytes",
+                        detail=fn.name,
+                    )
+                    if f is not None:
+                        out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT105 — raw process entropy
+# -------------------------------------------------------------------- #
+
+
+@register
+class RawEntropyRule(Rule):
+    """Randomness in library code must flow through the broadcast
+    ``ht.random`` state (Threefry key from the global seed/counter): raw
+    ``np.random``/stdlib ``random``/``os.urandom`` draws are per-process
+    entropy, so under multi-process SPMD each rank generates DIFFERENT
+    values from nominally identical code — the round-5 per-rank-seed
+    divergence class."""
+
+    code = "HT105"
+    name = "raw-process-entropy"
+    description = "raw np.random/process-entropy use instead of broadcast ht.random state"
+
+    # the module that IMPLEMENTS the broadcast state is the one sanctioned
+    # consumer of raw entropy
+    SANCTIONED_MODULES = ("core/random.py",)
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        imports_stdlib_random = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                if any(a.name == "random" for a in node.names):
+                    imports_stdlib_random = True
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "random":
+                    imports_stdlib_random = True
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = call_name(node)
+            if dn is None:
+                continue
+            bad = None
+            if dn.startswith("np.random.") or dn.startswith("numpy.random."):
+                bad = dn
+            elif imports_stdlib_random and dn.startswith("random."):
+                bad = dn
+            elif dn in ("os.urandom", "uuid.uuid4", "secrets.token_bytes"):
+                bad = dn
+            if bad is not None:
+                f = ctx.finding(
+                    self, node,
+                    f"`{bad}` draws per-process entropy — under multi-process SPMD "
+                    "each rank diverges; use the broadcast ht.random state "
+                    "(ht.random.seed/rand/randn) instead",
+                    detail=bad,
+                )
+                if f is not None:
+                    out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT106 — DNDarray metadata mutation outside sanctioned modules
+# -------------------------------------------------------------------- #
+
+
+@register
+class MetadataMutationRule(Rule):
+    """``DNDarray``'s split/gshape/pad/array metadata is maintained by the
+    class itself (constructor, ``_from_parts``, ``_renormalize``): writing
+    the name-mangled privates from outside desynchronizes the logical
+    metadata from the physical sharding — `split` starts lying.  Mutation
+    goes through the public surface (``resplit_``, ``larray``/``_jarray``
+    setters) instead."""
+
+    code = "HT106"
+    name = "metadata-mutation"
+    description = "direct mutation of DNDarray metadata outside sanctioned modules"
+
+    SANCTIONED_MODULES = ("core/dndarray.py",)
+    # explicitly-mangled writes reach DNDarray's privates from anywhere
+    MANGLED_ATTRS = {
+        "_DNDarray__split", "_DNDarray__gshape", "_DNDarray__lshape",
+        "_DNDarray__pad", "_DNDarray__array", "_DNDarray__dtype",
+        "_DNDarray__unpadded",
+    }
+    # unmangled double-underscore writes only hit (or shadow) DNDarray
+    # metadata OUTSIDE a class body — inside one, Python mangles them to the
+    # ENCLOSING class's private (e.g. DCSR_matrix's own __gshape), which is
+    # that class's business, not ours
+    UNMANGLED_ATTRS = {
+        "__split", "__gshape", "__lshape", "__pad", "__array", "__dtype", "__unpadded",
+    }
+
+    def _in_class_body(self, ctx: LintContext, node: ast.AST) -> bool:
+        return any(isinstance(a, ast.ClassDef) for a in ctx.ancestors(node))
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if not isinstance(sub, ast.Attribute):
+                        continue
+                    hits = sub.attr in self.MANGLED_ATTRS or (
+                        sub.attr in self.UNMANGLED_ATTRS
+                        and not self._in_class_body(ctx, sub)
+                    )
+                    if not hits:
+                        continue
+                    f = ctx.finding(
+                        self, node,
+                        f"direct write to DNDarray metadata `{sub.attr}` outside "
+                        "core/dndarray.py desynchronizes split/gshape from the "
+                        "physical sharding; use resplit_/the _jarray setter",
+                        detail=sub.attr,
+                    )
+                    if f is not None:
+                        out.append(f)
+        return out

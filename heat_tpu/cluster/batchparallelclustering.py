@@ -8,7 +8,7 @@ the sample axis with a jitted local Lloyd loop.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -30,7 +30,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import _cache, _complexsafe, sanitation, types
-from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
 
@@ -46,6 +45,14 @@ _DONATE_T1 = contextvars.ContextVar("heat_tpu_donate_t1", default=False)
 # every dispatch tail is ONE module-global load — no import, no call, no
 # flag indirection (the telemetry-off overhead contract, ISSUE 3)
 _TELEMETRY = None
+
+# runtime sanitizer hot-path hook (HEAT_TPU_CHECKS=1): ``core.sanitation.
+# enable_checks()`` sets this to the metadata-only validator and
+# ``disable_checks()`` clears it — same one-global-load disabled cost as
+# the telemetry hook.  When armed, every dispatch tail re-validates the
+# invariants the zero-copy fast paths assume (``DNDarray._from_parts``
+# skips ``__init__``'s enforcement).
+_CHECKS = None
 
 
 def _run_prog(tel, name: str, op, prog, args, cache_hit: bool):
@@ -172,7 +179,7 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
         # the result stays fully sharded with no unpad gather
         phys = op(x._parray, **kwargs)
         if phys.shape == x._parray.shape:
-            return DNDarray(
+            ret = DNDarray(
                 phys,
                 x.shape,
                 types.canonical_heat_type(phys.dtype),
@@ -181,6 +188,7 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
                 x.comm,
                 x.balanced,
             )
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.local.pad")
     comm = x.comm
     j = x._jarray
     if (
@@ -204,14 +212,15 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
                 if tel is None
                 else _run_prog(tel, "dispatch.local", op, prog, (j,), _cache._STATS["misses"] == m0)
             )
-            return DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, comm)
+            ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, comm)
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.local")
     result = op(j, **kwargs)
     result = comm.shard(result, x.split if x.split is not None and x.split < result.ndim else None)
     if out is not None:
         sanitation.sanitize_out(out, result.shape, x.split, x.device)
         out._jarray = result.astype(out.dtype.jax_dtype())
-        return out
-    return DNDarray(
+        return out if _CHECKS is None else _CHECKS(out, "dispatch.local.out")
+    ret = DNDarray(
         result,
         tuple(result.shape),
         types.canonical_heat_type(result.dtype),
@@ -220,6 +229,7 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
         x.comm,
         x.balanced,
     )
+    return ret if _CHECKS is None else _CHECKS(ret, "dispatch.local.general")
 
 
 def _compile_tail(comm, compute, j, want_split):
@@ -308,9 +318,10 @@ def _binary_op(
                             _cache._STATS["misses"] == m0,
                         )
                     )
-                    return DNDarray._from_parts(
+                    ret = DNDarray._from_parts(
                         res, rshape, rdtype, rsplit, proto.device, comm
                     )
+                    return ret if _CHECKS is None else _CHECKS(ret, "dispatch.binary")
 
     fn_kwargs = fn_kwargs or {}
     if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
@@ -373,7 +384,7 @@ def _binary_op(
             pj2 = a2._parray if d2 else a2
             pj1, pj2 = _complexsafe.colocate(pj1, pj2) if (d1 and d2) else (pj1, pj2)
             phys = op(pj1, pj2, **fn_kwargs)
-            return DNDarray(
+            ret = DNDarray(
                 phys,
                 out_shape,
                 types.canonical_heat_type(phys.dtype),
@@ -382,6 +393,7 @@ def _binary_op(
                 comm,
                 True,
             )
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.binary.pad")
 
     j1 = a1._jarray if isinstance(a1, DNDarray) else a1
     j2 = a2._jarray if isinstance(a2, DNDarray) else a2
@@ -400,12 +412,12 @@ def _binary_op(
             result = comm.shard(result, res_split)
         sanitation.sanitize_out(out, result.shape, res_split, device)
         out._jarray = result.astype(out.dtype.jax_dtype())
-        return out
+        return out if _CHECKS is None else _CHECKS(out, "dispatch.binary.out")
     if where is not None:
         w = where._jarray if isinstance(where, DNDarray) else jnp.asarray(where)
         w, result = _complexsafe.colocate(w, result)
         result = comm.shard(jnp.where(w, result, jnp.zeros_like(result)), res_split)
-    return DNDarray(
+    ret = DNDarray(
         result,
         tuple(result.shape),
         types.canonical_heat_type(result.dtype),
@@ -414,6 +426,7 @@ def _binary_op(
         comm,
         True,
     )
+    return ret if _CHECKS is None else _CHECKS(ret, "dispatch.binary.general")
 
 
 # negative-cache sentinel: this signature must take the general path
@@ -548,17 +561,19 @@ def _reduce_op(
             if reduces_split:
                 # pad axis reduced away under identity masking: result logical
                 phys = x.comm.shard(phys, None)
-                return DNDarray(
+                ret = DNDarray(
                     phys, tuple(phys.shape), types.canonical_heat_type(phys.dtype),
                     None, x.device, x.comm, True,
                 )
+                return ret if _CHECKS is None else _CHECKS(ret, "dispatch.reduce.pad")
             # split axis survives (still padded in phys): logical gshape shrinks
             gshape = list(phys.shape)
             gshape[new_split] -= x._pad
-            return DNDarray(
+            ret = DNDarray(
                 phys, tuple(gshape), types.canonical_heat_type(phys.dtype),
                 new_split, x.device, x.comm, True,
             )
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.reduce.pad-split")
 
     j = x._jarray
     axkey = axis if axis is None or isinstance(axis, int) else tuple(axis)
@@ -584,7 +599,8 @@ def _reduce_op(
                 if tel is None
                 else _run_prog(tel, "dispatch.reduce", op, prog, (j,), _cache._STATS["misses"] == m0)
             )
-            return DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
+            ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.reduce")
     result = op(j, axis=axis, keepdims=keepdims, **kwargs)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_dtype())
@@ -594,8 +610,8 @@ def _reduce_op(
     if out is not None:
         sanitation.sanitize_out(out, result.shape, new_split, x.device)
         out._jarray = result.astype(out.dtype.jax_dtype())
-        return out
-    return DNDarray(
+        return out if _CHECKS is None else _CHECKS(out, "dispatch.reduce.out")
+    ret = DNDarray(
         result,
         tuple(result.shape),
         types.canonical_heat_type(result.dtype),
@@ -604,6 +620,7 @@ def _reduce_op(
         x.comm,
         True,
     )
+    return ret if _CHECKS is None else _CHECKS(ret, "dispatch.reduce.general")
 
 
 def _build_reduce(comm, op, j, axis, keepdims, dtype, new_split, kwargs):
@@ -635,10 +652,11 @@ def _cum_op(
             phys = op(src, axis=axis)
             if dtype is not None:
                 phys = phys.astype(types.canonical_heat_type(dtype).jax_dtype())
-            return DNDarray(
+            ret = DNDarray(
                 phys, x.shape, types.canonical_heat_type(phys.dtype),
                 x.split, x.device, x.comm, True,
             )
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.cum.pad")
     j = x._jarray
     split = None if axis is None else x.split
     if out is None and not x._pad and _stable_op(op) and _cacheable(j):
@@ -657,7 +675,8 @@ def _cum_op(
                 if tel is None
                 else _run_prog(tel, "dispatch.cum", op, prog, (j,), _cache._STATS["misses"] == m0)
             )
-            return DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
+            ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
+            return ret if _CHECKS is None else _CHECKS(ret, "dispatch.cum")
     if axis is None:
         # numpy semantics: flatten
         flat = j.reshape(-1)
@@ -670,8 +689,8 @@ def _cum_op(
     if out is not None:
         sanitation.sanitize_out(out, result.shape, split, x.device)
         out._jarray = result.astype(out.dtype.jax_dtype())
-        return out
-    return DNDarray(
+        return out if _CHECKS is None else _CHECKS(out, "dispatch.cum.out")
+    ret = DNDarray(
         result,
         tuple(result.shape),
         types.canonical_heat_type(result.dtype),
@@ -680,6 +699,7 @@ def _cum_op(
         x.comm,
         True,
     )
+    return ret if _CHECKS is None else _CHECKS(ret, "dispatch.cum.general")
 
 
 def _build_cum(comm, op, j, axis, dtype, split):
@@ -701,3 +721,10 @@ _t = _sys.modules.get("heat_tpu.utils.telemetry")
 if _t is not None and _t._ENABLED:
     _TELEMETRY = _t
 del _sys, _t
+
+# same race for the sanitizer: HEAT_TPU_CHECKS=1 arms at core.sanitation
+# import time, which runs DURING this module's import (sanitation is imported
+# above) — its poke hit the half-initialized module and the `_CHECKS = None`
+# line then clobbered it, so re-read the flag now that the body is done
+if sanitation.checks_enabled():
+    _CHECKS = sanitation.validate_dispatch

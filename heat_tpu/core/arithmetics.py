@@ -6,7 +6,6 @@ inserts collectives where splits demand (SURVEY §2.2).
 
 from __future__ import annotations
 
-from typing import Optional, Union
 
 import jax.numpy as jnp
 import numpy as np

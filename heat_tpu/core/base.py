@@ -8,8 +8,7 @@ written purely in terms of the public array API, so they run identically on
 from __future__ import annotations
 
 import inspect
-import json
-from typing import Dict, List, Optional, Union
+from typing import Dict, List
 
 __all__ = [
     "BaseEstimator",

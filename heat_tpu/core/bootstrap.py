@@ -137,7 +137,6 @@ def init_distributed(
                     jax.distributed.initialize(**kw)
 
             _retrying_initialize(_initialize, kwargs, retries=connect_retries)
-    from . import devices
     from .devices import make_mesh, use_mesh
 
     if mesh_shape is not None:

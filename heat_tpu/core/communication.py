@@ -30,9 +30,8 @@ associative_scan over shards``.
 
 from __future__ import annotations
 
-import math
 import warnings
-from typing import Optional, Tuple, Union
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +53,17 @@ __all__ = [
 # every call below is at collective STAGING time or inside resplit — never
 # the per-op dispatch hot path
 _TELEMETRY_MOD = None
+
+# runtime sanitizer hook (HEAT_TPU_CHECKS=1): ``core.sanitation.
+# enable_checks()`` points this at ``sanitation.check_placement`` so every
+# eager resplit verifies the produced array actually carries the canonical
+# sharding of its target split (metadata-only: sharding objects, no value
+# reads).  Disabled cost: one module-global load per resplit.  This module
+# currently loads before sanitation (sanitation → dndarray → here), so the
+# env-arming poke lands after this line runs — but that ordering is
+# transitive and fragile, so the module bottom re-arms defensively like
+# ``_operations`` does.
+_RESPLIT_CHECK = None
 
 
 def _telemetry():
@@ -426,10 +436,14 @@ class Communication:
                 # returned for every case a donatable array could hit
                 sh = self.sharding(array.ndim, split)
                 try:
-                    return jax.device_put(array, sh, donate=True)
+                    out = jax.device_put(array, sh, donate=True)
                 except TypeError:  # jax without the donate kwarg
-                    return jax.device_put(array, sh)
-            return self.shard(array, split)
+                    out = jax.device_put(array, sh)
+            else:
+                out = self.shard(array, split)
+            if _RESPLIT_CHECK is not None:
+                _RESPLIT_CHECK(out, self, split, where="comm.resplit")
+            return out
 
     def _already_placed(self, array, split: Optional[int]) -> bool:
         """True when ``array`` is concrete and already carries exactly the
@@ -748,3 +762,20 @@ def __getattr__(name):
 
         return Communication(Mesh(np.asarray(jax.devices()[:1]), ("x",)), "x")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# the sanitizer may have been armed while this module was still importing
+# (if any import path ever makes sanitation load first, its poke would hit
+# the half-initialized module and the `_RESPLIT_CHECK = None` line above
+# would clobber it) — re-read the flag now that the body is done, same
+# defensive pattern as core._operations
+import sys as _sys  # noqa: E402
+
+# getattr default: in the hypothetical sanitation-loads-first ordering,
+# sanitation would be MID-import here (this import triggered by its own
+# top-of-module imports) and checks_enabled not yet defined — treat that as
+# "not armed"; sanitation's own env-arming poke runs once it finishes
+_san = _sys.modules.get("heat_tpu.core.sanitation")
+if _san is not None and getattr(_san, "checks_enabled", lambda: False)():
+    _RESPLIT_CHECK = _san.check_placement
+del _sys, _san

@@ -22,7 +22,6 @@ cannot offer.
 
 from __future__ import annotations
 
-import warnings
 from typing import List, Optional, Tuple, Union
 
 import jax
@@ -506,7 +505,9 @@ class DNDarray:
             self._renormalize(logical)
             if self.__pad == 0:
                 self.__array = self.__comm.resplit(self.__array, axis, donate=True)
-        return self
+        from . import sanitation  # lazy: sanitation imports this module
+
+        return sanitation.check(self, "resplit_")
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """Redistribute to a target chunk map (reference

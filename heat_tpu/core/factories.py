@@ -10,14 +10,14 @@ degenerates to ``split=``.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence, Type, Union
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import _complexsafe, devices, types
-from .communication import Communication, sanitize_comm
+from . import _complexsafe, devices, sanitation, types
+from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 
@@ -56,10 +56,17 @@ def _finalize(
         dtype = types.canonical_heat_type(dtype)
         if jarr.dtype != dtype.jax_dtype():
             jarr = jarr.astype(dtype.jax_dtype())
-    else:
-        dtype = types.canonical_heat_type(jarr.dtype)
+    # derive the metadata dtype from the array the cast actually produced,
+    # honoring JAX canonicalization (64→32-bit when x64 is off) like
+    # DNDarray.astype does — a requested float64 with x64 off used to leave
+    # float64 METADATA on a float32 buffer (runtime sanitizer's first catch)
+    dtype = types.canonical_heat_type(jarr.dtype)
     jarr = comm.shard(jarr, split)
-    return DNDarray(jarr, tuple(jarr.shape), dtype, split, device, comm, True)
+    # factory boundary of the runtime sanitizer (HEAT_TPU_CHECKS=1):
+    # no-op unless armed, metadata-only when armed
+    return sanitation.check(
+        DNDarray(jarr, tuple(jarr.shape), dtype, split, device, comm, True), "factory"
+    )
 
 
 def array(

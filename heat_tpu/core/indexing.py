@@ -67,7 +67,6 @@ def where(cond, x=None, y=None) -> DNDarray:
         return nonzero(cond)
     if x is None or y is None:
         raise TypeError("either both or neither of x and y should be given")
-    from ._operations import _binary_op
 
     jx = x._jarray if isinstance(x, DNDarray) else x
     jy = y._jarray if isinstance(y, DNDarray) else y

@@ -10,13 +10,12 @@ subsystem).
 
 from __future__ import annotations
 
-import csv as _csv
 import io as _pyio
 import json
 import os
 import warnings
 import zlib
-from typing import Dict, List, Optional, Tuple, Union
+from typing import List, Optional
 
 import numpy as np
 
@@ -1039,9 +1038,11 @@ def save_checkpoint(tree, path: str) -> None:
     """Save a pytree of arrays (params/opt state) to an .npz + structure json.
 
     The write is ATOMIC: the archive is serialized to memory, written to a
-    ``<path>.tmp`` sibling, fsynced, and renamed over the destination (then
-    the directory is fsynced) — a crash mid-save can never destroy an
-    existing checkpoint, which the previous in-place ``np.savez`` could.
+    ``<path>.tmp.<pid>`` sibling (per-process unique, so concurrent SPMD
+    ranks saving the same path don't rename each other's tmp away), fsynced,
+    and renamed over the destination (then the directory is fsynced) — a
+    crash mid-save can never destroy an existing checkpoint, which the
+    previous in-place ``np.savez`` could.
     Transient write faults are retried with backoff (``retry.io.write``).
     """
     import jax
@@ -1057,7 +1058,12 @@ def save_checkpoint(tree, path: str) -> None:
     for i, ((p, _), host) in enumerate(zip(flat, leaves)):
         keys.append(jax.tree_util.keystr(p))
         arrays[f"leaf_{i}"] = np.asarray(host)
-    tmp = final + ".tmp"
+    # per-process tmp name: in the multi-process SPMD lane every rank runs
+    # this save against the SAME shared path — a shared tmp would let rank
+    # 0's os.replace rename the file out from under rank 1's (found by the
+    # -m mp lane).  Each rank streams its own tmp and the atomic renames
+    # land last-wins with identical SPMD content.
+    tmp = f"{final}.tmp.{os.getpid()}"
 
     def attempt():
         # stream the archive straight into the tmp file: no second full
@@ -1077,6 +1083,21 @@ def save_checkpoint(tree, path: str) -> None:
     _telemetry.counter_inc("io.fsync.calls")
     os.replace(tmp, final)  # atomic: readers see the old or the new file
     _fsync_dir(os.path.dirname(os.path.abspath(final)))
+    # opportunistic cleanup of tmps orphaned by crashed saves (per-pid names
+    # mean nobody else renames them away).  Age-gated so a CONCURRENT SPMD
+    # rank's in-flight tmp — seconds old — is never unlinked out from under
+    # its still-open fd, which would make its os.replace raise.
+    import glob as _glob
+    import time as _time
+
+    # glob.escape: checkpoint paths may contain glob metachars ('ck[1]');
+    # '.tmp*' (not '.tmp.*') also sweeps legacy fixed-name '<path>.tmp' files
+    for stale in _glob.glob(_glob.escape(final) + ".tmp*"):
+        try:
+            if _time.time() - os.path.getmtime(stale) > 900:
+                os.unlink(stale)
+        except OSError:
+            pass  # raced with another cleaner or an active writer: fine
 
 
 @_telemetry.traced("io.load_checkpoint")

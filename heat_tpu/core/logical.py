@@ -8,7 +8,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from . import types
 from ._operations import _binary_op, _local_op, _reduce_op
 from .dndarray import DNDarray
 

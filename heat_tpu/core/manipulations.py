@@ -9,7 +9,7 @@ the reference's conventions.
 from __future__ import annotations
 
 
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -17,9 +17,7 @@ import numpy as np
 
 from . import factories, types
 from ._cache import comm_cached
-from ._operations import _local_op
 from .dndarray import DNDarray
-from .sanitation import sanitize_in
 from .stride_tricks import sanitize_axis, sanitize_shape
 
 # dtypes whose order round-trips the 32-bit sample-sort key encoding
@@ -465,9 +463,13 @@ def reshape(x: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> D
 
 def resplit(x: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place redistribution to a new split axis (→ XLA all-to-all)."""
+    from . import sanitation
+
     axis = sanitize_axis(x.shape, axis)
     arr = x.comm.resplit(x._jarray, axis)
-    return DNDarray(arr, x.gshape, x.dtype, axis, x.device, x.comm, True)
+    return sanitation.check(
+        DNDarray(arr, x.gshape, x.dtype, axis, x.device, x.comm, True), "resplit"
+    )
 
 
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:

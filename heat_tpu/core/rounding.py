@@ -5,7 +5,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import types
-from ._operations import _binary_op, _local_op
+from ._operations import _local_op
 from .dndarray import DNDarray
 
 __all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "frexp", "modf", "nan_to_num", "rint", "round", "sgn", "sign", "trunc"]
@@ -52,9 +52,6 @@ def clip(x, min=None, max=None, out=None) -> DNDarray:
 
 def frexp(x, out=None):
     """(mantissa, exponent) decomposition."""
-    m, e = jnp.frexp(x._jarray)
-    from ._operations import _local_op as lo
-
     mm = _local_op(lambda a: jnp.frexp(a)[0], x)
     ee = _local_op(lambda a: jnp.frexp(a)[1], x)
     return (mm, ee)

@@ -18,7 +18,6 @@ import jax.numpy as jnp
 from . import types
 from ._cache import comm_cached
 from .dndarray import DNDarray
-from .sanitation import sanitize_in
 
 __all__ = ["convolve", "convolve2d"]
 

@@ -8,9 +8,8 @@ these collapse to jnp reductions plus split bookkeeping.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple, Union
+from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -13,12 +13,10 @@ it plays for the reference's blocked solvers).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple, Union
+from typing import Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
-from . import types
 from .dndarray import DNDarray
 
 __all__ = ["SplitTiles", "SquareDiagTiles"]
@@ -54,7 +52,6 @@ class SplitTiles:
         shape = tuple(comm.size for _ in self.__arr.gshape)
         locs = np.zeros(shape, dtype=np.int64)
         if split is not None:
-            idx = [None] * len(shape)
             view = np.arange(comm.size)
             expand = [1] * len(shape)
             expand[split] = comm.size

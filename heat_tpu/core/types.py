@@ -17,7 +17,7 @@ TPU-first deviations (documented, deliberate):
 from __future__ import annotations
 
 import builtins
-from typing import Type, Union
+from typing import Type
 
 import jax.numpy as jnp
 import numpy as np

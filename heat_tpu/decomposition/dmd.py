@@ -5,7 +5,7 @@ Exact DMD via the distributed SVD of the snapshot matrix.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -45,7 +45,6 @@ class DMD(BaseEstimator):
     def fit(self, x: DNDarray) -> "DMD":
         if x.ndim != 2 or x.shape[1] < 2:
             raise ValueError("DMD requires a 2-D snapshot matrix with ≥ 2 time steps")
-        jX = x._jarray
         X0d, X1d = x[:, :-1], x[:, 1:]
         X0, X1 = X0d._jarray, X1d._jarray
 

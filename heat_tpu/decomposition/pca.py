@@ -11,7 +11,7 @@ from typing import Optional, Union
 
 import jax.numpy as jnp
 
-from ..core import factories, types
+from ..core import types
 from ..core.base import BaseEstimator, TransformMixin
 from ..core.dndarray import DNDarray
 from ..linalg import svdtools

@@ -11,7 +11,7 @@ and GSPMD derives the data movement.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple, Union
+from typing import Optional
 
 import jax.numpy as jnp
 

@@ -24,7 +24,6 @@ from ..core import _operations
 from ..core._cache import cached_program, comm_cached
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
-from ..core.stride_tricks import sanitize_axis
 
 __all__ = [
     "cross",

@@ -11,7 +11,6 @@ stack is both simpler and faster (n is small in the tall-skinny regime).
 from __future__ import annotations
 
 import collections
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,6 @@ from ..core import types
 from ..core._cache import comm_cached
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
-from .basics import dot, matmul
 
 __all__ = ["cg", "lanczos", "solve_triangular"]
 

@@ -12,13 +12,13 @@
 from __future__ import annotations
 
 import collections
-from typing import Optional, Tuple, Union
+from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core import types
+from ..core.communication import Communication
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from .qr import tsqr
@@ -71,7 +71,15 @@ def _truncate(u, s, rank: Optional[int] = None, rtol: Optional[float] = None, sa
     # rtol truncation: discard tail energy below rtol * ||s||
     err2 = jnp.cumsum((s**2)[::-1])[::-1]
     thresh = (rtol**2) * jnp.sum(s**2)
-    keep = int(jnp.sum(err2 > thresh).item())
+    # the truncation rank becomes a SHAPE, so a concrete integer is
+    # unavoidable — but the raw `.item()` that used to sit here was a naked
+    # blocking device→host read in the middle of the merge tree (heatlint
+    # HT101's first real catch).  Route the one scalar through the sanctioned
+    # materialization point instead: host_fetch is collective-correct under
+    # multi-process meshes (every rank attends, so all ranks agree on the
+    # rank/shape), fault-retried, and fetches the already-reduced 0-d count —
+    # an 8-byte transfer instead of an unaccounted ad-hoc sync
+    keep = int(Communication.host_fetch(jnp.sum(err2 > thresh)))
     keep = max(keep, 1)
     keep = min(keep + safetyshift, s.shape[0])
     return u[:, :keep], s[:keep]

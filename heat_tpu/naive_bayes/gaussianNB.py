@@ -7,7 +7,6 @@ log-likelihood prediction.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -19,14 +19,12 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from ..core import devices
-from ..core.communication import Communication
 
 __all__ = [
     "DataParallelOptimizer",

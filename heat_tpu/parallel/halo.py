@@ -8,7 +8,6 @@ caller computes on interior+halo — the stencil/context-parallel skeleton.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -9,8 +9,7 @@ and overlaps with the per-step compute (XLA async collectives).
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

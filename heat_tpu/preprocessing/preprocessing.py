@@ -6,7 +6,7 @@ the split axis); the transforms are elementwise and fuse into one kernel.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 

@@ -10,14 +10,12 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
 
-from ..core import devices as ht_devices
 from ..core import types
-from ..core.communication import Communication, sanitize_comm
+from ..core.communication import Communication
 
 __all__ = ["DCSR_matrix"]
 

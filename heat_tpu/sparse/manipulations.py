@@ -7,12 +7,9 @@ scatter/gather); the split metadata follows the dense operand.
 
 from __future__ import annotations
 
-from typing import Optional
 
-import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-from ..core import types
 from ..core.dndarray import DNDarray
 from .dcsr_matrix import DCSR_matrix
 

@@ -10,9 +10,8 @@ regime where only one rotating block may be resident at a time.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
-import jax
 import jax.numpy as jnp
 
 from ..core import types

@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-from ...core import factories, types
+from ...core import factories
 from .datatools import Dataset
 
 __all__ = ["MNISTDataset"]

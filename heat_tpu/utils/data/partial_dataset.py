@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 

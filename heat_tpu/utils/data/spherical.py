@@ -6,7 +6,6 @@ import jax
 import jax.numpy as jnp
 
 from ...core import factories, types
-from ...core.communication import sanitize_comm
 from ...core.dndarray import DNDarray
 
 __all__ = ["create_spherical_dataset", "create_clusters"]

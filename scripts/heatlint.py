@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""heatlint CLI — static analysis of heat_tpu's distributed invariants.
+
+Usage:
+    python scripts/heatlint.py heat_tpu/                    # gate vs baseline
+    python scripts/heatlint.py heat_tpu/ --json out.json    # machine output
+    python scripts/heatlint.py heat_tpu/ --write-baseline   # regenerate
+    python scripts/heatlint.py --list-rules
+
+Exit codes: 0 = clean (no findings beyond the committed baseline),
+1 = new findings, 2 = usage error.
+
+Suppressions: ``# heatlint: disable=HT101`` on the offending line,
+``# heatlint: disable-file=HT101`` anywhere for the whole file.
+The baseline (default: .heatlint-baseline.json next to the repo root)
+grandfathers pre-existing findings by fingerprint — line drift does not
+invalidate it, and ``--write-baseline`` regenerates it after intentional
+changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Import ``heat_tpu.analysis`` WITHOUT importing ``heat_tpu`` itself:
+    the linter is pure stdlib, and the CI lint lane (like any pre-commit
+    hook) must not need jax/numpy installed just to parse source files.
+    A synthetic parent package keeps the relative imports working."""
+    name = "_heatlint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(REPO, "heat_tpu", "analysis")
+    pkg = types.ModuleType(name)
+    pkg.__path__ = [pkg_dir]
+    sys.modules[name] = pkg
+    spec = importlib.util.spec_from_file_location(
+        name + ".framework", os.path.join(pkg_dir, "framework.py")
+    )
+    framework = importlib.util.module_from_spec(spec)
+    sys.modules[name + ".framework"] = framework
+    spec.loader.exec_module(framework)
+    pkg.framework = framework
+    rules = importlib.import_module(name + ".rules")
+    pkg.rules = rules
+    return framework
+
+
+_fw = _load_analysis()
+all_rules = _fw.all_rules
+lint_paths = _fw.lint_paths
+load_baseline = _fw.load_baseline
+render_json = _fw.render_json
+render_text = _fw.render_text
+split_by_baseline = _fw.split_by_baseline
+write_baseline = _fw.write_baseline
+
+DEFAULT_BASELINE = os.path.join(REPO, ".heatlint-baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="heatlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--select", help="comma-separated rule codes (default: all)")
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file of grandfathered findings (default: %(default)s)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true", help="ignore the baseline (report everything as new)"
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write ALL current findings to the baseline file and exit 0",
+    )
+    ap.add_argument("--json", metavar="FILE", help="write JSON findings to FILE ('-' = stdout)")
+    ap.add_argument(
+        "--show-baselined", action="store_true", help="also print grandfathered findings"
+    )
+    ap.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.code}  {rule.name:32s} {rule.description}")
+        return 0
+
+    if not args.paths:
+        ap.error("no paths given (try: heat_tpu/)")
+
+    select = [c for c in (args.select or "").split(",") if c.strip()] or None
+    try:
+        findings = lint_paths(args.paths, select=select)
+    except ValueError as exc:
+        print(f"heatlint: {exc}", file=sys.stderr)
+        return 2
+
+    # normalize paths relative to the baseline file's directory so the
+    # committed baseline matches regardless of how the CLI was invoked
+    # (absolute path, relative path, different cwd)
+    base_dir = os.path.dirname(os.path.abspath(args.baseline)) or "."
+
+    def _norm(p: str) -> str:
+        abs_p = os.path.abspath(p)
+        if abs_p.startswith(base_dir + os.sep):
+            return os.path.relpath(abs_p, base_dir).replace(os.sep, "/")
+        return p.replace(os.sep, "/")
+
+    for f in findings:
+        f.path = _norm(f.path)
+
+    if args.write_baseline:
+        if select:
+            print(
+                "heatlint: --write-baseline cannot be combined with --select "
+                "(a rule-scoped run would silently drop every other rule's "
+                "grandfathered findings from the baseline)",
+                file=sys.stderr,
+            )
+            return 2
+        # a baseline write only speaks for the files THIS run linted:
+        # grandfathered findings in files outside the given paths are
+        # preserved, so a narrow run can't silently shrink the baseline
+        linted = {_norm(p) for p in _fw.iter_python_files(args.paths)}
+        preserved = [
+            _fw.Finding(
+                rule=r["rule"], path=r["path"], line=r.get("line", 1), col=0,
+                message=r.get("message", ""), qualname=r.get("qualname", "<module>"),
+                detail=r.get("detail", ""),
+            )
+            for r in _fw.load_baseline_records(args.baseline)
+            if r.get("path") not in linted
+        ]
+        write_baseline(args.baseline, list(findings) + preserved)
+        print(
+            f"heatlint: wrote {len(findings)} finding(s) to {args.baseline}"
+            + (f" (+{len(preserved)} preserved outside the linted paths)" if preserved else "")
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, grandfathered = split_by_baseline(findings, baseline)
+
+    if args.json:
+        payload = render_json(new, grandfathered)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+
+    print(render_text(new, grandfathered, verbose_baselined=args.show_baselined))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

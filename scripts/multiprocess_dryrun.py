@@ -336,6 +336,30 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     )
     print(f"[{pid}] pipeline stages (cross-process ppermute): OK", flush=True)
 
+    # ---- runtime metadata sanitizer across the process seam ----------- #
+    # HEAT_TPU_CHECKS tier: arm the metadata-only validator (dispatch tails
+    # + factory/resplit boundaries) on a REAL multi-process mesh, then
+    # assert cross-rank metadata agreement — a rank whose (gshape, split,
+    # dtype, pad) diverged would stage different collectives and deadlock
+    # its peers, so the digest comparison itself is the canary
+    from heat_tpu.core import sanitation
+
+    checks_were_on = sanitation.checks_enabled()  # e.g. env-armed HEAT_TPU_CHECKS=1
+    sanitation.enable_checks()
+    try:
+        chk = ht.arange(48, dtype=ht.float32, split=0) * 2.0  # validated at the tail
+        sanitation.assert_cross_rank_consistent(chk, tag="mpdryrun.dispatch")
+        chk2 = ht.resplit(ht.reshape(chk, (8, 6)), 1)  # validated at the boundary
+        sanitation.assert_cross_rank_consistent(chk2, tag="mpdryrun.resplit")
+        rag = ht.arange(101, dtype=ht.float32, split=0) + 1.0  # pad metadata agrees too
+        sanitation.assert_cross_rank_consistent(rag, tag="mpdryrun.ragged")
+    finally:
+        # restore rather than disarm: an env-armed worker keeps validating
+        # the rest of its checks
+        if not checks_were_on:
+            sanitation.disable_checks()
+    print(f"[{pid}] SANITIZER-OK (cross-rank metadata agreement)", flush=True)
+
     # ---- telemetry per-rank export ----------------------------------- #
     # every rank flushes its span/counter/histogram state to a shared dir;
     # the launcher merges rank0+rank1+... with scripts/telemetry_report.py

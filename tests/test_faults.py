@@ -333,7 +333,8 @@ class TestDurablePytreeCheckpoint:
         back = ht.core.io.load_checkpoint(tree, p)
         for a, b in zip(np.asarray(back["w"]), np.asarray(tree["w"])):
             np.testing.assert_array_equal(a, b)
-        assert not os.path.exists(p + ".npz.tmp")  # tmp file renamed away
+        # tmp sibling (now .npz.tmp.<pid>, per-process unique) renamed away
+        assert not any(".tmp" in f for f in os.listdir(tmp_path))
 
 
 class TestNonFiniteGuard:

@@ -56,6 +56,10 @@ def test_n_process_spmd_tier(n_proc, devs):
         assert f"[{pid}] comm: size=8 rank={pid}/{n_proc}" in out
         # every rank exported a telemetry jsonl file...
         assert f"[{pid}] telemetry: rank file exported" in out, out[-2000:]
+        # ...and ran the armed metadata sanitizer incl. the cross-rank
+        # metadata-agreement digest (ISSUE 4: HEAT_TPU_CHECKS on a real
+        # multi-process mesh)
+        assert f"[{pid}] SANITIZER-OK" in out, out[-2000:]
     # ...and the launcher merged them into ONE multi-rank report (ISSUE 3
     # acceptance: scripts/telemetry_report.py folds the mp lane's rank files)
     assert f"TELEMETRY-MERGED ranks={n_proc}" in out, out[-2000:]
