@@ -10,6 +10,7 @@ contracts" for the full table):
 - HT104 — every public collective in communication.py byte-accounts
 - HT105 — no raw process entropy; seeding goes through ht.random
 - HT106 — no DNDarray metadata mutation outside sanctioned modules
+- HT107 — no naked blocking collective waits bypassing comm.deadline
 
 All analyses are intentionally *lexical and intra-procedural*: false
 negatives across call boundaries are accepted; false positives are kept
@@ -153,6 +154,7 @@ class HostSyncRule(Rule):
         "item",
         "tolist",
         "host_fetch",
+        "host_fetch_all",
         "__array__",
         "__bool__",
         "__int__",
@@ -640,4 +642,74 @@ class MetadataMutationRule(Rule):
                     )
                     if f is not None:
                         out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT107 — naked blocking collective wait bypassing the deadline watchdog
+# -------------------------------------------------------------------- #
+
+
+@register
+class NakedBlockingWaitRule(Rule):
+    """A blocking collective wait — ``Barrier()``, ``Wait(...)``,
+    ``jax.block_until_ready``, ``multihost_utils.sync_global_devices`` —
+    in library code, lexically outside any ``with comm.deadline(...)``
+    scope, hangs forever when one peer is dead: the exact failure mode the
+    elastic runtime's watchdog exists to convert into
+    ``CollectiveTimeoutError``.  Call sites that are legitimately
+    unbounded (process teardown, the materialization layer) are exempted
+    via the suppression/baseline machinery, like every other rule.
+
+    Lexical and intra-procedural on purpose: a deadline armed by a CALLER
+    is invisible here and such sites belong in the baseline — the point of
+    the rule is that NEW naked waits need a conscious decision."""
+
+    code = "HT107"
+    name = "naked-blocking-wait"
+    description = "blocking collective wait outside a comm.deadline scope"
+
+    # the wrapper itself and the guard implementation are the two places a
+    # raw blocking wait is the point
+    SANCTIONED_MODULES = (
+        "core/communication.py",
+        "utils/health.py",
+    )
+    BLOCKING_ATTRS = {"Barrier", "Wait", "block_until_ready", "sync_global_devices"}
+
+    def _under_deadline(self, ctx: LintContext, node: ast.AST) -> bool:
+        """True when an ancestor ``with`` arms a deadline (``comm.deadline``
+        / ``health.deadline`` / ``deadline(...)``) around this call."""
+        for anc in ctx.ancestors(node):
+            if not isinstance(anc, (ast.With, ast.AsyncWith)):
+                continue
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call) and last_attr(expr) == "deadline":
+                    return True
+        return False
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            la = last_attr(node)
+            if la not in self.BLOCKING_ATTRS:
+                continue
+            if la == "Barrier" and (node.args or node.keywords):
+                continue  # a foreign Barrier(...) API, not the collective fence
+            if self._under_deadline(ctx, node):
+                continue
+            f = ctx.finding(
+                self, node,
+                f"blocking collective wait `{la}` outside any `comm.deadline(...)` "
+                "scope hangs forever on a dead peer; arm a deadline (or baseline "
+                "the site if it is legitimately unbounded)",
+                detail=la,
+            )
+            if f is not None:
+                out.append(f)
         return out

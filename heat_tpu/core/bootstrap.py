@@ -12,7 +12,28 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["init_distributed", "finalize_distributed", "local_device_count", "device_count"]
+__all__ = [
+    "init_distributed",
+    "finalize_distributed",
+    "local_device_count",
+    "device_count",
+    "restart_epoch",
+]
+
+
+def restart_epoch() -> int:
+    """The supervisor restart generation this process was launched into.
+
+    0 on a fresh launch; the supervising launcher
+    (``heat_tpu.parallel.supervisor``) increments ``HEAT_TPU_RESTART_EPOCH``
+    on every world restart.  Workers branch on this at bring-up to resume
+    from the newest verified checkpoint (``DASO.resume()`` /
+    ``load_array_checkpoint``'s verified-fallback chain) instead of
+    retraining from scratch — a ``kill -9`` mid-training costs at most
+    ``checkpoint_every`` steps."""
+    from ..utils import health as _health
+
+    return _health.restart_epoch()
 
 
 def _coordinator_retryable(e: BaseException) -> bool:

@@ -54,6 +54,11 @@ __all__ = [
 # the per-op dispatch hot path
 _TELEMETRY_MOD = None
 
+# health (deadline watchdog) and faults are lazily cached the same way:
+# used at collective staging and around the blocking waits, never in the
+# dispatch hot path
+_HEALTH_MOD = None
+
 # runtime sanitizer hook (HEAT_TPU_CHECKS=1): ``core.sanitation.
 # enable_checks()`` points this at ``sanitation.check_placement`` so every
 # eager resplit verifies the produced array actually carries the canonical
@@ -73,6 +78,15 @@ def _telemetry():
 
         _TELEMETRY_MOD = telemetry
     return _TELEMETRY_MOD
+
+
+def _health():
+    global _HEALTH_MOD
+    if _HEALTH_MOD is None:
+        from ..utils import health
+
+        _HEALTH_MOD = health
+    return _HEALTH_MOD
 
 
 def _payload_nbytes(x) -> int:
@@ -280,8 +294,15 @@ class Communication:
         Fault site ``comm.host_fetch``: transient injected faults are
         retried with short backoff (every process fires the site the same
         number of times — fault countdowns are process-local and the call
-        pattern is SPMD, so retries stay collective-aligned)."""
+        pattern is SPMD, so retries stay collective-aligned).
+
+        Deadline-guarded: under an armed ``comm.deadline(...)`` a fetch
+        whose peers never show up (the collective ``process_allgather``
+        against a dead rank) raises ``CollectiveTimeoutError`` instead of
+        blocking forever — this is the real-world hang point of a dead
+        peer, not the staged collectives."""
         from ..utils import faults as _flt  # lazy: core imports before utils
+        from ..utils import health as _hlth
 
         def _fetch():
             _flt.fire("comm.host_fetch")
@@ -294,9 +315,44 @@ class Communication:
 
             return np.asarray(multihost_utils.process_allgather(array, tiled=True))
 
-        return _flt.call_with_retries(
-            _fetch, "comm.host_fetch", retries=3, base_delay=0.02, max_delay=0.5,
-            retry_on=(_flt.TransientFault,),
+        return _hlth.guard_blocking(
+            lambda: _flt.call_with_retries(
+                _fetch, "comm.host_fetch", retries=3, base_delay=0.02, max_delay=0.5,
+                retry_on=(_flt.TransientFault,),
+            ),
+            "comm.host_fetch",
+        )
+
+    @staticmethod
+    def host_fetch_all(arrays) -> "list":
+        """Batched :meth:`host_fetch` of many (possibly non-addressable)
+        arrays in ONE collective: ``process_allgather`` accepts a pytree,
+        so a checkpoint of a model with hundreds of cross-process leaves
+        costs one round-trip, not one per leaf.  Same contract as
+        ``host_fetch``: collective (every process calls together), fault
+        site ``comm.host_fetch``, retried, deadline-guarded."""
+        from ..utils import faults as _flt
+        from ..utils import health as _hlth
+
+        arrays = list(arrays)
+        if not arrays:
+            return []
+
+        def _fetch():
+            _flt.fire("comm.host_fetch")
+            if all(getattr(a, "is_fully_addressable", True) for a in arrays):
+                return [np.asarray(a) for a in jax.device_get(arrays)]
+            from jax.experimental import multihost_utils
+
+            out = multihost_utils.process_allgather(arrays, tiled=True)
+            return [np.asarray(o) for o in out]
+
+        return _hlth.guard_blocking(
+            lambda: _flt.call_with_retries(
+                _fetch, "comm.host_fetch", retries=3, base_delay=0.02, max_delay=0.5,
+                retry_on=(_flt.TransientFault,),
+            ),
+            "comm.host_fetch",
         )
 
     def shard(self, array: jax.Array, split: Optional[int]) -> jax.Array:
@@ -494,7 +550,26 @@ class Communication:
         collectives per compilation — a collective inside ``lax.scan``
         counts once however many iterations run.  Derived collectives
         (``Reduce``, ``Scatter``) account under the primitive they are
-        built from (``Allreduce``, ``Bcast``)."""
+        built from (``Allreduce``, ``Bcast``).
+
+        Health hooks ride the same choke point: fault site
+        ``comm.collective`` fires here (delay/hang model a slow or dead
+        peer at staging), and an armed :meth:`deadline` both refuses to
+        stage more work once blown AND catches an injected staging hang —
+        under a deadline the fire runs inside ``guard_blocking``, so a
+        ``hang=`` injection trips ``CollectiveTimeoutError`` exactly like
+        a hang in ``Wait`` would, instead of wedging the caller's thread."""
+        from ..utils import faults as _flt  # lazy: core imports before utils
+
+        hlth = _health()
+        if hlth.active_deadline() is None:
+            _flt.fire("comm.collective")
+        else:
+            # checks expiry first (raises CollectiveTimeoutError with this
+            # site name), then runs the fire on the watchdog thread
+            hlth.guard_blocking(
+                lambda: _flt.fire("comm.collective"), f"comm.{name}"
+            )
         _telemetry().account_collective(name, _payload_nbytes(x) * factor)
 
     def _warn_gather_based(self, name: str) -> None:
@@ -666,13 +741,47 @@ class Communication:
 
     @staticmethod
     def Wait(x):
-        """Block until a dispatched result is ready (reference MPIRequest.Wait)."""
-        return jax.block_until_ready(x)
+        """Block until a dispatched result is ready (reference MPIRequest.Wait).
+
+        Deadline-guarded: under an armed :meth:`deadline` a wait on a
+        collective whose peer died raises ``CollectiveTimeoutError`` (with
+        a full stack dump) instead of hanging the process forever — the
+        elastic runtime's detection point for a wedged world.  Fault site
+        ``comm.collective`` fires inside the guard so an injected hang is
+        caught by the watchdog exactly like a real one."""
+        from ..utils import faults as _flt
+
+        def _wait():
+            _flt.fire("comm.collective")
+            return jax.block_until_ready(x)
+
+        return _health().guard_blocking(_wait, "comm.Wait")
 
     def Barrier(self) -> None:
-        """Host-level barrier: forces completion of all enqueued work."""
-        tok = jax.device_put(jnp.zeros(()), self.sharding(0, None))
-        jax.block_until_ready(tok)
+        """Host-level barrier: forces completion of all enqueued work.
+        Deadline-guarded like :meth:`Wait` (same watchdog, same fault
+        site)."""
+        from ..utils import faults as _flt
+
+        def _barrier():
+            _flt.fire("comm.collective")
+            tok = jax.device_put(jnp.zeros(()), self.sharding(0, None))
+            jax.block_until_ready(tok)
+
+        _health().guard_blocking(_barrier, "comm.Barrier")
+
+    def deadline(self, seconds: float):
+        """Arm a collective deadline for the block (``with comm.deadline(30):``).
+
+        Inside it, the blocking waits (:meth:`Wait`, :meth:`Barrier`,
+        :meth:`host_fetch`) run under a watchdog that raises
+        :class:`heat_tpu.utils.health.CollectiveTimeoutError` — after
+        dumping every thread's stack — once the budget is exhausted, and
+        collective *staging* points refuse to stage more work past the
+        deadline.  A hung Allreduce becomes a catchable error the caller
+        (or the supervisor, via process exit) can recover from, instead of
+        being indistinguishable from slow progress."""
+        return _health().deadline(seconds)
 
     # convenience: run fn under shard_map over this communicator
     def shard_map(self, fn, in_splits, out_splits, check_vma: bool = False):

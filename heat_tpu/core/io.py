@@ -1051,8 +1051,21 @@ def save_checkpoint(tree, path: str) -> None:
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     # ONE batched device→host transfer for the whole tree: per-leaf
     # np.asarray would issue a blocking round-trip per parameter, turning a
-    # checkpoint into hundreds of serial host syncs
-    leaves = jax.device_get([leaf for _, leaf in flat])
+    # checkpoint into hundreds of serial host syncs.  Under multi-process
+    # SPMD a leaf sharded across processes is NOT fully addressable and
+    # device_get would raise — ALL such leaves go through ONE collective
+    # batched fetch (host_fetch_all wraps a single pytree process_allgather;
+    # every rank calls save together, which the SPMD contract already
+    # requires), the rest stay on the batched device_get.
+    raw = [leaf for _, leaf in flat]
+    is_local = [getattr(x, "is_fully_addressable", True) for x in raw]
+    local_it = iter(jax.device_get([x for x, loc in zip(raw, is_local) if loc]))
+    from .communication import Communication
+
+    remote_it = iter(
+        Communication.host_fetch_all([x for x, loc in zip(raw, is_local) if not loc])
+    )
+    leaves = [next(local_it) if loc else next(remote_it) for loc in is_local]
     arrays = {}
     keys = []
     for i, ((p, _), host) in enumerate(zip(flat, leaves)):

@@ -94,11 +94,31 @@ def nonfinite_guard(inner: "optax.GradientTransformation") -> "optax.GradientTra
 def _guard_counters(opt_state) -> dict:
     """{'steps': int, 'skipped': int} summed over any leading replica axes
     (DASO broadcasts the counters per dcn group).  Syncs the two 0-d/1-d
-    counter arrays — call at reporting boundaries only."""
+    counter arrays — call at reporting boundaries only.
+
+    Under multi-process SPMD the per-group counters are sharded over
+    processes and a plain ``device_get`` would raise; this reads the
+    LOCALLY addressable shards only — a per-rank view, deliberately not a
+    collective (reporting must never be able to deadlock a rank whose
+    peers aren't reporting), and the multi-rank telemetry merge sums the
+    per-rank counter snapshots anyway."""
     if not isinstance(opt_state, NonFiniteGuardState):
         return {}
+
+    def _local(x):
+        if getattr(x, "is_fully_addressable", True):
+            return jax.device_get(x)
+        import numpy as _np
+
+        # one value per DISTINCT shard index: each group's counter is
+        # replicated over 'ici', so raw addressable_shards holds duplicates
+        uniq = {}
+        for s in x.addressable_shards:
+            uniq.setdefault(str(s.index), _np.asarray(s.data))
+        return _np.concatenate([v.reshape(-1) for _, v in sorted(uniq.items())])
+
     try:
-        steps, skipped = jax.device_get((opt_state.steps, opt_state.skipped))
+        steps, skipped = _local(opt_state.steps), _local(opt_state.skipped)
     except RuntimeError as e:
         if "deleted" not in str(e).lower():
             raise
@@ -535,6 +555,13 @@ class DASO:
                     self._pending = (avg, t + self.stale_steps)
         if self.checkpoint_every and t % self.checkpoint_every == 0:
             self.checkpoint()
+        # fault site ``proc.exit`` (elastic-runtime chaos lane): arming
+        # ``proc.exit:exit=N`` on one rank SIGKILLs it after its Nth step —
+        # the deterministic "rank dies mid-training" the supervisor must
+        # detect and recover from.  Disarmed cost: one dict miss.
+        from ..utils import faults as _flt
+
+        _flt.fire("proc.exit")
         # asynchronous loss: a 0-d device array (duck-types float) — the old
         # float(...) here was a blocking host sync on EVERY step, serializing
         # the train loop on the slowest collective.  Callers that need the
@@ -612,12 +639,36 @@ class DASO:
         return {"steps": s["steps"], "skipped_steps": s["skipped"]}
 
     _CKPT_NAME = "daso_state.npz"
+    _PREV_NAME = "daso_state.prev.npz"
+    _META_NAME = "daso_state.meta.json"
+
+    def _world_meta(self) -> dict:
+        return {
+            "n_groups": int(self.n_groups),
+            "ici": int(self.ici_size),
+            "devices": int(len(self.mesh.devices.ravel())),
+        }
 
     def checkpoint(self, directory: Optional[str] = None) -> str:
         """Atomically checkpoint the full training state (per-group params,
         optimizer state incl. guard counters, step count) to
         ``<dir>/daso_state.npz`` via the durable pytree writer; returns the
-        path.  Called automatically every ``checkpoint_every`` steps."""
+        path.  Called automatically every ``checkpoint_every`` steps.
+
+        Two durability extras for the elastic runtime:
+
+        - the previously durable state is preserved as
+          ``daso_state.prev.npz`` before the new save, so :meth:`resume`
+          has a verified-fallback target when the newest file is corrupt
+          (bit rot between crash and restart);
+        - a ``daso_state.meta.json`` sidecar records the step count and the
+          world shape (n_groups, ici, device count) so a restarted world
+          can refuse a mismatched topology with a clear error instead of a
+          shape crash deep inside the loader.
+        """
+        import json as _json
+        import shutil as _shutil
+
         from ..core import io as _io
 
         d = directory or self.checkpoint_dir
@@ -625,12 +676,25 @@ class DASO:
             raise ValueError("no checkpoint directory configured")
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, self._CKPT_NAME)
+        if os.path.exists(path):
+            # copy (not rename): `path` stays durable through the whole new
+            # save; `prev` only ever holds a complete older state
+            try:
+                _shutil.copy2(path, os.path.join(d, self._PREV_NAME))
+            except OSError:
+                pass  # a missing fallback degrades recovery, never the save
         tree = {
             "params": self._params,
             "opt_state": self._opt_state,
             "step": jnp.asarray(self._step_count, jnp.int32),
         }
         _io.save_checkpoint(tree, path)
+        meta = dict(self._world_meta(), step=int(self._step_count), time=time.time())
+        mpath = os.path.join(d, self._META_NAME)
+        tmp = f"{mpath}.tmp.{os.getpid()}"  # per-pid: SPMD ranks share the dir
+        with open(tmp, "w") as fh:
+            _json.dump(meta, fh)
+        os.replace(tmp, mpath)
         return path
 
     def resume(self, directory: Optional[str] = None) -> bool:
@@ -638,25 +702,74 @@ class DASO:
         Call after :meth:`init` — the live params/opt-state tree provides the
         structure, dtypes and shardings the loaded leaves are validated
         against and placed back onto.  Any in-flight global average is
-        dropped (it refers to pre-crash state)."""
+        dropped (it refers to pre-crash state).
+
+        Validation and fallback (the restart-with-resume contract):
+
+        - the sidecar's world shape must match this optimizer's mesh — a
+          restarted world with a different n_groups/ici/device count gets a
+          clear ``ValueError`` naming both topologies, not a shape crash;
+        - a corrupt/torn ``daso_state.npz`` falls back (with a warning and
+          a ``health.resume.fallbacks`` counter) to the preserved
+          ``daso_state.prev.npz``; only when nothing verifies does the
+          corruption error surface;
+        - a sidecar step disagreeing with the restored tree's step (the
+          crash window between the two writes) is warned about — the tree,
+          which is what actually restores, wins.
+        """
+        import json as _json
+        import warnings as _warnings
+
         from ..core import io as _io
+        from ..utils import health as _health
 
         d = directory or self.checkpoint_dir
         if d is None:
             raise ValueError("no checkpoint directory configured")
         path = os.path.join(d, self._CKPT_NAME)
-        if not os.path.exists(path):
+        prev = os.path.join(d, self._PREV_NAME)
+        if not os.path.exists(path) and not os.path.exists(prev):
             return False
         if not hasattr(self, "_params"):
             raise RuntimeError("call init() before resume(): the live tree "
                                "provides the structure to restore into")
+        meta = None
+        try:
+            with open(os.path.join(d, self._META_NAME)) as fh:
+                meta = _json.load(fh)
+        except (OSError, ValueError):
+            meta = None  # pre-sidecar checkpoint or torn write: skip checks
+        if meta is not None:
+            want = self._world_meta()
+            got = {k: int(meta.get(k, want[k])) for k in want}
+            if got != want:
+                raise ValueError(
+                    f"checkpoint under {d!r} was written by a different world: "
+                    f"checkpoint {got} vs this optimizer {want} — a restarted "
+                    "world must be rebuilt with the same n_groups/ici/device "
+                    "count to resume this state"
+                )
         tree_like = {
             "params": self._params,
             "opt_state": self._opt_state,
             "step": jnp.asarray(0, jnp.int32),
         }
-        loaded = _io.load_checkpoint(tree_like, path)
+        used_fallback = False
+        try:
+            loaded = _io.load_checkpoint(tree_like, path)
+        except (_io.CheckpointCorruptionError, FileNotFoundError) as e:
+            if not os.path.exists(prev):
+                raise
+            _warnings.warn(
+                f"newest DASO checkpoint is unusable ({e}); falling back to "
+                f"the preserved previous state {prev!r}"
+            )
+            _health.counter_inc("health.resume.fallbacks")
+            loaded = _io.load_checkpoint(tree_like, prev)
+            used_fallback = True
         from jax.sharding import NamedSharding
+
+        multiprocess = jax.process_count() > 1
 
         def place(new, old):
             # restore mesh shardings (params live sharded over 'dcn');
@@ -664,11 +777,29 @@ class DASO:
             # jit remains free to co-locate it with the params
             sh = getattr(old, "sharding", None)
             if isinstance(sh, NamedSharding):
+                if multiprocess:
+                    # device_put of host data onto a multi-process mesh runs
+                    # the NaN-hostile multihost assert_equal; build the
+                    # global array from per-device slices instead (same
+                    # hazard Communication.shard handles)
+                    import numpy as _np
+
+                    from ..core.communication import _array_from_callback
+
+                    return _array_from_callback(_np.asarray(new), sh)
                 return jax.device_put(jnp.asarray(new), sh)
             return jnp.asarray(new)
 
         self._params = jax.tree.map(place, loaded["params"], self._params)
         self._opt_state = jax.tree.map(place, loaded["opt_state"], self._opt_state)
         self._step_count = int(loaded["step"])
+        if meta is not None and not used_fallback and int(meta.get("step", -1)) not in (
+            -1, self._step_count
+        ):
+            _warnings.warn(
+                f"checkpoint sidecar records step {meta.get('step')} but the "
+                f"restored tree holds step {self._step_count} (crash window "
+                "between the two writes); trusting the restored tree"
+            )
         self._pending = None
         return True

@@ -14,8 +14,13 @@ from .halo import halo_exchange, with_halos
 from .ring_attention import ring_attention, ring_self_attention
 from .sample_sort import order_statistics_1d, sample_sort_1d
 from .pipeline import pipeline_apply
+from . import supervisor
+from .supervisor import Supervisor, SupervisorResult
 
 __all__ = [
+    "Supervisor",
+    "SupervisorResult",
+    "supervisor",
     "pipeline_apply",
     "ring_map",
     "halo_exchange",

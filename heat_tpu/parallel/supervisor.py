@@ -1,0 +1,282 @@
+"""Supervised restart-with-resume: the recovery half of the elastic runtime.
+
+The detection half (``heat_tpu.utils.health``) gives every rank a heartbeat
+beacon and every collective a deadline; this module is the process that
+*acts* on those signals.  A :class:`Supervisor` owns a world of rank
+subprocesses and drives the state machine::
+
+    LAUNCH ──► MONITOR ──(all ranks exit 0)──► DONE(ok)
+                  │
+                  ├─ rank died (nonzero / signal)
+                  ├─ heartbeat went stale (> heartbeat_timeout)
+                  └─ generation overran its deadline
+                  │
+                  ▼
+          TEARDOWN: SIGUSR1 every survivor (faulthandler stack dump into
+          its log — the PR-2 wiring), grace, then SIGKILL
+                  │
+        restarts < budget? ──no──► DONE(failed, merged diagnostic report)
+                  │ yes
+                  ▼
+          RELAUNCH: fresh coordinator port, HEAT_TPU_RESTART_EPOCH+1,
+          back to MONITOR
+
+Workers detect ``HEAT_TPU_RESTART_EPOCH > 0`` at bring-up
+(``bootstrap.restart_epoch()``) and resume from the newest verified
+checkpoint (``DASO.resume()`` / ``load_array_checkpoint``'s fallback
+chain), so one ``kill -9`` costs at most ``checkpoint_every`` steps — not
+the run.
+
+Why a fresh port per generation: the coordination service lives inside
+rank 0; when the world dies the listener dies with it, and rebinding the
+old port races TIME_WAIT.  Why kill *everyone* on one failure: a dead
+peer wedges every survivor's next collective forever (the MPI heritage
+this layer exists to escape) — waiting for them is pure lost time.
+
+Everything the watchdog does is counted (``watchdog.dumps``,
+``watchdog.kills``, ``health.restarts``) and returned in the
+:class:`SupervisorResult`, so a post-hoc report shows every silent kill.
+
+Stdlib-only on purpose — no package-relative imports either, so launchers
+may load this file standalone (``importlib.util.spec_from_file_location``)
+without importing ``heat_tpu`` and hence without importing jax: the
+supervisor is the process that outlives the runtime it supervises.  The
+heartbeat *reader* here is deliberately just the file mtime — the writer
+(``heat_tpu.utils.health.Heartbeat``) rewrites atomically, and mtime is
+immune to payload-format drift between supervisor and worker versions.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "Supervisor",
+    "SupervisorResult",
+    "free_port",
+    "dump_stacks_then_kill",
+]
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (the next coordinator's address)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def dump_stacks_then_kill(procs, grace: float = 3.0) -> Dict[str, int]:
+    """Watchdog teardown for wedged workers: SIGUSR1 each live process (the
+    workers registered a faulthandler stack dump on it, so every thread's
+    traceback lands in that rank's output), give them ``grace`` seconds to
+    finish dumping, then kill.  Returns ``{"dumps": n, "kills": m}`` — the
+    counts the callers fold into the merged telemetry report so silent
+    kills stay visible post-hoc (``dumps`` = processes asked for a stack
+    dump, ``kills`` = processes that had to be SIGKILLed after the
+    grace)."""
+    hung = [p for p in procs if p.poll() is None]
+    if not hung:
+        return {"dumps": 0, "kills": 0}
+    print(
+        f"watchdog: {len(hung)} process(es) still alive at the deadline; "
+        "requesting stack dumps (SIGUSR1) before kill",
+        flush=True,
+    )
+    for p in hung:
+        try:
+            p.send_signal(signal.SIGUSR1)
+        except OSError:
+            pass
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < grace and any(p.poll() is None for p in hung):
+        time.sleep(0.1)
+    kills = 0
+    for p in hung:
+        if p.poll() is None:
+            p.kill()
+            kills += 1
+    return {"dumps": len(hung), "kills": kills}
+
+
+@dataclass
+class SupervisorResult:
+    """What happened, for the caller and the post-hoc report."""
+
+    ok: bool
+    restarts: int
+    generations: int
+    returncodes: List[Optional[int]]
+    counters: Dict[str, int]
+    failures: List[str] = field(default_factory=list)
+
+    def report(self) -> dict:
+        """Merged diagnostic structure (printed/JSON-dumped by launchers on
+        give-up; the counters slot straight into a telemetry counters
+        line)."""
+        return {
+            "ok": self.ok,
+            "restarts": self.restarts,
+            "generations": self.generations,
+            "returncodes": self.returncodes,
+            "counters": dict(self.counters),
+            "failures": list(self.failures),
+        }
+
+
+class Supervisor:
+    """Supervise ``n_ranks`` subprocesses with liveness + heartbeat
+    monitoring and restart-with-resume.
+
+    ``spawn(rank, epoch, port)`` launches one rank of generation ``epoch``
+    against a coordinator at ``port`` and returns its ``subprocess.Popen``.
+    The callback owns the environment; its contract with this class:
+
+    - export ``HEAT_TPU_RESTART_EPOCH=<epoch>`` so the worker's resume
+      path can branch on it;
+    - if heartbeat monitoring is wanted, have rank ``r`` beat
+      ``<heartbeat_dir>/rank<r>.json`` (``health.Heartbeat``);
+    - route stdout/stderr somewhere durable (a log file) — SIGUSR1 stack
+      dumps land there.
+
+    Monitoring declares the generation failed when any rank exits nonzero
+    (or by signal), any live rank's heartbeat goes staler than
+    ``heartbeat_timeout`` (a rank that never beats is measured from the
+    generation's start), or the generation exceeds
+    ``generation_deadline``.  On failure the remaining world is torn down
+    via :func:`dump_stacks_then_kill` and — while ``restart_budget``
+    lasts — relaunched on a fresh port with the epoch incremented.
+    """
+
+    def __init__(
+        self,
+        spawn: Callable[[int, int, int], subprocess.Popen],
+        n_ranks: int,
+        *,
+        heartbeat_dir: Optional[str] = None,
+        heartbeat_timeout: float = 120.0,
+        restart_budget: int = 1,
+        generation_deadline: Optional[float] = None,
+        poll_interval: float = 0.5,
+        grace: float = 3.0,
+    ):
+        self.spawn = spawn
+        self.n_ranks = int(n_ranks)
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.restart_budget = int(restart_budget)
+        self.generation_deadline = generation_deadline
+        self.poll_interval = float(poll_interval)
+        self.grace = float(grace)
+        self.counters: Dict[str, int] = {
+            "watchdog.dumps": 0,
+            "watchdog.kills": 0,
+            "health.restarts": 0,
+        }
+
+    # ------------------------------------------------------------------ #
+    def _heartbeat_path(self, rank: int) -> str:
+        return os.path.join(self.heartbeat_dir, f"rank{rank}.json")
+
+    def _clear_heartbeats(self) -> None:
+        """Remove the previous generation's beacons so staleness is always
+        measured against THIS generation (a stale leftover file would trip
+        the monitor before the new rank's first beat)."""
+        if not self.heartbeat_dir:
+            return
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        for r in range(self.n_ranks):
+            try:
+                os.unlink(self._heartbeat_path(r))
+            except OSError:
+                pass
+
+    def _check_failure(
+        self, procs: List[subprocess.Popen], gen_wall_start: float
+    ) -> Optional[str]:
+        codes = [p.poll() for p in procs]
+        for r, c in enumerate(codes):
+            if c is not None and c != 0:
+                sig = f" (signal {-c})" if c < 0 else ""
+                return f"rank {r} died with exit code {c}{sig}"
+        if self.heartbeat_dir:
+            now = time.time()
+            for r, c in enumerate(codes):
+                if c is not None:
+                    continue  # exited 0: no longer expected to beat
+                try:
+                    age = now - os.path.getmtime(self._heartbeat_path(r))
+                except OSError:
+                    age = now - gen_wall_start  # never beat yet
+                if age > self.heartbeat_timeout:
+                    return (
+                        f"rank {r} heartbeat stale ({age:.1f}s > "
+                        f"{self.heartbeat_timeout:.1f}s) — hung or wedged"
+                    )
+        return None
+
+    def run(self) -> SupervisorResult:
+        failures: List[str] = []
+        epoch = 0
+        while True:
+            port = free_port()
+            self._clear_heartbeats()
+            gen_wall_start = time.time()
+            gen_t0 = time.monotonic()
+            procs = [self.spawn(r, epoch, port) for r in range(self.n_ranks)]
+            failure: Optional[str] = None
+            while True:
+                codes = [p.poll() for p in procs]
+                if all(c == 0 for c in codes):
+                    return SupervisorResult(
+                        ok=True,
+                        restarts=epoch,
+                        generations=epoch + 1,
+                        returncodes=codes,
+                        counters=dict(self.counters),
+                        failures=failures,
+                    )
+                failure = self._check_failure(procs, gen_wall_start)
+                if failure is not None:
+                    break
+                if (
+                    self.generation_deadline is not None
+                    and time.monotonic() - gen_t0 > self.generation_deadline
+                ):
+                    failure = (
+                        f"generation {epoch} exceeded its "
+                        f"{self.generation_deadline:.0f}s deadline"
+                    )
+                    break
+                time.sleep(self.poll_interval)
+            failures.append(f"epoch {epoch}: {failure}")
+            print(f"supervisor: {failures[-1]}; tearing the world down", flush=True)
+            d = dump_stacks_then_kill(procs, grace=self.grace)
+            self.counters["watchdog.dumps"] += d["dumps"]
+            self.counters["watchdog.kills"] += d["kills"]
+            for p in procs:
+                if p.poll() is None:
+                    p.wait()
+            if epoch >= self.restart_budget:
+                return SupervisorResult(
+                    ok=False,
+                    restarts=epoch,
+                    generations=epoch + 1,
+                    returncodes=[p.poll() for p in procs],
+                    counters=dict(self.counters),
+                    failures=failures,
+                )
+            epoch += 1
+            self.counters["health.restarts"] += 1
+            print(
+                f"supervisor: restarting the world (epoch {epoch} of "
+                f"<= {self.restart_budget}) on a fresh coordinator port",
+                flush=True,
+            )

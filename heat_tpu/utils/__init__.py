@@ -2,5 +2,6 @@
 
 from . import data
 from . import faults
+from . import health
 from . import profiler
 from . import telemetry

@@ -16,6 +16,16 @@ two halves of that story:
   ``io.read``               checkpoint verification/assembly reads
   ``io.fsync``              every fsync of a checkpoint file or directory
   ``comm.host_fetch``       ``Communication.host_fetch`` (device→host fetches)
+  ``comm.collective``       every ``Communication`` collective staging point
+                            (``_account``) and the blocking waits
+                            (``Wait``/``Barrier``) — ``delay``/``hang`` here
+                            model a slow or dead peer, the case the
+                            ``comm.deadline`` watchdog exists for
+  ``proc.exit``             once per training step (``DASO.step``) and per
+                            dryrun-worker section — ``exit=N`` SIGKILLs the
+                            process on the Nth firing, the deterministic
+                            "rank dies mid-training" the supervisor lane
+                            recovers from
   ``dist.init``             each ``jax.distributed.initialize`` attempt in
                             ``bootstrap.init_distributed``
   ========================  ====================================================
@@ -43,6 +53,12 @@ Modes per site (combinable):
 - ``corrupt=N``  flip one byte of the file passed as ``fire(..., path=)`` on
   the first N firings — models bit rot / torn sectors *after* the writer
   computed its checksum.
+- ``hang=N``     block forever on the first N firings (``-1``: every) —
+  models a dead peer's collective; only a deadline watchdog or a kill
+  reclaims the caller.
+- ``exit=N``     SIGKILL the *own* process on the Nth firing — models rank
+  death at a deterministic point (the supervisor chaos lane arms this on
+  one rank's ``proc.exit``).
 
 Everything here is stdlib-only on purpose: the registry is imported from the
 innermost I/O and bootstrap paths, where a heavy import would be a cycle.
@@ -82,11 +98,11 @@ class TransientFault(InjectedFault, OSError):
 
 
 class FaultSpec:
-    """Armed behavior of one site.  ``fail``/``corrupt`` are countdowns
-    (mutated as the site fires; ``-1`` = unlimited); ``delay`` applies to
-    every firing."""
+    """Armed behavior of one site.  ``fail``/``corrupt``/``hang`` are
+    countdowns (mutated as the site fires; ``-1`` = unlimited); ``delay``
+    applies to every firing; ``exit`` counts DOWN to the fatal firing."""
 
-    __slots__ = ("site", "fail", "delay", "corrupt", "exc")
+    __slots__ = ("site", "fail", "delay", "corrupt", "hang", "exit", "exc")
 
     def __init__(
         self,
@@ -94,24 +110,29 @@ class FaultSpec:
         fail: int = 0,
         delay: float = 0.0,
         corrupt: int = 0,
+        hang: int = 0,
+        exit: int = 0,
         exc: type = TransientFault,
     ):
         self.site = site
         self.fail = int(fail)
         self.delay = float(delay)
         self.corrupt = int(corrupt)
+        self.hang = int(hang)
+        self.exit = int(exit)
         self.exc = exc
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
             f"FaultSpec({self.site!r}, fail={self.fail}, delay={self.delay}, "
-            f"corrupt={self.corrupt})"
+            f"corrupt={self.corrupt}, hang={self.hang}, exit={self.exit})"
         )
 
 
 def parse_spec(text: str) -> Dict[str, FaultSpec]:
     """Parse the ``HEAT_TPU_FAULTS`` grammar:
-    ``site:key=val,key=val;site2:key=val`` with keys fail/delay/corrupt."""
+    ``site:key=val,key=val;site2:key=val`` with keys
+    fail/delay/corrupt/hang/exit."""
     specs: Dict[str, FaultSpec] = {}
     for entry in filter(None, (e.strip() for e in text.split(";"))):
         site, _, kvs = entry.partition(":")
@@ -122,7 +143,7 @@ def parse_spec(text: str) -> Dict[str, FaultSpec]:
         for kv in filter(None, (p.strip() for p in kvs.split(","))):
             k, _, v = kv.partition("=")
             k = k.strip()
-            if k not in ("fail", "delay", "corrupt"):
+            if k not in ("fail", "delay", "corrupt", "hang", "exit"):
                 raise ValueError(f"unknown fault mode {k!r} for site {site!r}")
             kw[k] = float(v) if k == "delay" else int(v)
         specs[site] = FaultSpec(site, **kw)
@@ -145,11 +166,15 @@ def inject(
     fail: int = 0,
     delay: float = 0.0,
     corrupt: int = 0,
+    hang: int = 0,
+    exit: int = 0,
     exc: type = TransientFault,
 ) -> Iterator[FaultSpec]:
     """Arm ``site`` for the duration of the block (nests; yields the live
     spec so tests can inspect the remaining countdown)."""
-    spec = FaultSpec(site, fail=fail, delay=delay, corrupt=corrupt, exc=exc)
+    spec = FaultSpec(
+        site, fail=fail, delay=delay, corrupt=corrupt, hang=hang, exit=exit, exc=exc
+    )
     current = dict(_ctx.get() or {})
     current[site] = spec
     token = _ctx.set(current)
@@ -172,8 +197,9 @@ def _flip_byte(path: str) -> None:
 
 
 def fire(site: str, path: Optional[str] = None) -> None:
-    """Trip ``site`` if armed: delay, then corrupt ``path``, then fail.
-    A disarmed site is a dict miss — cheap enough for hot paths."""
+    """Trip ``site`` if armed: delay, then hang, then corrupt ``path``,
+    then exit, then fail.  A disarmed site is a dict miss — cheap enough
+    for hot paths."""
     ctx = _ctx.get()
     if ctx is None and not _ENV:
         return
@@ -183,10 +209,21 @@ def fire(site: str, path: Optional[str] = None) -> None:
     _trips[site] = _trips.get(site, 0) + 1
     if spec.delay:
         time.sleep(spec.delay)
+    if spec.hang != 0:
+        if spec.hang > 0:
+            spec.hang -= 1
+        while True:  # a dead peer never returns; only a watchdog/kill ends this
+            time.sleep(3600.0)
     if spec.corrupt != 0 and path is not None:
         if spec.corrupt > 0:
             spec.corrupt -= 1
         _flip_byte(path)
+    if spec.exit > 0:
+        spec.exit -= 1
+        if spec.exit == 0:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)  # rank death, not an exception
     if spec.fail != 0:
         if spec.fail > 0:
             spec.fail -= 1
@@ -237,28 +274,48 @@ def call_with_retries(
     retry_if: Optional[Callable[[BaseException], bool]] = None,
     sleep: Callable[[float], None] = time.sleep,
     rand: Optional[Callable[[], float]] = None,
+    deadline: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
 ):
     """Run ``fn()`` with up to ``retries`` backoff retries on transient
     failures.  Each retry increments the ``retry.<site>`` counter in
     ``utils.profiler`` so recovered faults stay visible.  ``retry_if``
     narrows ``retry_on`` (e.g. only coordinator-unreachable RuntimeErrors);
-    ``sleep``/``rand`` are injectable for fake-clock tests."""
+    ``sleep``/``rand``/``clock`` are injectable for fake-clock tests.
+
+    ``deadline`` is a TOTAL-time budget in seconds: cumulative time spent
+    (attempts + backoff sleeps, measured on ``clock``) never exceeds it —
+    a backoff sleep that would overrun the budget is not taken and the
+    last failure re-raises instead.  This caps tail latency where the
+    attempt count alone cannot (attempt durations vary; a slow NFS mount
+    can eat the whole budget in one try).
+
+    Every give-up — attempts exhausted OR deadline overrun — increments
+    ``retry.<site>.exhausted`` before re-raising, so abandoned recoveries
+    are visible post-hoc, not just the successful ones."""
     delays = None
     attempt = 0
+    t0 = clock()
     while True:
         try:
             return fn()
         except retry_on as e:
             if retry_if is not None and not retry_if(e):
                 raise
+            from . import profiler
+
             if attempt >= retries:
+                profiler.counter_inc(f"retry.{site}.exhausted")
                 raise
             if delays is None:
                 delays = list(
                     backoff_schedule(retries, base_delay, factor, max_delay, jitter, rand)
                 )
-            from . import profiler
-
+            if deadline is not None:
+                elapsed = clock() - t0
+                if elapsed + delays[attempt] >= deadline:
+                    profiler.counter_inc(f"retry.{site}.exhausted")
+                    raise
             profiler.counter_inc(f"retry.{site}")
             sleep(delays[attempt])
             attempt += 1

@@ -75,6 +75,7 @@ __all__ = [
     "report",
     "span_summary",
     "flush",
+    "write_counters_line",
     "reset",
 ]
 
@@ -555,6 +556,35 @@ def flush(directory: Optional[str] = None) -> Optional[str]:
     return path
 
 
-# env arming: one check at import, the documented subprocess story
-if os.environ.get("HEAT_TPU_TELEMETRY", "").strip().lower() in ("1", "true", "on", "yes"):
+def write_counters_line(directory: str, rank: int, values: Dict[str, int]) -> str:
+    """Append ONE counters record for ``rank`` to ``{dir}/rank{rank}.jsonl``.
+
+    This is how a process that is NOT a jax rank — the supervising
+    launcher, chiefly — folds its own counters (``watchdog.dumps``,
+    ``watchdog.kills``, ``health.restarts``) into the same multi-rank merge
+    ``scripts/telemetry_report.py`` performs: give it a rank id outside the
+    worker range (launchers use ``n_workers``) so its last-wins counters
+    record never shadows a real rank's.  Stdlib-only, and safe to call from
+    a module loaded standalone (no profiler/jax touch)."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"rank{int(rank)}.jsonl")
+    with open(path, "a") as fh:
+        fh.write(
+            json.dumps(
+                {"type": "counters", "rank": int(rank), "values": dict(values)}
+            )
+            + "\n"
+        )
+    return path
+
+
+# env arming: one check at import, the documented subprocess story.  Gated
+# on __package__: a STANDALONE load of this file (the supervising launcher
+# pulls write_counters_line via spec_from_file_location — a process that
+# must never import jax) is tooling, not the runtime, and must not run
+# enable() (which resolves jax.profiler.TraceAnnotation) nor register an
+# atexit flush into a shared telemetry dir it has no rank in.
+if __package__ and os.environ.get(
+    "HEAT_TPU_TELEMETRY", ""
+).strip().lower() in ("1", "true", "on", "yes"):
     enable()

@@ -1,17 +1,31 @@
-"""Chaos lane: crash-recovery under real process death (ISSUE 2 robustness).
+"""Chaos lane: crash-recovery under real process death (ISSUE 2 robustness;
+elastic restart-with-resume in ISSUE 5).
 
-A victim subprocess is SIGKILLed in the middle of ``save_array_checkpoint``
-— the fault site ``io.write`` is armed (via ``HEAT_TPU_FAULTS``) with a
-per-chunk delay so the kill deterministically lands inside the chunk-write
-loop — and the parent then asserts the previous checkpoint version still
-loads bit-exact.  This is the torn-write scenario the fsync +
-version-then-flip discipline exists for; no amount of in-process mocking
-proves it the way a real SIGKILL does.
+Three scenario families:
+
+- **kill mid-save** (ISSUE 2): a victim subprocess is SIGKILLed in the
+  middle of ``save_array_checkpoint`` — the fault site ``io.write`` is
+  armed (via ``HEAT_TPU_FAULTS``) with a per-chunk delay so the kill
+  deterministically lands inside the chunk-write loop — and the parent
+  then asserts the previous checkpoint version still loads bit-exact.
+- **collective hang** (ISSUE 5): an injected ``comm.collective`` hang
+  under an armed ``comm.deadline`` raises ``CollectiveTimeoutError``
+  within the budget (``health.deadline.trips`` asserted) instead of
+  blocking the suite.
+- **kill-and-resume** (ISSUE 5 acceptance): one rank of a 2-process DASO
+  training world is SIGKILLed mid-training via the ``proc.exit`` fault
+  site; the supervising launcher restarts the world and training resumes
+  from the newest verified checkpoint (``RESUMED epoch=1`` marker),
+  reaching the target step having lost at most ``checkpoint_every``
+  steps.
+
+No amount of in-process mocking proves these the way a real SIGKILL does.
 
 Marked ``chaos`` (+ ``slow``/``heavy``): runs in the dedicated chaos CI job,
 not in the quick verify lane.
 """
 
+import importlib.util
 import os
 import signal
 import subprocess
@@ -24,6 +38,13 @@ import pytest
 pytestmark = [pytest.mark.chaos, pytest.mark.slow, pytest.mark.heavy]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "multiprocess_dryrun_chaos",
+    os.path.join(REPO, "scripts", "multiprocess_dryrun.py"),
+)
+mpd = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(mpd)
 
 # the victim: phase "seed" completes a checkpoint; phase "victim" starts a
 # second save (announcing SAVING first so the parent can time its kill)
@@ -138,3 +159,93 @@ class TestKillMidSave:
         ht.save_array_checkpoint(ht.array(d3, split=0), ckpt)
         back = ht.load_array_checkpoint(ckpt)
         np.testing.assert_array_equal(back.numpy(), d3)
+
+
+class TestCollectiveDeadline:
+    def test_injected_hang_trips_deadline_within_budget(self, ht):
+        """Acceptance (ISSUE 5): an injected collective hang raises
+        ``CollectiveTimeoutError`` within the armed deadline instead of
+        blocking the suite, and ``health.deadline.trips`` records it."""
+        from heat_tpu.utils import faults, health, profiler
+
+        comm = ht.communication.get_comm()
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        base = profiler.counters().get("health.deadline.trips", 0)
+        t0 = time.monotonic()
+        with faults.inject("comm.collective", hang=1):
+            with comm.deadline(1.0):
+                with pytest.raises(health.CollectiveTimeoutError):
+                    comm.Wait(x._jarray)
+        took = time.monotonic() - t0
+        assert took < 10.0, f"deadline trip took {took:.1f}s — watchdog not arming"
+        assert profiler.counters()["health.deadline.trips"] == base + 1
+
+
+class TestKillAndResume:
+    def test_sigkill_rank_mid_daso_training_supervisor_resumes(self):
+        """Acceptance (ISSUE 5): ``kill -9`` of one rank mid-DASO-training →
+        the supervising launcher restarts the world → training resumes from
+        the newest verified checkpoint and reaches the target step, losing
+        at most ``checkpoint_every`` steps."""
+        target, ck_every, kill_step = 12, 3, 5
+        proc = mpd.launch(
+            timeout=700,
+            n_proc=2,
+            devs_per_proc=4,
+            mode="train",
+            extra_env={
+                "MPDRYRUN_TARGET_STEPS": target,
+                "MPDRYRUN_CKPT_EVERY": ck_every,
+                "MPDRYRUN_FAULT_RANK": 1,
+                "MPDRYRUN_FAULT_SPEC": f"proc.exit:exit={kill_step}",
+                "MPDRYRUN_STEP_DELAY": 0.1,
+                "MPDRYRUN_RESTARTS": 2,
+            },
+        )
+        out = proc.stdout
+        assert proc.returncode == 0, (proc.stderr or out)[-3000:]
+        assert mpd.PASS_MARKER in out
+        # the victim really died by SIGKILL and the supervisor saw it
+        assert "rank 1 died with exit code -9" in out, out[-3000:]
+        # exactly one restart: the fault is disarmed on the restarted world
+        assert "SUPERVISOR restarts=1 generations=2" in out, out[-3000:]
+        # both ranks resumed from the newest verified checkpoint, losing at
+        # most ck_every steps (killed at 5 -> checkpoint at 3)
+        resumed_step = kill_step - (kill_step % ck_every)
+        for rank in range(2):
+            assert f"[{rank}] RESUMED epoch=1 step={resumed_step} ok=True" in out, (
+                out[-3000:]
+            )
+            assert f"[{rank}] {mpd.TRAIN_MARKER} steps={target}" in out, out[-3000:]
+        # the watchdog teardown of the wedged survivor is accounted in the
+        # merged telemetry report (the once-dropped return value)
+        assert "watchdog.kills" in out
+        assert "TELEMETRY-MERGED ranks=2" in out, out[-3000:]
+
+    def test_supervised_dryrun_restart_budget_give_up(self):
+        """A rank that dies on EVERY generation exhausts the restart budget
+        and the launcher prints the merged diagnostic report instead of
+        retrying forever."""
+        proc = mpd.launch(
+            timeout=700,
+            n_proc=2,
+            devs_per_proc=4,
+            mode="train",
+            extra_env={
+                "MPDRYRUN_TARGET_STEPS": 8,
+                "MPDRYRUN_CKPT_EVERY": 3,
+                # a persistently bad node: the fault re-arms on EVERY
+                # generation, so every restart dies again and the budget
+                # must run out
+                "MPDRYRUN_FAULT_RANK": 1,
+                "MPDRYRUN_FAULT_SPEC": "proc.exit:exit=2",
+                "MPDRYRUN_FAULT_EVERY_EPOCH": 1,
+                "MPDRYRUN_STEP_DELAY": 0.1,
+                "MPDRYRUN_RESTARTS": 1,
+            },
+        )
+        out = proc.stdout
+        assert proc.returncode != 0
+        assert "SUPERVISOR GAVE UP" in out, out[-3000:]
+        assert "MULTIPROCESS DRYRUN: FAIL" in out
+        assert '"restarts": 1' in out  # budget honored, not a retry loop

@@ -1,0 +1,513 @@
+"""Elastic-runtime health layer tests (ISSUE 5 tentpole).
+
+Covers the three pieces end to end, all on CPU and all fast:
+
+- **heartbeats**: atomic beacon writes, monotonic steps, staleness reads,
+  the ``health.heartbeat.writes`` counter, the background beacon thread;
+- **deadlines**: the ``Deadline`` helper, the ``comm.deadline`` context,
+  the guarded blocking waits (``Wait``/``Barrier``/``host_fetch``) raising
+  ``CollectiveTimeoutError`` on an injected hang instead of wedging the
+  suite, and the staging-time check refusing to stage past an expired
+  deadline;
+- **supervisor**: the restart state machine against real subprocesses —
+  clean run, crash-once-then-restart, budget exhaustion with a diagnostic
+  report, heartbeat-stall detection, generation deadline — plus the
+  ``watchdog.dumps``/``watchdog.kills``/``health.restarts`` accounting;
+- the faults satellites: ``hang=``/``exit=`` modes, the ``proc.exit``
+  SIGKILL site (in a subprocess), and ``call_with_retries``' total-time
+  ``deadline=`` budget with ``retry.<site>.exhausted`` give-up counters.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.parallel import supervisor as sup
+from heat_tpu.utils import faults, health, profiler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------- #
+# Deadline helper
+# ---------------------------------------------------------------------- #
+class TestDeadline:
+    def test_remaining_and_expired(self):
+        dl = health.Deadline(30.0)
+        assert 0 < dl.remaining() <= 30.0
+        assert not dl.expired()
+        gone = health.Deadline(0.0)
+        assert gone.expired() and gone.remaining() <= 0
+
+    def test_check_raises_and_counts(self):
+        base = health.counters().get("health.deadline.trips", 0)
+        dl = health.Deadline(0.0)
+        with pytest.raises(health.CollectiveTimeoutError, match="deadline"):
+            dl.check("comm.Allreduce")
+        assert health.counters()["health.deadline.trips"] == base + 1
+
+    def test_context_arms_and_disarms(self):
+        assert health.active_deadline() is None
+        with health.deadline(5.0) as dl:
+            assert health.active_deadline() is dl
+            # nested: the innermost governs
+            with health.deadline(1.0) as inner:
+                assert health.active_deadline() is inner
+            assert health.active_deadline() is dl
+        assert health.active_deadline() is None
+
+    def test_counters_surface_in_profiler(self):
+        # the health provider mirrors the module-local store into
+        # profiler.counters() pre-prefixed (no double "health." prefix)
+        health.counter_inc("health.deadline.trips", 0)  # force registration
+        c = profiler.counters()
+        assert "health.deadline.trips" in c
+        assert not any(k.startswith("health.health.") for k in c)
+
+
+# ---------------------------------------------------------------------- #
+# heartbeats
+# ---------------------------------------------------------------------- #
+class TestHeartbeat:
+    def test_write_and_read(self, tmp_path):
+        p = str(tmp_path / "rank0.json")
+        health.write_heartbeat(p, 7, status="training")
+        rec = health.read_heartbeat(p)
+        assert rec["step"] == 7 and rec["pid"] == os.getpid()
+        assert rec["status"] == "training" and rec["restart_epoch"] == 0
+        assert abs(rec["time"] - time.time()) < 5
+
+    def test_age_and_missing(self, tmp_path):
+        p = str(tmp_path / "hb.json")
+        assert health.heartbeat_age(p) is None
+        health.write_heartbeat(p, 1)
+        assert health.heartbeat_age(p) < 5
+
+    def test_torn_read_returns_none(self, tmp_path):
+        p = str(tmp_path / "torn.json")
+        with open(p, "w") as fh:
+            fh.write('{"pid": 12')  # torn foreign write
+        assert health.read_heartbeat(p) is None
+
+    def test_beat_monotonic_and_counted(self, tmp_path):
+        base = health.counters().get("health.heartbeat.writes", 0)
+        hb = health.Heartbeat(str(tmp_path / "sub" / "rank1.json"))  # mkdirs
+        hb.beat()
+        hb.beat()
+        hb.beat(step=10)
+        rec = health.read_heartbeat(hb.path)
+        assert rec["step"] == 10
+        assert health.counters()["health.heartbeat.writes"] == base + 3
+        assert profiler.counters()["health.heartbeat.writes"] >= base + 3
+
+    def test_beacon_thread(self, tmp_path):
+        hb = health.Heartbeat(str(tmp_path / "beacon.json"))
+        hb.beat(step=3)
+        with hb:
+            hb.start_beacon(interval=0.05)
+            time.sleep(0.25)
+        rec = health.read_heartbeat(hb.path)
+        assert rec["step"] == 3  # beacon re-beats the CURRENT step
+        assert health.heartbeat_age(hb.path) < 5
+        assert hb._thread is None  # context exit stopped the thread
+
+    def test_beacon_and_beat_race_safely(self, tmp_path):
+        """The beacon thread and the train loop's beat() write concurrently
+        by design — per-thread tmp names keep every rewrite atomic (review
+        finding: a shared tmp let one writer's rename consume the other's
+        file, killing the beacon thread silently)."""
+        hb = health.Heartbeat(str(tmp_path / "race.json"))
+        with hb:
+            hb.start_beacon(interval=0.001)
+            for i in range(300):
+                hb.beat(step=i)
+            time.sleep(0.05)
+            assert hb._thread.is_alive()  # the beacon survived the race
+        rec = health.read_heartbeat(hb.path)
+        assert rec is not None and rec["step"] == 299
+
+
+# ---------------------------------------------------------------------- #
+# guarded collectives (the comm.deadline watchdog)
+# ---------------------------------------------------------------------- #
+class TestGuardedCollectives:
+    def test_wait_passthrough_without_deadline(self, ht):
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        out = ht.communication.get_comm().Wait((x + 1.0)._jarray)
+        np.testing.assert_allclose(np.asarray(out), np.arange(8) + 1.0)
+
+    def test_injected_hang_on_wait_trips(self, ht):
+        comm = ht.communication.get_comm()
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        base = health.counters().get("health.deadline.trips", 0)
+        t0 = time.monotonic()
+        with faults.inject("comm.collective", hang=1):
+            with comm.deadline(0.5):
+                with pytest.raises(health.CollectiveTimeoutError, match="comm.Wait"):
+                    comm.Wait(x._jarray)
+        assert time.monotonic() - t0 < 10  # tripped, did not wedge the suite
+        assert health.counters()["health.deadline.trips"] == base + 1
+
+    def test_injected_hang_on_barrier_trips(self, ht):
+        comm = ht.communication.get_comm()
+        with faults.inject("comm.collective", hang=1):
+            with comm.deadline(0.5):
+                with pytest.raises(health.CollectiveTimeoutError, match="comm.Barrier"):
+                    comm.Barrier()
+
+    def test_injected_hang_on_host_fetch_trips(self, ht):
+        comm = ht.communication.get_comm()
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        with faults.inject("comm.host_fetch", hang=1):
+            with comm.deadline(0.5):
+                with pytest.raises(
+                    health.CollectiveTimeoutError, match="comm.host_fetch"
+                ):
+                    comm.host_fetch(x._jarray)
+
+    def test_injected_hang_at_staging_trips(self, ht):
+        """A hang injected at the comm.collective STAGING site (inside
+        _account) must be caught by the armed deadline like a hang in
+        Wait — not wedge the caller's thread (review finding)."""
+        import jax.numpy as jnp
+
+        comm = ht.communication.get_comm()
+        t0 = time.monotonic()
+        with faults.inject("comm.collective", hang=1):
+            with comm.deadline(0.5):
+                with pytest.raises(health.CollectiveTimeoutError):
+                    comm.shard_map(
+                        lambda a: comm.Allreduce(a), ((1, 0),), (1, None)
+                    )(jnp.arange(float(comm.size)) + 3.0)
+        assert time.monotonic() - t0 < 10
+
+    def test_host_fetch_all_batches(self, ht):
+        comm = ht.communication.get_comm()
+        xs = [ht.arange(8, dtype=ht.float32, split=0)._jarray,
+              ht.ones(4, dtype=ht.float32)._jarray]
+        assert comm.host_fetch_all([]) == []
+        out = comm.host_fetch_all(xs)
+        np.testing.assert_allclose(out[0], np.arange(8, dtype=np.float32))
+        np.testing.assert_allclose(out[1], np.ones(4, dtype=np.float32))
+        # one batched call fires the site ONCE however many leaves
+        faults.reset_trips()
+        with faults.inject("comm.host_fetch", fail=0):
+            comm.host_fetch_all(xs)
+        assert faults.trip_count("comm.host_fetch") == 1
+
+    def test_expired_deadline_refuses_staging(self, ht):
+        import jax.numpy as jnp
+
+        comm = ht.communication.get_comm()
+        with comm.deadline(0.0):
+            time.sleep(0.01)
+            with pytest.raises(health.CollectiveTimeoutError, match="comm.Allreduce"):
+                comm.shard_map(
+                    lambda a: comm.Allreduce(a), ((1, 0),), (1, None)
+                )(jnp.arange(float(comm.size)))
+
+    def test_guard_propagates_real_errors(self):
+        with health.deadline(5.0):
+            with pytest.raises(ZeroDivisionError):
+                health.guard_blocking(lambda: 1 / 0, "test.op")
+
+    def test_guard_returns_value_under_deadline(self):
+        with health.deadline(5.0):
+            assert health.guard_blocking(lambda: 42, "test.op") == 42
+
+    def test_collective_inside_deadline_still_works(self, ht):
+        # a deadline generous enough must not perturb results
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        comm = ht.communication.get_comm()
+        with comm.deadline(60.0):
+            total = float(x.sum().numpy())
+            comm.Barrier()
+        assert total == float(np.arange(16).sum())
+
+
+# ---------------------------------------------------------------------- #
+# faults satellites: hang/exit modes, retry deadline budget
+# ---------------------------------------------------------------------- #
+class TestFaultModes:
+    def test_parse_spec_hang_and_exit(self):
+        specs = faults.parse_spec("comm.collective:hang=1,delay=0.5;proc.exit:exit=3")
+        assert specs["comm.collective"].hang == 1
+        assert specs["comm.collective"].delay == 0.5
+        assert specs["proc.exit"].exit == 3
+        with pytest.raises(ValueError):
+            faults.parse_spec("proc.exit:explode=1")
+
+    @pytest.mark.slow
+    def test_proc_exit_sigkills_subprocess(self):
+        # loads faults.py standalone: stdlib-only, no jax import in the victim
+        code = (
+            "import importlib.util, sys;"
+            "spec = importlib.util.spec_from_file_location('f', sys.argv[1]);"
+            "m = importlib.util.module_from_spec(spec);"
+            "spec.loader.exec_module(m);"
+            "m.fire('proc.exit');"
+            "m.fire('proc.exit');"
+            "print('SURVIVED FIRST');"
+            "m.fire('proc.exit');"
+            "print('NEVER')"
+        )
+        env = dict(os.environ, HEAT_TPU_FAULTS="proc.exit:exit=3")
+        p = subprocess.run(
+            [sys.executable, "-c", code,
+             os.path.join(REPO, "heat_tpu", "utils", "faults.py")],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode == -signal.SIGKILL
+        assert "SURVIVED FIRST" in p.stdout and "NEVER" not in p.stdout
+
+
+class TestRetryDeadlineBudget:
+    def test_budget_caps_cumulative_backoff(self):
+        clk = [0.0]
+        slept = []
+
+        def fake_sleep(d):
+            slept.append(d)
+            clk[0] += d
+
+        base = profiler.counters().get("retry.io.write.exhausted", 0)
+        with faults.inject("io.write", fail=-1):
+            with pytest.raises(faults.TransientFault):
+                faults.call_with_retries(
+                    lambda: faults.fire("io.write"), "io.write",
+                    retries=10, base_delay=1.0, factor=2.0, max_delay=10.0,
+                    jitter=0.0, sleep=fake_sleep, rand=lambda: 0.0,
+                    deadline=3.0, clock=lambda: clk[0],
+                )
+        # slept 1.0; the next 2.0 would overrun the 3.0 budget -> gave up
+        assert slept == [1.0]
+        assert profiler.counters()["retry.io.write.exhausted"] == base + 1
+
+    def test_attempt_exhaustion_also_counts(self):
+        base = profiler.counters().get("retry.io.read.exhausted", 0)
+        with faults.inject("io.read", fail=-1):
+            with pytest.raises(faults.TransientFault):
+                faults.call_with_retries(
+                    lambda: faults.fire("io.read"), "io.read",
+                    retries=2, sleep=lambda _: None,
+                )
+        assert profiler.counters()["retry.io.read.exhausted"] == base + 1
+
+    def test_success_within_budget_unchanged(self):
+        with faults.inject("io.write", fail=2):
+            out = faults.call_with_retries(
+                lambda: faults.fire("io.write") or "done", "io.write",
+                retries=4, sleep=lambda _: None, deadline=100.0,
+            )
+        assert out == "done"
+
+
+# ---------------------------------------------------------------------- #
+# supervisor: the restart state machine against real subprocesses
+# ---------------------------------------------------------------------- #
+def _spawn_code(code: str, hb_dir=None):
+    """A spawn callback running ``python -c code`` with RANK/EPOCH/HB in
+    the environment (the supervisor contract, minus jax)."""
+
+    def spawn(rank, epoch, port):
+        env = dict(os.environ)
+        env["RANK"] = str(rank)
+        env["HEAT_TPU_RESTART_EPOCH"] = str(epoch)
+        if hb_dir:
+            env["HB"] = hb_dir
+        return subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    return spawn
+
+
+class TestSupervisor:
+    def test_clean_world_no_restarts(self):
+        s = sup.Supervisor(
+            _spawn_code("pass"), 2, restart_budget=2, poll_interval=0.05
+        )
+        res = s.run()
+        assert res.ok and res.restarts == 0 and res.generations == 1
+        assert res.returncodes == [0, 0]
+        assert res.counters["health.restarts"] == 0
+
+    def test_crash_once_restarts_with_resume_epoch(self):
+        code = (
+            "import os, sys;"
+            "sys.exit(3 if os.environ['RANK'] == '1' "
+            "and os.environ['HEAT_TPU_RESTART_EPOCH'] == '0' else 0)"
+        )
+        s = sup.Supervisor(_spawn_code(code), 2, restart_budget=2, poll_interval=0.05)
+        res = s.run()
+        assert res.ok and res.restarts == 1 and res.generations == 2
+        assert res.counters["health.restarts"] == 1
+        assert "rank 1 died" in res.failures[0]
+
+    def test_budget_exhaustion_reports(self):
+        s = sup.Supervisor(
+            _spawn_code("import sys; sys.exit(7)"), 2,
+            restart_budget=1, poll_interval=0.05,
+        )
+        res = s.run()
+        assert not res.ok and res.restarts == 1 and res.generations == 2
+        assert len(res.failures) == 2
+        rep = res.report()
+        assert rep["ok"] is False and rep["failures"] == res.failures
+        assert json.loads(json.dumps(rep)) == rep  # merged report is JSON-able
+
+    def test_heartbeat_stall_detected_and_restarted(self, tmp_path):
+        hb_dir = str(tmp_path / "hb")
+        os.makedirs(hb_dir)
+        # epoch 0: both ranks beat once, rank 1 then stalls forever;
+        # epoch 1: everyone beats and exits 0
+        code = (
+            "import os, time;"
+            "open(os.path.join(os.environ['HB'], 'rank%s.json' % os.environ['RANK']),"
+            " 'w').write('{}');"
+            "time.sleep(120) if os.environ['RANK'] == '1' "
+            "and os.environ['HEAT_TPU_RESTART_EPOCH'] == '0' else None"
+        )
+        s = sup.Supervisor(
+            _spawn_code(code, hb_dir=hb_dir), 2,
+            heartbeat_dir=hb_dir, heartbeat_timeout=1.0,
+            restart_budget=1, poll_interval=0.1,
+        )
+        t0 = time.monotonic()
+        res = s.run()
+        assert res.ok and res.restarts == 1
+        assert "heartbeat stale" in res.failures[0]
+        assert res.counters["watchdog.dumps"] >= 1  # the stalled rank was reaped
+        assert time.monotonic() - t0 < 60
+
+    def test_never_beats_measured_from_generation_start(self, tmp_path):
+        hb_dir = str(tmp_path / "hb")
+        code = (
+            "import os, time;"
+            "time.sleep(120) if os.environ['HEAT_TPU_RESTART_EPOCH'] == '0' else None"
+        )
+        s = sup.Supervisor(
+            _spawn_code(code, hb_dir=hb_dir), 1,
+            heartbeat_dir=hb_dir, heartbeat_timeout=1.0,
+            restart_budget=1, poll_interval=0.1,
+        )
+        res = s.run()
+        assert res.ok and res.restarts == 1
+        assert "heartbeat stale" in res.failures[0]
+
+    def test_generation_deadline_aborts(self):
+        s = sup.Supervisor(
+            _spawn_code("import time; time.sleep(120)"), 1,
+            restart_budget=0, generation_deadline=1.0, poll_interval=0.1,
+        )
+        t0 = time.monotonic()
+        res = s.run()
+        assert not res.ok
+        assert "deadline" in res.failures[0]
+        assert time.monotonic() - t0 < 30
+
+    def test_free_port_is_bindable(self):
+        import socket
+
+        port = sup.free_port()
+        s = socket.socket()
+        s.bind(("127.0.0.1", port))
+        s.close()
+
+    def test_dump_stacks_then_kill_counts(self):
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import signal, time; signal.signal(signal.SIGUSR1, lambda *a: None);"
+             "print('up', flush=True); time.sleep(120)"],
+            stdout=subprocess.PIPE,
+        )
+        p.stdout.readline()  # SIGUSR1 handler installed
+        d = sup.dump_stacks_then_kill([p], grace=0.5)
+        p.wait()
+        assert d == {"dumps": 1, "kills": 1}
+        done = subprocess.Popen([sys.executable, "-c", "pass"])
+        done.wait()
+        assert sup.dump_stacks_then_kill([done]) == {"dumps": 0, "kills": 0}
+
+
+# ---------------------------------------------------------------------- #
+# launcher-side plumbing
+# ---------------------------------------------------------------------- #
+class TestLauncherStaysJaxFree:
+    def test_standalone_telemetry_load_never_imports_jax(self):
+        """The supervising launcher standalone-loads telemetry.py for
+        write_counters_line; even with HEAT_TPU_TELEMETRY=1 in the
+        environment the import-time env arming must NOT fire (it resolves
+        jax.profiler) — the launcher process never imports jax (review
+        finding)."""
+        code = (
+            "import importlib.util, sys, os;"
+            "spec = importlib.util.spec_from_file_location('t', sys.argv[1]);"
+            "m = importlib.util.module_from_spec(spec);"
+            "sys.modules['t'] = m;"
+            "spec.loader.exec_module(m);"
+            "assert not m.enabled(), 'env arming fired on a standalone load';"
+            "assert 'jax' not in sys.modules, 'launcher imported jax';"
+            "p = m.write_counters_line(sys.argv[2], 2, {'watchdog.kills': 1});"
+            "assert 'jax' not in sys.modules;"
+            "print(open(p).read().strip())"
+        )
+        import tempfile
+
+        tdir = tempfile.mkdtemp()
+        env = dict(os.environ, HEAT_TPU_TELEMETRY="1",
+                   HEAT_TPU_TELEMETRY_DIR=tdir)
+        p = subprocess.run(
+            [sys.executable, "-c", code,
+             os.path.join(REPO, "heat_tpu", "utils", "telemetry.py"), tdir],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        rec = json.loads(p.stdout.strip())
+        assert rec == {"type": "counters", "rank": 2,
+                       "values": {"watchdog.kills": 1}}
+
+    def test_write_counters_line_merges(self, tmp_path):
+        """The launcher's counters line folds into the multi-rank merge as
+        its own rank (never shadowing a real rank's last-wins counters)."""
+        import importlib.util
+
+        from heat_tpu.utils import telemetry
+
+        telemetry.write_counters_line(str(tmp_path), 0, {"comm.x.calls": 5})
+        telemetry.write_counters_line(str(tmp_path), 2, {"watchdog.kills": 1})
+        spec = importlib.util.spec_from_file_location(
+            "trep_health", os.path.join(REPO, "scripts", "telemetry_report.py")
+        )
+        trep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(trep)
+        merged = trep.merge_files(trep.find_rank_files(str(tmp_path)))
+        assert merged["ranks"] == [0, 2]
+        assert merged["counters"]["comm.x.calls"] == 5
+        assert merged["counters"]["watchdog.kills"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# bootstrap integration
+# ---------------------------------------------------------------------- #
+class TestRestartEpoch:
+    def test_default_zero(self, monkeypatch):
+        monkeypatch.delenv("HEAT_TPU_RESTART_EPOCH", raising=False)
+        assert ht.core.bootstrap.restart_epoch() == 0
+
+    def test_reads_env(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RESTART_EPOCH", "3")
+        assert ht.core.bootstrap.restart_epoch() == 3
+        assert health.restart_epoch() == 3
+
+    def test_garbage_env_is_zero(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RESTART_EPOCH", "banana")
+        assert ht.core.bootstrap.restart_epoch() == 0

@@ -26,6 +26,7 @@ from heat_tpu.analysis.rules import (
     CollectiveAccountingRule,
     HostSyncRule,
     MetadataMutationRule,
+    NakedBlockingWaitRule,
     RankConditionalCollectiveRule,
     RawEntropyRule,
     UseAfterDonateRule,
@@ -422,6 +423,83 @@ class TestHT106:
 
 
 # ---------------------------------------------------------------------- #
+# HT107 — naked blocking collective wait bypassing comm.deadline
+# ---------------------------------------------------------------------- #
+class TestHT107:
+    def test_naked_barrier_flagged(self):
+        fs = run_rule(NakedBlockingWaitRule(), """
+            def f(comm):
+                comm.Barrier()
+        """)
+        assert [f.detail for f in fs] == ["Barrier"]
+        assert fs[0].rule == "HT107"
+
+    def test_naked_wait_and_block_until_ready_flagged(self):
+        fs = run_rule(NakedBlockingWaitRule(), """
+            import jax
+            def f(comm, x):
+                comm.Wait(x)
+                jax.block_until_ready(x)
+        """)
+        assert sorted(f.detail for f in fs) == ["Wait", "block_until_ready"]
+
+    def test_sync_global_devices_flagged(self):
+        fs = run_rule(NakedBlockingWaitRule(), """
+            from jax.experimental import multihost_utils
+            def f():
+                multihost_utils.sync_global_devices("tag")
+        """)
+        assert [f.detail for f in fs] == ["sync_global_devices"]
+
+    def test_under_deadline_not_flagged(self):
+        fs = run_rule(NakedBlockingWaitRule(), """
+            def f(comm, x):
+                with comm.deadline(30.0):
+                    comm.Wait(x)
+                    comm.Barrier()
+        """)
+        assert fs == []
+
+    def test_health_deadline_context_not_flagged(self):
+        fs = run_rule(NakedBlockingWaitRule(), """
+            from heat_tpu.utils import health
+            def f(comm, x):
+                with health.deadline(5.0) as dl:
+                    comm.Wait(x)
+        """)
+        assert fs == []
+
+    def test_wrapper_modules_sanctioned(self):
+        src = """
+            import jax
+            def Wait(x):
+                return jax.block_until_ready(x)
+        """
+        assert run_rule(
+            NakedBlockingWaitRule(), src, path="heat_tpu/core/communication.py"
+        ) == []
+        assert run_rule(
+            NakedBlockingWaitRule(), src, path="heat_tpu/utils/health.py"
+        ) == []
+
+    def test_foreign_barrier_api_not_flagged(self):
+        # threading.Barrier(3) etc: Barrier WITH arguments is not the fence
+        fs = run_rule(NakedBlockingWaitRule(), """
+            import threading
+            def f():
+                b = threading.Barrier(3)
+        """)
+        assert fs == []
+
+    def test_suppression_works(self):
+        fs = run_rule(NakedBlockingWaitRule(), """
+            def f(comm):
+                comm.Barrier()  # heatlint: disable=HT107 teardown fence
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------- #
 # framework: suppressions, baseline, discovery, CLI
 # ---------------------------------------------------------------------- #
 class TestFramework:
@@ -457,7 +535,9 @@ class TestFramework:
 
     def test_all_rules_registered(self):
         codes = [r.code for r in all_rules()]
-        assert codes == ["HT101", "HT102", "HT103", "HT104", "HT105", "HT106"]
+        assert codes == [
+            "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
+        ]
 
     def test_select_unknown_rule_raises(self):
         with pytest.raises(ValueError, match="unknown rule"):
