@@ -81,11 +81,14 @@ sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!
 import heat_tpu as ht
 x = ht.zeros(({size}, {size}), split=0)
 x += 1.0  # touch every page
+# memory_budget=0 pins the MONOLITHIC path regardless of any
+# HEAT_TPU_RESPLIT_BUDGET / process default in the inherited env —
+# these rows are labeled monolithic and must measure it
 if {mode!r} == "inplace":
-    x.resplit_(1)       # donating path
+    x.resplit_(1, memory_budget=0)       # donating path
     out = x
 else:
-    out = x.resplit(1)  # copying path (source stays live)
+    out = x.resplit(1, memory_budget=0)  # copying path (source stays live)
 ht.utils.profiler.sync(out)
 print(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0)
 """
@@ -96,6 +99,69 @@ print(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0)
         return float(r.stdout.strip().splitlines()[-1])
     except Exception:
         return float("nan")
+
+
+def _peak_rss_resplit(shape, budget_bytes, mode: str) -> dict:
+    """Budgeted-resplit peak-RSS capture in a fresh process: build a 3-d
+    f32 array split 0, touch every page, record the pre-transfer RSS
+    high-water mark (``base``, source included), resplit to split 1 under
+    ``budget_bytes`` (``mode='budgeted'``) or monolithically
+    (``mode='copy'``/``'inplace'``), and report the post-transfer peak plus
+    the plan shape read back from the telemetry counters."""
+    code = f"""
+import json, os, resource, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import heat_tpu as ht
+from heat_tpu.utils import profiler
+shape, budget, mode = {tuple(shape)!r}, {int(budget_bytes)}, {mode!r}
+x = ht.zeros(shape, split=0)
+x += 1.0  # touch every page
+# completion fence WITHOUT materialization: profiler.sync would device_get
+# the sharded array — a host-side full copy (~1 GB on this mesh) that has
+# nothing to do with the transfer being measured
+jax.block_until_ready(x._parray)
+base_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+profiler.reset_counters()
+if mode == "budgeted":
+    x.resplit_(1, memory_budget=budget)
+    out = x
+elif mode == "inplace":
+    # memory_budget=0 pins the monolithic path even when the inherited env
+    # carries HEAT_TPU_RESPLIT_BUDGET — the comparison row must not stream
+    x.resplit_(1, memory_budget=0)
+    out = x
+else:
+    out = x.resplit(1, memory_budget=0)
+jax.block_until_ready(out._parray)
+c = profiler.counters()
+print(json.dumps({{
+    "base_mb": base_mb,
+    "peak_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+    "tiles": c.get("comm.resplit.tiles", 0),
+    "peak_tile_bytes": c.get("comm.resplit.peak_tile_bytes", 0),
+    "resplit_bytes": c.get("comm.resplit.bytes", 0),
+}}))
+"""
+    r = None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=600
+        )
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        # surface the capture's own diagnostics: a NaN payload without them
+        # reads as "planner fell back to monolithic?" when the subprocess
+        # actually died of an import error / OOM kill / timeout
+        print(f"resplit RSS capture ({mode}) failed: {exc!r}", file=sys.stderr)
+        if r is not None:
+            print(f"  returncode={r.returncode}", file=sys.stderr)
+            if r.stderr:
+                print(r.stderr[-2000:], file=sys.stderr)
+        return {"base_mb": float("nan"), "peak_mb": float("nan"), "tiles": 0,
+                "peak_tile_bytes": 0, "resplit_bytes": 0}
 
 
 def main(argv=None) -> int:
@@ -109,6 +175,25 @@ def main(argv=None) -> int:
                     help="exit 4 if telemetry-on adds more than PCT%% to the "
                          "dispatch cost above the compiled-program floor "
                          "(the CI telemetry lane's 5%% overhead contract)")
+    ap.add_argument("--resplit-gate", action="store_true",
+                    help="run the budgeted-resplit peak-RSS gate: exit 5 when "
+                         "the chunked pipeline's peak RSS exceeds "
+                         "base + destination + budget + one tile (+ slack)")
+    ap.add_argument("--resplit-out", default=None, metavar="PATH",
+                    help="write the resplit-gate payload here "
+                         "(committed capture: BENCH_RESPLIT.json)")
+    ap.add_argument("--resplit-shape", type=int, nargs=3, default=(1024, 1024, 16),
+                    metavar=("R", "C", "D"),
+                    help="3-d f32 array for the resplit gate (split 0 -> 1, "
+                         "tiled along axis 2); default 64 MB")
+    ap.add_argument("--resplit-budget-mb", type=float, default=16.0,
+                    help="per-step byte budget for the gate")
+    ap.add_argument("--resplit-slack-mb", type=float, default=48.0,
+                    help="allocator/runtime slack added to the gate bound "
+                         "(XLA CPU working memory + per-plan compile spikes "
+                         "are not byte-exact; 48 MB keeps the gate below the "
+                         "64 MB whole-array-staging regression it exists to "
+                         "catch)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
@@ -239,21 +324,28 @@ def main(argv=None) -> int:
 
     # both variants alternate 0→1 and 1→0 so each per-call figure is the
     # same direction mix
+    # memory_budget=0 pins the monolithic path throughout: these rows are
+    # labeled monolithic and must not silently stream under an inherited
+    # HEAT_TPU_RESPLIT_BUDGET / process default
     r = ht.random.randn(n, n, split=0)
-    r.resplit_(1)  # warm both directions
-    r.resplit_(0)
+    r.resplit_(1, memory_budget=0)  # warm both directions
+    r.resplit_(0, memory_budget=0)
 
     def flip():
-        r.resplit_(1 if r.split == 0 else 0)
+        r.resplit_(1 if r.split == 0 else 0, memory_budget=0)
         return r
 
     rc0 = ht.random.randn(n, n, split=0)
-    rc1 = rc0.resplit(1)
+    rc1 = rc0.resplit(1, memory_budget=0)
     copy_state = [0]
 
     def copy_flip():
         copy_state[0] ^= 1
-        return (rc0.resplit(1) if copy_state[0] else rc1.resplit(0))
+        return (
+            rc0.resplit(1, memory_budget=0)
+            if copy_state[0]
+            else rc1.resplit(0, memory_budget=0)
+        )
 
     # batch=1 (sync every call): in-place resplits form a serial dependency
     # chain, so batching would let only the copy variant overlap transfers
@@ -266,6 +358,81 @@ def main(argv=None) -> int:
         rss_size = 2048
         rss_inplace = _peak_rss_subprocess("inplace", rss_size)
         rss_copy = _peak_rss_subprocess("copy", rss_size)
+
+    # --- budgeted-resplit peak-RSS gate (ISSUE 6) ---------------------- #
+    # the memory contract of the chunked pipeline, measured: beyond the
+    # source (inside base) and the preallocated destination, the transient
+    # working set is at most budget + one tile.  The monolithic copy path
+    # is captured side by side as the comparison row.
+    resplit_gate_ok = True
+    resplit_payload = None
+    if args.resplit_gate or args.resplit_out:
+        shape = tuple(args.resplit_shape)
+        budget = int(args.resplit_budget_mb * 1024 * 1024)
+        # ONE unit everywhere: MiB, matching ru_maxrss/1024 (base_mb/peak_mb)
+        # and budget_mb — mixing in decimal MB here loosened the bound by
+        # ~4 MB and understated the reported transient by ~3 MiB
+        arr_mb = (shape[0] * shape[1] * shape[2] * 4) / 2**20
+        bud = _peak_rss_resplit(shape, budget, "budgeted")
+        mono = _peak_rss_resplit(shape, 0, "copy")
+        tile_mb = bud["peak_tile_bytes"] / 2**20
+        # base already contains the source; the destination is a hard
+        # requirement of ANY resplit, so the gate bound is
+        # base + |dst| + budget + one tile + allocator slack
+        allowed_mb = (
+            bud["base_mb"] + arr_mb + args.resplit_budget_mb + tile_mb
+            + args.resplit_slack_mb
+        )
+        transient_mb = bud["peak_mb"] - bud["base_mb"] - arr_mb
+        resplit_payload = {
+            "metric": "resplit_budgeted_transient_mb",
+            "value": round(transient_mb, 1),
+            "unit": "MB above source+destination (bound: budget + one tile)",
+            "vs_baseline": None,
+            "extra": {
+                "platform": platform,
+                "n_devices": n_dev,
+                "array_shape": list(shape),
+                "array_mb": round(arr_mb, 1),
+                "budget_mb": args.resplit_budget_mb,
+                "tiles": bud["tiles"],
+                "peak_tile_mb": round(tile_mb, 1),
+                "gate_allowed_peak_rss_mb": round(allowed_mb, 1),
+                "budgeted_base_rss_mb_snapshot": round(bud["base_mb"], 1),
+                "budgeted_peak_rss_mb_snapshot": round(bud["peak_mb"], 1),
+                "monolithic_copy_peak_rss_mb_snapshot": round(mono["peak_mb"], 1),
+                "monolithic_copy_transient_mb_snapshot": round(
+                    mono["peak_mb"] - mono["base_mb"] - arr_mb, 1
+                ),
+                "resplit_bytes_accounted": bud["resplit_bytes"],
+                "slack_mb": args.resplit_slack_mb,
+                "provenance": "benchmarks/dispatch.py --resplit-gate, fresh "
+                              "subprocess per capture (allocator history "
+                              "cannot pollute the peak)",
+            },
+        }
+        print(json.dumps(resplit_payload, indent=1))
+        if bud["tiles"] < 2:
+            resplit_gate_ok = False
+            print(
+                f"RESPLIT GATE: expected a chunked plan, got tiles={bud['tiles']}"
+                " (planner fell back to monolithic?)",
+                file=sys.stderr,
+            )
+        if not (bud["peak_mb"] <= allowed_mb):  # NaN-safe: fails on nan
+            resplit_gate_ok = False
+            print(
+                f"RESPLIT GATE: budgeted resplit peaked at {bud['peak_mb']:.0f} MB"
+                f" > allowed {allowed_mb:.0f} MB (base {bud['base_mb']:.0f}"
+                f" + dst {arr_mb:.0f} + budget {args.resplit_budget_mb:.0f}"
+                f" + tile {tile_mb:.0f} + slack {args.resplit_slack_mb:.0f})",
+                file=sys.stderr,
+            )
+        if args.resplit_out:
+            with open(args.resplit_out, "w") as fh:
+                json.dump(resplit_payload, fh, indent=1)
+        if not args.resplit_gate:
+            resplit_gate_ok = True  # capture-only run: report, don't gate
 
     # Row-name scheme (scripts/bench_compare.py infers direction by name):
     # the TRACKED contract rows are the host-portable ratios (*_speedup,
@@ -337,7 +504,13 @@ def main(argv=None) -> int:
         flushed = telemetry.flush()
         if flushed:
             print(f"telemetry flushed to {flushed}", file=sys.stderr)
-    return 0 if ok and gate_ok else (3 if not ok else 4)
+    if not ok:
+        return 3
+    if not gate_ok:
+        return 4
+    if not resplit_gate_ok:
+        return 5
+    return 0
 
 
 if __name__ == "__main__":

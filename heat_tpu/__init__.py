@@ -10,6 +10,7 @@ flat namespace.
 from .core import *
 from . import core
 from .core import random
+from .core.redistribution import set_redistribution_budget, get_redistribution_budget
 from . import linalg
 from .linalg import matmul, dot, transpose, norm  # hoist reference's flat exports
 from .linalg.basics import outer, trace, tril, triu, vdot, cross, projection, vector_norm, matrix_norm, einsum, einsum_path, kron, inner, tensordot, vecdot

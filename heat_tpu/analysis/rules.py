@@ -456,9 +456,14 @@ class UseAfterDonateRule(Rule):
 @register
 class CollectiveAccountingRule(Rule):
     """Every public collective in ``communication.py`` must byte-account at
-    its entry (``self._account(...)``) or delegate to another public
-    collective that does — the telemetry round's invariant that no staged
-    collective traffic is invisible to ``comm.<name>.calls/.bytes``."""
+    its entry (``self._account(...)`` / ``self._account_bytes(...)``) or
+    delegate to another public collective that does — the telemetry round's
+    invariant that no staged collective traffic is invisible to
+    ``comm.<name>.calls/.bytes``.  The tiled-redistribution entry points
+    (``resplit*``) may instead delegate to the chunked executor
+    (``core.redistribution.execute_plan``), which byte-accounts every tile
+    at its own staging point through ``_account_bytes`` — per-tile staging
+    behind that entry is accounted, not invisible."""
 
     code = "HT104"
     name = "unaccounted-collective"
@@ -468,6 +473,11 @@ class CollectiveAccountingRule(Rule):
     # public-but-not-traffic: Wait is a completion fence, Barrier moves one
     # scalar token (accounting it would pollute the traffic metric)
     EXEMPT = {"Wait", "Barrier"}
+    # direct accounting calls at a collective's staging entry
+    ACCOUNT_CALLS = {"self._account", "self._account_bytes"}
+    # the tiled executor: accounts each tile exactly once via _account_bytes
+    # (core/redistribution.py), so delegating to it IS accounting
+    TILED_EXECUTORS = {"execute_plan"}
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:
         if not module_matches(ctx.path, self.TARGET_SUFFIX):
@@ -480,7 +490,7 @@ class CollectiveAccountingRule(Rule):
                 if not isinstance(fn, ast.FunctionDef):
                     continue
                 is_mpi_name = fn.name[:1].isupper()
-                if not (is_mpi_name or fn.name == "resplit"):
+                if not (is_mpi_name or fn.name.startswith("resplit")):
                     continue
                 if fn.name in self.EXEMPT:
                     continue
@@ -488,15 +498,21 @@ class CollectiveAccountingRule(Rule):
                 for node in ast.walk(fn):
                     if isinstance(node, ast.Call):
                         dn = call_name(node)
-                        if dn == "self._account":
+                        if dn in self.ACCOUNT_CALLS:
                             accounted = True
                             break
                         la = last_attr(node)
+                        if la in self.TILED_EXECUTORS and fn.name.startswith("resplit"):
+                            # scoped to the resplit* entries: a future public
+                            # collective calling something named execute_plan
+                            # must still account its own traffic
+                            accounted = True  # per-tile accounting in the executor
+                            break
                         if (
                             dn
                             and dn.startswith("self.")
                             and la
-                            and la[:1].isupper()
+                            and (la[:1].isupper() or la.startswith("resplit"))
                             and la != fn.name
                             and la not in self.EXEMPT
                         ):

@@ -450,7 +450,11 @@ class Communication:
     # redistribution — the reference's Alltoallv-based resplit_
     # ------------------------------------------------------------------ #
     def resplit(
-        self, array: jax.Array, split: Optional[int], donate: bool = False
+        self,
+        array: jax.Array,
+        split: Optional[int],
+        donate: bool = False,
+        memory_budget: Optional[int] = None,
     ) -> jax.Array:
         """Redistribute a global array to a new split axis.
 
@@ -458,6 +462,15 @@ class Communication:
         memory-efficient reshard of arXiv 2112.01075); the reference does the
         same thing by hand with derived datatypes + ``Alltoallv``
         (``DNDarray.resplit_``, SURVEY §3.3).
+
+        ``memory_budget`` (bytes; ``None`` → the process default set via
+        ``heat_tpu.set_redistribution_budget()`` / ``HEAT_TPU_RESPLIT_BUDGET``)
+        bounds the bytes moved per step: when the transition is tileable and
+        the array exceeds the budget, the transfer runs as the chunked
+        pipeline of ``core.redistribution`` — K tiled all-to-alls along a
+        non-split axis, each ≤ budget bytes, destination written in place,
+        transient memory ≤ budget + one tile beyond source + destination.
+        K=1 (or no budget) degenerates to the monolithic fast path below.
 
         ``donate=True`` (the in-place ``resplit_`` path) hands the source
         buffer to the transfer (``jax.device_put(..., donate=True)``): the
@@ -467,25 +480,36 @@ class Communication:
         use ``array`` afterwards.  Donation falls back to the plain path
         for tracers, hosted-complex arrays, ragged extents and
         multi-process meshes (where placement goes through host assembly
-        anyway).
+        anyway) — counted under ``comm.resplit.donate_fallbacks`` when the
+        running jax lacks the ``donate`` kwarg, so a peak-memory regression
+        is attributable to the silently-lost donation.
 
         Telemetry: every resharding call counts under
         ``comm.resplit.calls``/``.bytes`` (the all-to-all moves (p-1)/p of
-        the GLOBAL payload — the known hot spot of redistribution traffic),
-        and the eager transfer runs under a ``comm.resplit`` span when
-        telemetry is enabled.  A no-op call (the array already carries the
-        target sharding) moves nothing and is NOT counted — defensive
-        resplit calls must not inflate the traffic metric.
+        the GLOBAL payload — the known hot spot of redistribution traffic;
+        a chunked transfer accounts per tile, summing to the identical
+        total), plus ``comm.resplit.tiles``/``.peak_tile_bytes`` for the
+        plan shape, and the eager transfer runs under a ``comm.resplit``
+        span when telemetry is enabled.  A no-op call (the array already
+        carries the target sharding) moves nothing and is NOT counted —
+        defensive resplit calls must not inflate the traffic metric.
         """
         if self._already_placed(array, split):
             return array
+        from . import redistribution as _redist
+
+        plan = _redist.make_plan(self, array, split, memory_budget)
+        if plan is not None and plan.n_tiles > 1:
+            return self.resplit_tiled(array, split, donate=donate, _plan=plan)
         self._account("resplit", array, (self.size - 1) / self.size)
         tel = _telemetry()
+        tel.counter_inc("comm.resplit.tiles", 1)
         with tel.span(
             "comm.resplit",
             split=split,
             donate=donate,
             nbytes=_payload_nbytes(array),
+            tiles=1,
         ):
             if donate and self._donatable(array, split):
                 # no already-placed test here: _already_placed() at the top
@@ -494,12 +518,79 @@ class Communication:
                 try:
                     out = jax.device_put(array, sh, donate=True)
                 except TypeError:  # jax without the donate kwarg
+                    self._note_donate_fallback()
                     out = jax.device_put(array, sh)
             else:
                 out = self.shard(array, split)
             if _RESPLIT_CHECK is not None:
                 _RESPLIT_CHECK(out, self, split, where="comm.resplit")
             return out
+
+    def resplit_tiled(
+        self,
+        array: jax.Array,
+        split: Optional[int],
+        memory_budget: Optional[int] = None,
+        donate: bool = False,
+        _plan=None,
+    ) -> jax.Array:
+        """Explicit tiled-redistribution entry: stream ``array`` to ``split``
+        in budget-bounded tiles (``core.redistribution.execute_plan``).
+
+        ``resplit`` routes here whenever a budget yields K>1; calling it
+        directly forces the planner with ``memory_budget`` and degenerates
+        to :meth:`resplit` when the transition is not tileable.  Byte
+        accounting happens PER TILE at the executor's staging points (one
+        ``_account_bytes`` per tile — telescoped so the ``comm.resplit.bytes``
+        total is identical to the monolithic path's), which also gives every
+        tile the ``comm.collective`` fault site and ``comm.deadline``
+        refusal/watchdog semantics — a hung tile trips the deadline instead
+        of wedging the plan."""
+        from . import redistribution as _redist
+
+        plan = _plan
+        if plan is None:
+            if self._already_placed(array, split):
+                return array
+            plan = _redist.make_plan(self, array, split, memory_budget)
+        if plan is None or plan.n_tiles <= 1:
+            return self.resplit(array, split, donate=donate, memory_budget=0)
+        tel = _telemetry()
+        with tel.span(
+            "comm.resplit",
+            split=split,
+            donate=donate,
+            nbytes=_payload_nbytes(array),
+            tiles=plan.n_tiles,
+            tile_axis=plan.tile_axis,
+            budget=plan.budget,
+        ):
+            out = _redist.execute_plan(self, array, plan, donate=donate)
+            if _RESPLIT_CHECK is not None:
+                _RESPLIT_CHECK(out, self, split, where="comm.resplit_tiled")
+            return out
+
+    # one-time-per-process warning flag for the lost-donation fallback
+    _DONATE_FALLBACK_WARNED = False
+
+    def _note_donate_fallback(self) -> None:
+        """The running jax's ``device_put`` lacks ``donate=`` — the in-place
+        resplit silently degraded to a copying transfer.  Counted under
+        ``comm.resplit.donate_fallbacks`` (every occurrence) and warned once
+        per process, so a peak-memory regression on an old jax is
+        attributable instead of invisible."""
+        from ..utils import profiler as _profiler
+
+        _profiler.counter_inc("comm.resplit.donate_fallbacks")
+        if not Communication._DONATE_FALLBACK_WARNED:
+            Communication._DONATE_FALLBACK_WARNED = True
+            warnings.warn(
+                "jax.device_put does not support donate=: in-place resplit "
+                "falls back to a copying transfer (peak memory ~2x the "
+                "array). Upgrade jax to recover donation; occurrences are "
+                "counted under comm.resplit.donate_fallbacks.",
+                stacklevel=4,
+            )
 
     def _already_placed(self, array, split: Optional[int]) -> bool:
         """True when ``array`` is concrete and already carries exactly the
@@ -559,6 +650,15 @@ class Communication:
         under a deadline the fire runs inside ``guard_blocking``, so a
         ``hang=`` injection trips ``CollectiveTimeoutError`` exactly like
         a hang in ``Wait`` would, instead of wedging the caller's thread."""
+        self._account_bytes(name, int(round(_payload_nbytes(x) * factor)))
+
+    def _account_bytes(self, name: str, wire_bytes: int) -> None:
+        """The staging choke point itself, taking pre-computed WIRE bytes:
+        :meth:`_account` (payload × factor) and the tiled-resplit executor
+        (telescoped per-tile bytes, ``core.redistribution.execute_plan``)
+        both land here, so fault injection, deadline refusal and byte
+        accounting cover every staged collective — monolithic or per-tile —
+        through one code path."""
         from ..utils import faults as _flt  # lazy: core imports before utils
 
         hlth = _health()
@@ -570,7 +670,7 @@ class Communication:
             hlth.guard_blocking(
                 lambda: _flt.fire("comm.collective"), f"comm.{name}"
             )
-        _telemetry().account_collective(name, _payload_nbytes(x) * factor)
+        _telemetry().account_collective(name, wire_bytes)
 
     def _warn_gather_based(self, name: str) -> None:
         """Perf-trap warning (reference: ``warnings.warn`` on implicit-comm

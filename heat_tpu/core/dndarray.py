@@ -482,7 +482,9 @@ class DNDarray:
         ``is_balanced()`` may legitimately stay False for ragged shapes; that
         reports the ceil-div chunk asymmetry, not a repairable state."""
 
-    def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
+    def resplit_(
+        self, axis: Optional[int] = None, memory_budget: Optional[int] = None
+    ) -> "DNDarray":
         """In-place redistribution to a new split axis (reference SURVEY §3.3).
 
         Lowered by XLA to an all-to-all (split↔split) or allgather (→None);
@@ -491,6 +493,13 @@ class DNDarray:
         permitting, XLA aliases or early-frees it), so other DNDarrays
         sharing this array's buffer — ``astype(copy=False)`` views — must
         not be read afterwards.  Use ``resplit()`` for the copying form.
+
+        ``memory_budget`` (bytes; ``None`` → the process default from
+        ``ht.set_redistribution_budget()`` / ``HEAT_TPU_RESPLIT_BUDGET``)
+        bounds the bytes moved per step: an oversized transition streams as
+        K budget-sized tiled all-to-alls with the destination written in
+        place and the source freed as soon as its last tile is staged (see
+        ``core.redistribution``).
         """
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
@@ -500,11 +509,15 @@ class DNDarray:
         self.__pad = 0
         self.__unpadded = None
         if axis is None:
-            self.__array = self.__comm.resplit(logical, None, donate=True)
+            self.__array = self.__comm.resplit(
+                logical, None, donate=True, memory_budget=memory_budget
+            )
         else:
             self._renormalize(logical)
             if self.__pad == 0:
-                self.__array = self.__comm.resplit(self.__array, axis, donate=True)
+                self.__array = self.__comm.resplit(
+                    self.__array, axis, donate=True, memory_budget=memory_budget
+                )
         from . import sanitation  # lazy: sanitation imports this module
 
         return sanitation.check(self, "resplit_")
@@ -541,10 +554,12 @@ class DNDarray:
             self.__array = self.__comm.pad_shard(self._jarray, self.__split)
             self.__unpadded = None
 
-    def resplit(self, axis: Optional[int] = None) -> "DNDarray":
+    def resplit(
+        self, axis: Optional[int] = None, memory_budget: Optional[int] = None
+    ) -> "DNDarray":
         from . import manipulations
 
-        return manipulations.resplit(self, axis)
+        return manipulations.resplit(self, axis, memory_budget=memory_budget)
 
     def cpu(self) -> "DNDarray":
         from . import devices as _dev

@@ -461,12 +461,20 @@ def reshape(x: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> D
     return _wrap(res, new_split, x)
 
 
-def resplit(x: DNDarray, axis: Optional[int] = None) -> DNDarray:
-    """Out-of-place redistribution to a new split axis (→ XLA all-to-all)."""
+def resplit(
+    x: DNDarray, axis: Optional[int] = None, memory_budget: Optional[int] = None
+) -> DNDarray:
+    """Out-of-place redistribution to a new split axis (→ XLA all-to-all).
+
+    ``memory_budget`` (bytes; ``None`` → the process default from
+    ``ht.set_redistribution_budget()`` / ``HEAT_TPU_RESPLIT_BUDGET``) bounds
+    the bytes moved per step: oversized transitions stream as K budget-sized
+    tiled all-to-alls instead of one monolithic transfer (see
+    ``core.redistribution``)."""
     from . import sanitation
 
     axis = sanitize_axis(x.shape, axis)
-    arr = x.comm.resplit(x._jarray, axis)
+    arr = x.comm.resplit(x._jarray, axis, memory_budget=memory_budget)
     return sanitation.check(
         DNDarray(arr, x.gshape, x.dtype, axis, x.device, x.comm, True), "resplit"
     )
@@ -1082,9 +1090,18 @@ def choose(a: DNDarray, choices, mode: str = "raise") -> DNDarray:
     jch = [c._jarray if isinstance(c, DNDarray) else jnp.asarray(np.asarray(c)) for c in choices]
     if mode == "raise":
         # numpy contract: out-of-range selectors are an error; validate
-        # eagerly (one cheap reduction), then index with clip semantics
-        lo = int(jnp.min(a._jarray)) if a.size else 0
-        hi = int(jnp.max(a._jarray)) if a.size else 0
+        # eagerly (one cheap reduction), then index with clip semantics.
+        # ONE sanctioned host_fetch for both bounds (retried + deadline-
+        # guarded), not two naked int() syncs
+        if a.size:
+            lo, hi = (
+                int(v)
+                for v in a.comm.host_fetch(
+                    jnp.stack([jnp.min(a._jarray), jnp.max(a._jarray)])
+                )
+            )
+        else:
+            lo = hi = 0
         if lo < 0 or hi >= len(jch):
             raise ValueError(f"invalid entry in choice array (range [{lo}, {hi}], {len(jch)} choices)")
         mode = "clip"
@@ -1265,8 +1282,14 @@ def put(a: DNDarray, ind, v, mode: str = "raise") -> None:
     jv = jnp.atleast_1d(v._jarray if isinstance(v, DNDarray) else jnp.asarray(np.asarray(v))).reshape(-1)
     n = a.size
     if mode == "raise":
-        lo = int(jnp.min(ji)) if ji.size else 0
-        hi = int(jnp.max(ji)) if ji.size else 0
+        # one sanctioned host_fetch for both bounds (see choose())
+        if ji.size:
+            lo, hi = (
+                int(v)
+                for v in a.comm.host_fetch(jnp.stack([jnp.min(ji), jnp.max(ji)]))
+            )
+        else:
+            lo = hi = 0
         if lo < -n or hi >= n:
             raise IndexError(f"index out of range for array of size {n} (range [{lo}, {hi}])")
         ji = jnp.where(ji < 0, ji + n, ji)

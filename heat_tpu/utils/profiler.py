@@ -28,6 +28,7 @@ __all__ = [
     "reset_cache_stats",
     "cache_hit_rate",
     "counter_inc",
+    "counter_max",
     "counters",
     "reset_counters",
     "register_counter_provider",
@@ -49,6 +50,15 @@ _providers: Dict[str, Callable[[], Dict[str, int]]] = {}
 def counter_inc(name: str, n: int = 1) -> None:
     """Increment a named event counter (host-side, cheap)."""
     _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def counter_max(name: str, value: int) -> None:
+    """High-water-mark counter: keep the MAX of all observed values (e.g.
+    ``comm.resplit.peak_tile_bytes`` — additive semantics would be a lie
+    for a peak).  Reads/resets/exports exactly like any other counter."""
+    v = int(value)
+    if v > _counters.get(name, 0):
+        _counters[name] = v
 
 
 def register_counter_provider(name: str, fn: Callable[[], Dict[str, int]]) -> str:

@@ -72,6 +72,7 @@ __all__ = [
     "Histogram",
     "account_collective",
     "counter_inc",
+    "counter_max",
     "report",
     "span_summary",
     "flush",
@@ -353,6 +354,11 @@ def span_summary(top: Optional[int] = None) -> List[dict]:
 def counter_inc(name: str, n: int = 1) -> None:
     """Increment a named counter in the shared ``utils.profiler`` store."""
     _prof().counter_inc(name, n)
+
+
+def counter_max(name: str, value: int) -> None:
+    """High-water-mark update of a counter in the shared store."""
+    _prof().counter_max(name, value)
 
 
 def account_collective(name: str, nbytes: float) -> None:

@@ -311,6 +311,65 @@ class TestHT104:
         """, path="heat_tpu/parallel/ring.py")
         assert fs == []
 
+    def test_tiled_entry_delegating_to_executor_not_flagged(self):
+        # ISSUE 6: the tiled-resplit entry accounts PER TILE inside
+        # core.redistribution.execute_plan (via _account_bytes) — delegating
+        # to the executor IS accounting, not invisible traffic
+        fs = run_rule(CollectiveAccountingRule(), """
+            class Communication:
+                def resplit_tiled(self, array, split, memory_budget=None):
+                    from . import redistribution as _redist
+                    plan = _redist.make_plan(self, array, split, memory_budget)
+                    return _redist.execute_plan(self, array, plan)
+        """, path=self.PATH)
+        assert fs == []
+
+    def test_tiled_entry_without_accounting_flagged(self):
+        # a resplit* entry that neither accounts, delegates to an accounted
+        # collective, nor routes through the executor IS flagged
+        fs = run_rule(CollectiveAccountingRule(), """
+            import jax
+            class Communication:
+                def resplit_tiled(self, array, split):
+                    return jax.device_put(array, self.sharding(array.ndim, split))
+        """, path=self.PATH)
+        assert [f.detail for f in fs] == ["resplit_tiled"]
+
+    def test_executor_delegation_scoped_to_resplit_entries(self):
+        # the execute_plan exemption must NOT leak to other collectives: a
+        # public collective delegating to something named execute_plan still
+        # has invisible traffic unless it accounts its own
+        fs = run_rule(CollectiveAccountingRule(), """
+            class Communication:
+                def Alltoallw(self, x):
+                    from . import helper
+                    return helper.execute_plan(self, x)
+        """, path=self.PATH)
+        assert [f.detail for f in fs] == ["Alltoallw"]
+
+    def test_account_bytes_counts_as_accounting(self):
+        fs = run_rule(CollectiveAccountingRule(), """
+            from jax import lax
+            class Communication:
+                def Alltoall(self, x):
+                    self._account_bytes("Alltoall", 128)
+                    return lax.all_to_all(x, "x", 0, 0)
+        """, path=self.PATH)
+        assert fs == []
+
+    def test_resplit_variant_delegating_to_resplit_not_flagged(self):
+        # delegation among the resplit entries (resplit_tiled degenerates to
+        # resplit for K=1 plans) carries the callee's accounting
+        fs = run_rule(CollectiveAccountingRule(), """
+            class Communication:
+                def resplit(self, array, split):
+                    self._account("resplit", array, 1.0)
+                    return self.shard(array, split)
+                def resplit_tiled(self, array, split):
+                    return self.resplit(array, split)
+        """, path=self.PATH)
+        assert fs == []
+
     def test_repo_communication_is_fully_accounted(self):
         # the live invariant: the real communication.py has NO findings
         fs = lint_paths(

@@ -60,6 +60,10 @@ def test_n_process_spmd_tier(n_proc, devs):
         # metadata-agreement digest (ISSUE 4: HEAT_TPU_CHECKS on a real
         # multi-process mesh)
         assert f"[{pid}] SANITIZER-OK" in out, out[-2000:]
+        # ...and streamed a budgeted (tiled) resplit across the process
+        # seam, bit-exact vs the monolithic oracle (ISSUE 6: the chunked
+        # pipeline's per-tile SPMD programs over a real multi-process mesh)
+        assert f"[{pid}] RESPLIT-BUDGETED tiles=3" in out, out[-2000:]
     # ...and the launcher merged them into ONE multi-rank report (ISSUE 3
     # acceptance: scripts/telemetry_report.py folds the mp lane's rank files)
     assert f"TELEMETRY-MERGED ranks={n_proc}" in out, out[-2000:]
