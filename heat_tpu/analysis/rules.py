@@ -11,6 +11,7 @@ contracts" for the full table):
 - HT105 — no raw process entropy; seeding goes through ht.random
 - HT106 — no DNDarray metadata mutation outside sanctioned modules
 - HT107 — no naked blocking collective waits bypassing comm.deadline
+- HT108 — no collective staging bypassing the seq-stamp choke point
 
 All analyses are intentionally *lexical and intra-procedural*: false
 negatives across call boundaries are accepted; false positives are kept
@@ -728,4 +729,98 @@ class NakedBlockingWaitRule(Rule):
             )
             if f is not None:
                 out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT108 — collective staging bypassing the seq-stamp choke point
+# -------------------------------------------------------------------- #
+
+
+@register
+class SeqStampBypassRule(Rule):
+    """Every staged collective must pass through
+    ``Communication._account_bytes`` — the ONE choke point where fault
+    injection, deadline refusal, byte accounting AND the flight recorder's
+    sequence stamp live.  A collective staged around it is invisible to
+    ``scripts/postmortem.py``: the ranks' seq streams stay aligned while
+    the wire traffic diverges, which is exactly the blind spot the flight
+    recorder exists to close.  Two bypass shapes are flagged in library
+    code (outside ``core/communication.py`` / ``core/redistribution.py``,
+    the accounting layer itself):
+
+    - a direct call to the tiled executor ``execute_plan`` — its sanctioned
+      caller is ``Communication.resplit_tiled``, which wraps it in the
+      sanitizer boundary and deadline scope; anything else staging a plan
+      skips that wrapping;
+    - a resharding ``jax.device_put`` of an already-device-resident array
+      (the raw ``._jarray``/``._parray`` plumbing) onto comm sharding
+      machinery (``comm.sharding(...)``/``NamedSharding``) — the lowered
+      all-to-all never reaches the choke point.  Host→device uploads
+      (``device_put`` of host data) are placement, not collective traffic,
+      and are not flagged."""
+
+    code = "HT108"
+    name = "seq-stamp-bypass"
+    description = "collective staged around the _account_bytes seq-stamp choke point"
+
+    # the accounting layer itself: _account_bytes lives in communication.py;
+    # execute_plan (redistribution.py) byte-accounts + stamps every tile
+    # through it at the executor's own staging point
+    SANCTIONED_MODULES = (
+        "core/communication.py",
+        "core/redistribution.py",
+    )
+    SHARDING_MARKERS = {"sharding", "NamedSharding", "PositionalSharding"}
+
+    def _mentions_sharding(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in self.SHARDING_MARKERS:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.SHARDING_MARKERS:
+                return True
+        return False
+
+    def _device_resident(self, node: ast.AST) -> bool:
+        """Stricter than HT101's heuristic on purpose: only the raw device
+        plumbing counts.  ``jnp.asarray(host_data)`` ahead of a sharded
+        ``device_put`` is an upload idiom, not a resharding."""
+        return any(
+            isinstance(sub, ast.Attribute)
+            and sub.attr in ("_jarray", "_parray", "larray")
+            for sub in ast.walk(node)
+        )
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            la = last_attr(node)
+            if la == "execute_plan":
+                f = ctx.finding(
+                    self, node,
+                    "direct `execute_plan` call bypasses Communication.resplit_tiled "
+                    "— the staged tiles skip the sanitizer boundary and deadline "
+                    "scope of the sanctioned entry; route through comm.resplit",
+                    detail="execute_plan",
+                )
+                if f is not None:
+                    out.append(f)
+            elif la == "device_put" and len(node.args) >= 2:
+                if self._device_resident(node.args[0]) and self._mentions_sharding(
+                    node.args[1]
+                ):
+                    f = ctx.finding(
+                        self, node,
+                        "resharding `device_put` of a device-resident array stages "
+                        "an all-to-all around the `_account_bytes` choke point — "
+                        "invisible to the flight recorder's seq stream and the "
+                        "comm.<name> byte accounting; use Communication.resplit",
+                        detail="device_put",
+                    )
+                    if f is not None:
+                        out.append(f)
         return out

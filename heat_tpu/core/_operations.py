@@ -54,6 +54,14 @@ _TELEMETRY = None
 # skips ``__init__``'s enforcement).
 _CHECKS = None
 
+# flight-recorder hot-path hook (``utils.flightrec.enable()`` pokes the
+# module in, ``disable()`` clears it): armed, every cached dispatch appends
+# a minimal op record to the crash-durable ring — the "last healthy local
+# operation" context around the seq-stamped collectives.  Disabled cost:
+# the same one-module-global load as the two hooks above (the flightrec
+# overhead contract, gated by ``benchmarks/dispatch.py --flightrec-gate``).
+_FLIGHTREC = None
+
 
 def _run_prog(tel, name: str, op, prog, args, cache_hit: bool):
     """Run a cached dispatch executable with the telemetry tail around it
@@ -212,6 +220,8 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
                 if tel is None
                 else _run_prog(tel, "dispatch.local", op, prog, (j,), _cache._STATS["misses"] == m0)
             )
+            if _FLIGHTREC is not None:
+                _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
             ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, comm)
             return ret if _CHECKS is None else _CHECKS(ret, "dispatch.local")
     result = op(j, **kwargs)
@@ -318,6 +328,8 @@ def _binary_op(
                             _cache._STATS["misses"] == m0,
                         )
                     )
+                    if _FLIGHTREC is not None:
+                        _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
                     ret = DNDarray._from_parts(
                         res, rshape, rdtype, rsplit, proto.device, comm
                     )
@@ -599,6 +611,8 @@ def _reduce_op(
                 if tel is None
                 else _run_prog(tel, "dispatch.reduce", op, prog, (j,), _cache._STATS["misses"] == m0)
             )
+            if _FLIGHTREC is not None:
+                _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
             ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
             return ret if _CHECKS is None else _CHECKS(ret, "dispatch.reduce")
     result = op(j, axis=axis, keepdims=keepdims, **kwargs)
@@ -675,6 +689,8 @@ def _cum_op(
                 if tel is None
                 else _run_prog(tel, "dispatch.cum", op, prog, (j,), _cache._STATS["misses"] == m0)
             )
+            if _FLIGHTREC is not None:
+                _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
             ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
             return ret if _CHECKS is None else _CHECKS(ret, "dispatch.cum")
     if axis is None:
@@ -720,7 +736,12 @@ import sys as _sys  # noqa: E402
 _t = _sys.modules.get("heat_tpu.utils.telemetry")
 if _t is not None and _t._ENABLED:
     _TELEMETRY = _t
-del _sys, _t
+# same race for the flight recorder (HEAT_TPU_FLIGHTREC_DIR arms at
+# utils.flightrec import time): re-read the flag now that the body is done
+_fr = _sys.modules.get("heat_tpu.utils.flightrec")
+if _fr is not None and _fr.enabled():
+    _FLIGHTREC = _fr
+del _sys, _t, _fr
 
 # same race for the sanitizer: HEAT_TPU_CHECKS=1 arms at core.sanitation
 # import time, which runs DURING this module's import (sanitation is imported

@@ -70,6 +70,13 @@ _HEALTH_MOD = None
 # ``_operations`` does.
 _RESPLIT_CHECK = None
 
+# flight-recorder hook (``utils.flightrec.enable()`` pokes the module in,
+# ``disable()`` clears it): every staged collective is seq-stamped at the
+# ``_account_bytes`` choke point below.  Disabled cost: one module-global
+# load at staging time.  Module bottom re-arms against import-order races
+# exactly like the two hooks above.
+_FLIGHTREC = None
+
 
 def _telemetry():
     global _TELEMETRY_MOD
@@ -501,7 +508,13 @@ class Communication:
         plan = _redist.make_plan(self, array, split, memory_budget)
         if plan is not None and plan.n_tiles > 1:
             return self.resplit_tiled(array, split, donate=donate, _plan=plan)
-        self._account("resplit", array, (self.size - 1) / self.size)
+        self._account(
+            "resplit",
+            array,
+            (self.size - 1) / self.size,
+            src_split=self.split_of(array) if not isinstance(array, jax.core.Tracer) else None,
+            dst_split=split,
+        )
         tel = _telemetry()
         tel.counter_inc("comm.resplit.tiles", 1)
         with tel.span(
@@ -630,7 +643,14 @@ class Communication:
     # tests can lower it; 8 ≈ one host's worth of chips)
     GATHER_WARN_THRESHOLD = 8
 
-    def _account(self, name: str, x, factor: float) -> None:
+    def _account(
+        self,
+        name: str,
+        x,
+        factor: float,
+        src_split: Optional[int] = None,
+        dst_split: Optional[int] = None,
+    ) -> None:
         """Byte accounting of one staged collective: ``comm.<name>.calls``
         += 1 and ``comm.<name>.bytes`` += per-shard payload nbytes × the
         collective's algorithmic traffic factor (the wire cost per shard in
@@ -650,15 +670,35 @@ class Communication:
         under a deadline the fire runs inside ``guard_blocking``, so a
         ``hang=`` injection trips ``CollectiveTimeoutError`` exactly like
         a hang in ``Wait`` would, instead of wedging the caller's thread."""
-        self._account_bytes(name, int(round(_payload_nbytes(x) * factor)))
+        self._account_bytes(
+            name,
+            int(round(_payload_nbytes(x) * factor)),
+            x=x,
+            src_split=src_split,
+            dst_split=dst_split,
+        )
 
-    def _account_bytes(self, name: str, wire_bytes: int) -> None:
+    def _account_bytes(
+        self,
+        name: str,
+        wire_bytes: int,
+        x=None,
+        src_split: Optional[int] = None,
+        dst_split: Optional[int] = None,
+    ) -> None:
         """The staging choke point itself, taking pre-computed WIRE bytes:
         :meth:`_account` (payload × factor) and the tiled-resplit executor
         (telescoped per-tile bytes, ``core.redistribution.execute_plan``)
-        both land here, so fault injection, deadline refusal and byte
-        accounting cover every staged collective — monolithic or per-tile —
-        through one code path."""
+        both land here, so fault injection, deadline refusal, byte
+        accounting AND the flight-recorder seq stamp cover every staged
+        collective — monolithic or per-tile — through one code path.
+
+        The stamp is written FIRST, before the fault site fires: a hang
+        injected (or suffered) at staging leaves the collective it hung on
+        as the rank's last ring record — "stuck AT seq N op X", which is
+        exactly what ``scripts/postmortem.py`` names."""
+        if _FLIGHTREC is not None:
+            _FLIGHTREC.record_collective(name, wire_bytes, x, src_split, dst_split)
         from ..utils import faults as _flt  # lazy: core imports before utils
 
         hlth = _health()
@@ -987,4 +1027,10 @@ import sys as _sys  # noqa: E402
 _san = _sys.modules.get("heat_tpu.core.sanitation")
 if _san is not None and getattr(_san, "checks_enabled", lambda: False)():
     _RESPLIT_CHECK = _san.check_placement
-del _sys, _san
+# same defensive re-arm for the flight recorder: if utils.flightrec was
+# env-armed before this module finished importing, its poke hit the
+# half-initialized module and the `_FLIGHTREC = None` line clobbered it
+_fr = _sys.modules.get("heat_tpu.utils.flightrec")
+if _fr is not None and getattr(_fr, "enabled", lambda: False)():
+    _FLIGHTREC = _fr
+del _sys, _san, _fr

@@ -25,6 +25,7 @@ from .dndarray import DNDarray
 
 # stdlib-only modules; safe to import from the innermost write paths
 from ..utils import faults as _faults
+from ..utils import flightrec as _flightrec
 from ..utils import telemetry as _telemetry
 
 __all__ = [
@@ -790,6 +791,7 @@ def save_array_checkpoint(
     """
     if not isinstance(x, DNDarray):
         x = factories.array(x)
+    _flightrec.record_event("ckpt", op="save_array", path=directory)
     keep_versions = max(int(keep_versions), 1)
     os.makedirs(directory, exist_ok=True)
     # crash-safe layout: each save goes into a fresh v<k>/ subdirectory and
@@ -950,6 +952,7 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
     """
     import jax
 
+    _flightrec.record_event("ckpt", op="load_array", path=directory)
     if not os.path.isdir(directory):
         raise FileNotFoundError(f"checkpoint directory {directory!r} does not exist")
     candidates = _checkpoint_candidates(directory)
@@ -1048,6 +1051,7 @@ def save_checkpoint(tree, path: str) -> None:
     import jax
 
     final = path if path.endswith(".npz") else path + ".npz"
+    _flightrec.record_event("ckpt", op="save_tree", path=final)
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     # ONE batched device→host transfer for the whole tree: per-leaf
     # np.asarray would issue a blocking round-trip per parameter, turning a
@@ -1133,6 +1137,7 @@ def load_checkpoint(tree_like, path: str):
     import jax.numpy as jnp
 
     p = path if path.endswith(".npz") else path + ".npz"
+    _flightrec.record_event("ckpt", op="load_tree", path=p)
     if not os.path.exists(p):
         raise FileNotFoundError(
             f"checkpoint file {p!r} does not exist"

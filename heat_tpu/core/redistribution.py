@@ -365,7 +365,10 @@ def execute_plan(comm, array, plan: ResplitPlan, donate: bool = False):
         moved += tile_bytes
         wire = int(round(moved * factor)) - accounted
         accounted += wire
-        comm._account_bytes("resplit", wire)
+        comm._account_bytes(
+            "resplit", wire, x=array,
+            src_split=plan.src_split, dst_split=plan.dst_split,
+        )
         # plan-shape counters advance PER TILE so a mid-plan failure (hung
         # tile tripping the deadline) leaves calls/bytes/tiles consistent in
         # the post-mortem report instead of tiles=0 masquerading as monolithic

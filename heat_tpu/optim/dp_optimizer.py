@@ -802,4 +802,13 @@ class DASO:
                 "between the two writes); trusting the restored tree"
             )
         self._pending = None
+        # restart-with-resume marker in the flight recorder: the analyzer
+        # reads `resume` events to tell a relaunched generation's ring from
+        # a first boot (no-op when the recorder is disarmed)
+        from ..utils import flightrec as _flightrec
+
+        _flightrec.record_event(
+            "resume", step=int(self._step_count),
+            epoch=_health.restart_epoch(), fallback=bool(used_fallback),
+        )
         return True

@@ -48,10 +48,13 @@ immune to payload-format drift between supervisor and worker versions.
 
 from __future__ import annotations
 
+import importlib.util
+import json
 import os
 import signal
 import socket
 import subprocess
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -116,11 +119,15 @@ class SupervisorResult:
     returncodes: List[Optional[int]]
     counters: Dict[str, int]
     failures: List[str] = field(default_factory=list)
+    postmortems: List[dict] = field(default_factory=list)
 
     def report(self) -> dict:
         """Merged diagnostic structure (printed/JSON-dumped by launchers on
         give-up; the counters slot straight into a telemetry counters
-        line)."""
+        line).  ``postmortems`` carries one flight-recorder verdict per
+        failed generation (``scripts/postmortem.py``): the report no
+        longer just says "rank died / went stale", it names the first
+        divergent collective sequence or the straggler rank."""
         return {
             "ok": self.ok,
             "restarts": self.restarts,
@@ -128,6 +135,7 @@ class SupervisorResult:
             "returncodes": self.returncodes,
             "counters": dict(self.counters),
             "failures": list(self.failures),
+            "postmortems": list(self.postmortems),
         }
 
 
@@ -166,6 +174,8 @@ class Supervisor:
         generation_deadline: Optional[float] = None,
         poll_interval: float = 0.5,
         grace: float = 3.0,
+        flightrec_dir: Optional[str] = None,
+        telemetry_dir: Optional[str] = None,
     ):
         self.spawn = spawn
         self.n_ranks = int(n_ranks)
@@ -175,6 +185,13 @@ class Supervisor:
         self.generation_deadline = generation_deadline
         self.poll_interval = float(poll_interval)
         self.grace = float(grace)
+        # post-mortem inputs: when `flightrec_dir` is set, every TEARDOWN
+        # harvests the ranks' crash-durable rings, runs the analyzer
+        # (scripts/postmortem.py, loaded standalone — still no jax) and
+        # keeps the verdict; `telemetry_dir` additionally feeds the
+        # comm.<name>.wait straggler evidence into it
+        self.flightrec_dir = flightrec_dir
+        self.telemetry_dir = telemetry_dir
         self.counters: Dict[str, int] = {
             "watchdog.dumps": 0,
             "watchdog.kills": 0,
@@ -184,6 +201,35 @@ class Supervisor:
     # ------------------------------------------------------------------ #
     def _heartbeat_path(self, rank: int) -> str:
         return os.path.join(self.heartbeat_dir, f"rank{rank}.json")
+
+    def _heartbeat_payload(self, rank: int) -> dict:
+        """Last heartbeat JSON of ``rank`` ({} on any problem — the
+        monitor must never crash on a torn/missing beacon)."""
+        try:
+            with open(self._heartbeat_path(rank)) as fh:
+                rec = json.load(fh)
+            return rec if isinstance(rec, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _semantic_progress(self, stale_rank: int) -> str:
+        """' (stuck at seq 417 Alltoall; peers at seq 423)' when the
+        beacons carry the flight recorder's collective sequence — the
+        live semantic-progress view the heartbeat ``seq`` field exists
+        for; '' when no beacon has one."""
+        mine = self._heartbeat_payload(stale_rank)
+        peers = [
+            self._heartbeat_payload(r).get("seq")
+            for r in range(self.n_ranks)
+            if r != stale_rank
+        ]
+        peers = [s for s in peers if isinstance(s, int)]
+        if not isinstance(mine.get("seq"), int):
+            return ""
+        msg = f" (stuck at seq {mine['seq']} {mine.get('collective', '?')}"
+        if peers:
+            msg += f"; peers at seq {max(peers)}"
+        return msg + ")"
 
     def _clear_heartbeats(self) -> None:
         """Remove the previous generation's beacons so staleness is always
@@ -219,11 +265,73 @@ class Supervisor:
                     return (
                         f"rank {r} heartbeat stale ({age:.1f}s > "
                         f"{self.heartbeat_timeout:.1f}s) — hung or wedged"
+                        + self._semantic_progress(r)
                     )
         return None
 
+    # ------------------------------------------------------------------ #
+    # flight-recorder harvest + post-mortem (TEARDOWN diagnostics)
+    # ------------------------------------------------------------------ #
+    _POSTMORTEM_PATH = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", "scripts",
+        "postmortem.py",
+    )
+    _postmortem_mod = None
+
+    @classmethod
+    def _load_postmortem(cls):
+        """scripts/postmortem.py loaded standalone (this process must never
+        import jax); None when the file is missing (a stripped install) —
+        the supervisor then degrades to the pre-PR-7 report."""
+        if cls._postmortem_mod is None:
+            path = os.path.normpath(cls._POSTMORTEM_PATH)
+            if not os.path.exists(path):
+                return None
+            spec = importlib.util.spec_from_file_location("heat_postmortem", path)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[spec.name] = mod
+            spec.loader.exec_module(mod)
+            cls._postmortem_mod = mod
+        return cls._postmortem_mod
+
+    def _run_postmortem(self, epoch: int, failure: str) -> Optional[dict]:
+        """Analyze the dead generation's rings, then HARVEST them (move
+        into ``{flightrec_dir}/epoch{epoch}/``) so the relaunched world
+        starts a clean black box and the evidence survives next to the
+        logs.  Returns the verdict dict (with ``epoch``/``failure``
+        attached), or None when no recorder was configured."""
+        if not self.flightrec_dir:
+            return None
+        pm = self._load_postmortem()
+        if pm is None:
+            return None
+        try:
+            verdict = pm.analyze_dir(
+                self.flightrec_dir,
+                heartbeat_dir=self.heartbeat_dir,
+                telemetry_dir=self.telemetry_dir,
+                expected_ranks=list(range(self.n_ranks)),
+            )
+        except Exception as e:  # diagnostics must never kill the supervisor
+            verdict = {"verdict": "inconclusive", "detail": f"analyzer failed: {e!r}"}
+        verdict["epoch"] = epoch
+        verdict["failure"] = failure
+        harvest = os.path.join(self.flightrec_dir, f"epoch{epoch}")
+        try:
+            os.makedirs(harvest, exist_ok=True)
+            for name in os.listdir(self.flightrec_dir):
+                if name.startswith("flight_rank") and name.endswith(".ring"):
+                    os.replace(
+                        os.path.join(self.flightrec_dir, name),
+                        os.path.join(harvest, name),
+                    )
+        except OSError:
+            pass
+        return verdict
+
     def run(self) -> SupervisorResult:
         failures: List[str] = []
+        postmortems: List[dict] = []
         epoch = 0
         while True:
             port = free_port()
@@ -242,6 +350,7 @@ class Supervisor:
                         returncodes=codes,
                         counters=dict(self.counters),
                         failures=failures,
+                        postmortems=postmortems,
                     )
                 failure = self._check_failure(procs, gen_wall_start)
                 if failure is not None:
@@ -264,6 +373,14 @@ class Supervisor:
             for p in procs:
                 if p.poll() is None:
                     p.wait()
+            # every dead rank has stopped moving its ring: analyze + harvest
+            # NOW, before a relaunch overwrites the evidence
+            pm = self._run_postmortem(epoch, failure)
+            if pm is not None:
+                postmortems.append(pm)
+                mod = self._load_postmortem()
+                if mod is not None:
+                    print("supervisor: " + mod.summary_line(pm, epoch=epoch), flush=True)
             if epoch >= self.restart_budget:
                 return SupervisorResult(
                     ok=False,
@@ -272,6 +389,7 @@ class Supervisor:
                     returncodes=[p.poll() for p in procs],
                     counters=dict(self.counters),
                     failures=failures,
+                    postmortems=postmortems,
                 )
             epoch += 1
             self.counters["health.restarts"] += 1

@@ -142,6 +142,18 @@ def write_heartbeat(
         "restart_epoch": restart_epoch(),
         "status": status,
     }
+    # fold the flight recorder's latest collective into the beacon: the
+    # supervisor then sees live SEMANTIC progress ("rank 2 stuck at seq 417
+    # Alltoall while peers are at 423"), not just mtime staleness.  Via
+    # sys.modules — this module must stay importable without the package.
+    fr = sys.modules.get("heat_tpu.utils.flightrec")
+    if fr is not None:
+        try:
+            last = fr.last_collective()
+        except Exception:
+            last = None
+        if last is not None:
+            rec["seq"], rec["collective"] = int(last[0]), str(last[1])
     if extra:
         rec.update(extra)
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
@@ -294,6 +306,35 @@ def _dump_stacks() -> None:
         pass
 
 
+def _wait_observer():
+    """The telemetry module iff it is loaded AND armed; None otherwise.
+    Via ``sys.modules`` so this module never imports the package (a bare
+    supervisor process must keep working without telemetry)."""
+    tel = sys.modules.get("heat_tpu.utils.telemetry")
+    if tel is None or not getattr(tel, "_ENABLED", False):
+        return None
+    return tel
+
+
+def _observe_wait(what: str, seconds: float) -> None:
+    """Record an observed blocking-wait duration into the per-collective
+    histogram ``<what>.wait`` (e.g. ``comm.Wait.wait``,
+    ``comm.host_fetch.wait``, ``comm.resplit.tile.wait``) — the straggler
+    evidence ``scripts/postmortem.py`` reads from the telemetry export.
+    Gated on telemetry being ARMED: disarmed, the observation could never
+    reach an export anyway, and doing per-call histogram work between
+    back-to-back collectives is exactly the hot-path cost the telemetry-off
+    contract forbids (measured: it can perturb rapid small-collective
+    streams on slow hosts)."""
+    tel = _wait_observer()
+    if tel is None:
+        return
+    try:
+        tel.observe(f"{what}.wait", seconds)
+    except Exception:
+        pass
+
+
 def guard_blocking(fn: Callable[[], Any], what: str) -> Any:
     """Run ``fn()`` under the active deadline (plain call when none armed).
 
@@ -303,10 +344,22 @@ def guard_blocking(fn: Callable[[], Any], what: str) -> Any:
     worker thread is abandoned (it is stuck in uninterruptible C code by
     hypothesis; only a process teardown can reclaim it, and that teardown
     is exactly what the caller's error handling / the supervisor performs).
-    """
+
+    Either way the observed wait time lands in the ``<what>.wait``
+    histogram (:func:`_observe_wait`) WHEN telemetry is armed: on a trip
+    the recorded value is the full burned budget, so a straggler's guard
+    sites accumulate visibly long waits — the attribution the post-mortem
+    analyzer names.  Telemetry off: the no-deadline path is a bare
+    ``fn()`` call — no clocks, no histogram — per the off-cost contract."""
     dl = _active.get()
     if dl is None:
-        return fn()
+        if _wait_observer() is None:
+            return fn()
+        t0 = time.monotonic()
+        try:
+            return fn()
+        finally:
+            _observe_wait(what, time.monotonic() - t0)
     remaining = dl.remaining()
     if remaining <= 0:
         dl.check(what)  # raises
@@ -323,8 +376,10 @@ def guard_blocking(fn: Callable[[], Any], what: str) -> Any:
             box["error"] = e
 
     t = threading.Thread(target=run, name=f"heat-guard:{what}", daemon=True)
+    t0 = time.monotonic()
     t.start()
     t.join(remaining)
+    _observe_wait(what, time.monotonic() - t0)
     if t.is_alive():
         counter_inc("health.deadline.trips")
         _dump_stacks()

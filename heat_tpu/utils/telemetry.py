@@ -77,6 +77,7 @@ __all__ = [
     "span_summary",
     "flush",
     "write_counters_line",
+    "install_signal_flush",
     "reset",
 ]
 
@@ -91,6 +92,13 @@ _flush_dir: Optional[str] = None
 _atexit_registered = False
 _trace_annotation = None  # jax.profiler.TraceAnnotation, resolved at enable()
 _profiler = None  # utils.profiler, resolved on first counter touch
+
+# flight-recorder hook (``utils.flightrec.enable()`` pokes the module in):
+# armed, context-manager span open/close boundaries are mirrored into the
+# crash-durable ring — the named phases around the seq-stamped collectives.
+# The leaf-record fast paths (record_dispatch/record_event) are NOT hooked
+# here; the dispatch tails have their own hook in ``core._operations``.
+_FLIGHTREC = None
 
 # wall-clock anchor: span timestamps are perf_counter-based for precision
 # but exported in epoch seconds so multi-rank timelines merge on one axis
@@ -150,6 +158,10 @@ def enable(directory: Optional[str] = None, ring_size: Optional[int] = None) -> 
     if _flush_dir and not _atexit_registered:
         atexit.register(_atexit_flush)
         _atexit_registered = True
+    if _flush_dir:
+        # graceful kills (SIGTERM/SIGINT) must export too — atexit never
+        # runs when a supervisor tears the world down with signals
+        install_signal_flush()
     _ENABLED = True
     _poke_dispatch_hook(True)
 
@@ -174,6 +186,91 @@ def _atexit_flush() -> None:  # pragma: no cover - exercised by the mp lane
             flush(_flush_dir)
     except Exception:
         pass
+
+
+# ---------------------------------------------------------------------- #
+# graceful-kill flush: SIGTERM/SIGINT export what atexit cannot
+# ---------------------------------------------------------------------- #
+_signal_prev: Dict[int, Any] = {}
+_signal_installed = False
+
+
+def _signal_flush_handler(signum, frame):  # pragma: no cover - exercised
+    # via os.kill in tests; keep it exception-proof: a failed flush must
+    # never mask the signal's real semantics
+    try:
+        from . import health as _hlth
+
+        _hlth.counter_inc("health.signal_flush")
+    except Exception:
+        pass
+    try:
+        if _ENABLED:
+            flush()
+    except Exception:
+        pass
+    try:
+        fr = sys.modules.get("heat_tpu.utils.flightrec")
+        if fr is not None:
+            fr.sync()
+    except Exception:
+        pass
+    prev = _signal_prev.get(signum)
+    if callable(prev):
+        prev(signum, frame)  # chain (incl. Python's default SIGINT handler)
+    else:
+        # SIG_DFL (or unset): restore the default disposition and re-raise
+        # so the process still dies of the signal with the right exit code
+        import signal as _signal
+
+        _signal.signal(signum, _signal.SIG_DFL if prev is None else prev)
+        os.kill(os.getpid(), signum)
+
+
+def install_signal_flush() -> bool:
+    """Arm a SIGTERM/SIGINT handler that flushes the telemetry ring and
+    msyncs the flight recorder before chaining to whatever handler was
+    installed before (or re-raising the default disposition) — so a
+    *graceful* kill exports even without the ``HEAT_TPU_TELEMETRY_DIR``
+    atexit hook (SIGKILL needs no help: the flight recorder's mmap
+    survives it by construction).  Invocations count under
+    ``health.signal_flush``.  Idempotent; returns False off the main
+    thread (signal handlers can only be installed there) and on platforms
+    without the signals."""
+    global _signal_installed
+    if _signal_installed:
+        return True
+    import signal as _signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    ok = False
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            prev = _signal.getsignal(sig)
+            _signal.signal(sig, _signal_flush_handler)
+        except (ValueError, OSError):  # non-main thread race / exotic platform
+            continue
+        _signal_prev[sig] = None if prev is _signal.SIG_DFL else prev
+        ok = True
+    _signal_installed = ok
+    return ok
+
+
+def _uninstall_signal_flush() -> None:
+    """Test hook: restore the pre-install handlers."""
+    global _signal_installed
+    if not _signal_installed:
+        return
+    import signal as _signal
+
+    for sig, prev in list(_signal_prev.items()):
+        try:
+            _signal.signal(sig, _signal.SIG_DFL if prev is None else prev)
+        except (ValueError, OSError):
+            pass
+    _signal_prev.clear()
+    _signal_installed = False
 
 
 # ---------------------------------------------------------------------- #
@@ -216,6 +313,8 @@ class _Span:
         stack.append(self)
         if self._ta is not None:
             self._ta.__enter__()
+        if _FLIGHTREC is not None:
+            _FLIGHTREC.record_event("span", name=self.name)
         self.t0 = time.perf_counter()
         return self
 
@@ -223,6 +322,11 @@ class _Span:
         t1 = time.perf_counter()
         if self._ta is not None:
             self._ta.__exit__(et, ev, tb)
+        if _FLIGHTREC is not None:
+            _FLIGHTREC.record_event(
+                "span_end", name=self.name, dur=round(t1 - self.t0, 6),
+                **({"error": et.__name__} if et is not None else {}),
+            )
         stack = _stack()
         if stack and stack[-1] is self:
             stack.pop()
@@ -594,3 +698,13 @@ if __package__ and os.environ.get(
     "HEAT_TPU_TELEMETRY", ""
 ).strip().lower() in ("1", "true", "on", "yes"):
     enable()
+
+# the flight recorder may have been env-armed while this module was still
+# importing (flightrec's poke would hit the half-initialized module and the
+# `_FLIGHTREC = None` line above clobbered it) — re-read the flag now, same
+# defensive pattern as core._operations / core.communication
+if __package__:
+    _fr_mod = sys.modules.get("heat_tpu.utils.flightrec")
+    if _fr_mod is not None and _fr_mod.enabled():
+        _FLIGHTREC = _fr_mod
+    del _fr_mod

@@ -473,6 +473,125 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     hb.beat()
     print(f"[{pid}] telemetry: rank file exported", flush=True)
 
+    # ---- flight recorder (ISSUE 7) ----------------------------------- #
+    # env-armed (HEAT_TPU_FLIGHTREC_DIR, exported by the launcher) at
+    # heat_tpu import: every staged collective above was seq-stamped into
+    # this rank's crash-durable ring; print the seq so the launcher-side
+    # post-mortem has a cross-check, and so tests can assert the recorder
+    # really ran on every rank
+    from heat_tpu.utils import flightrec
+
+    if flightrec.enabled():
+        last = flightrec.last_collective()
+        assert last is not None, "flight recorder armed but no collective stamped"
+        print(f"[{pid}] FLIGHTREC seq={last[0]} op={last[1]}", flush=True)
+
+    print(f"[{pid}] {MARKER}", flush=True)
+    faulthandler.cancel_dump_traceback_later()
+    ht.core.bootstrap.finalize_distributed()
+
+
+# ---------------------------------------------------------------------- #
+# postmortem worker (MPDRYRUN_MODE=postmortem): the flight-recorder chaos
+# scenarios — a deterministic collective loop with injectable hang/desync
+# ---------------------------------------------------------------------- #
+def postmortem_worker(pid: int, port: int, tmpdir: str) -> None:
+    """Deterministic seq-stamped collective stream for the post-mortem
+    chaos scenarios (ISSUE 7 acceptance).
+
+    Every rank stages the IDENTICAL loop of ``MPDRYRUN_PM_ITERS`` resplit
+    flips — exactly one accounted collective per iteration, with NO host
+    sync, so staging stays async and the surviving ranks keep staging past
+    a wedged peer.  Two injectable failures at iteration
+    ``MPDRYRUN_CHAOS_AT``:
+
+    - ``MPDRYRUN_HANG_RANK=k``: rank k arms a ``comm.collective`` hang and
+      stages one more flip — the stamp lands in the ring FIRST, so the
+      rank's last record is exactly the collective it hung on (printed as
+      ``PM-HANG expect_seq=N`` for the test's cross-check); peers finish
+      the loop, so the analyzer names rank k as the straggler at seq N.
+    - ``MPDRYRUN_DESYNC_RANK=k``: rank k stages one EXTRA collective its
+      peers never post (the classic rank-conditional SPMD divergence) —
+      from ``PM-DESYNC expect_seq=N`` on, rank k's fingerprint stream is
+      shifted, and the analyzer must name seq N as the first divergence.
+
+    After a chaos injection every rank PARKS (no beats, no teardown): the
+    supervisor's heartbeat-staleness monitor is what must notice, tear the
+    world down, and run the analyzer on the harvested rings."""
+    import faulthandler
+    import signal
+    import time
+
+    # pre-beat the beacon by mtime BEFORE the heavy bring-up imports: the
+    # chaos tests run with a short MPDRYRUN_HB_TIMEOUT so post-hang
+    # detection is fast, and jax + gloo bring-up alone can exceed it
+    hb_dir = os.environ.get("MPDRYRUN_HB")
+    if hb_dir:
+        os.makedirs(hb_dir, exist_ok=True)
+        with open(os.path.join(hb_dir, f"rank{pid}.json"), "a"):
+            pass
+    faulthandler.register(signal.SIGUSR1)
+    faulthandler.dump_traceback_later(
+        float(os.environ.get("MPDRYRUN_WATCHDOG", "450")), exit=True
+    )
+    n_proc = int(os.environ.get("MPDRYRUN_NPROC", N_PROC))
+    devs = int(os.environ.get("MPDRYRUN_DEVS", DEVS_PER_PROC))
+    os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=n_proc, process_id=pid
+    )
+    sys.path.insert(0, REPO)
+
+    import heat_tpu as ht
+
+    ht.core.bootstrap.init_distributed(num_processes=n_proc, process_id=pid)
+    comm = ht.communication.get_comm()
+    hb = _make_heartbeat(pid)
+    hb.beat(status="bring-up")
+    from heat_tpu.utils import faults, flightrec
+
+    assert flightrec.enabled(), "postmortem mode needs HEAT_TPU_FLIGHTREC_DIR"
+    hang_rank = int(os.environ.get("MPDRYRUN_HANG_RANK", "-1"))
+    desync_rank = int(os.environ.get("MPDRYRUN_DESYNC_RANK", "-1"))
+    chaos_at = int(os.environ.get("MPDRYRUN_CHAOS_AT", "3"))
+    n_iters = int(os.environ.get("MPDRYRUN_PM_ITERS", "6"))
+    chaos = hang_rank >= 0 or desync_rank >= 0
+
+    m = ht.reshape(
+        ht.arange(comm.size * comm.size, dtype=ht.float32, split=0),
+        (comm.size, comm.size),
+    )
+    last = flightrec.last_collective()
+    seq0 = last[0] if last else 0
+    print(f"[{pid}] PM-LOOP start seq0={seq0}", flush=True)
+    for i in range(n_iters):
+        if pid == hang_rank and i == chaos_at:
+            # the stamp is written before the fault site fires, so the
+            # ring's last record IS the collective this rank hung on
+            print(f"[{pid}] PM-HANG expect_seq={seq0 + i + 1}", flush=True)
+            with faults.inject("comm.collective", hang=1):
+                m = m.resplit(1 if m.split == 0 else 0)
+            raise AssertionError("unreachable: staging was armed to hang")
+        if pid == desync_rank and i == chaos_at:
+            print(f"[{pid}] PM-DESYNC expect_seq={seq0 + i + 1}", flush=True)
+            # the rank-conditional EXTRA collective: a different shape, so
+            # the divergent fingerprint differs in op payload, not just order
+            ht.arange(comm.size, dtype=ht.float32, split=0).resplit(None)
+        m = m.resplit(1 if m.split == 0 else 0)
+        hb.beat(step=i)
+    last = flightrec.last_collective()
+    print(f"[{pid}] FLIGHTREC seq={last[0]} op={last[1]}", flush=True)
+    if chaos:
+        # park: a clean teardown would need the wedged/diverged peers'
+        # collectives.  Beats stop here on purpose — heartbeat staleness
+        # is the signal the supervisor must convert into teardown+verdict.
+        print(f"[{pid}] PM-PARK", flush=True)
+        while True:
+            time.sleep(60.0)
     print(f"[{pid}] {MARKER}", flush=True)
     faulthandler.cancel_dump_traceback_later()
     ht.core.bootstrap.finalize_distributed()
@@ -589,6 +708,8 @@ def main() -> int:
     mode = os.environ.get("MPDRYRUN_MODE", "dryrun")
     tmpdir = tempfile.mkdtemp(prefix="mpdryrun_")
     hb_dir = os.path.join(tmpdir, "heartbeats")
+    fr_dir = os.path.join(tmpdir, "flightrec")
+    tdir = os.path.join(tmpdir, "telemetry")
     restart_budget = int(
         os.environ.get("MPDRYRUN_RESTARTS", "2" if mode == "train" else "0")
     )
@@ -613,6 +734,11 @@ def main() -> int:
         env["MPDRYRUN_PORT"] = str(port)
         env["MPDRYRUN_TMP"] = tmpdir
         env["MPDRYRUN_HB"] = hb_dir
+        # black box: every staged collective is seq-stamped into a
+        # crash-durable ring under fr_dir (env-armed at heat_tpu import);
+        # the explicit rank is the fallback when jax isn't live yet
+        env["HEAT_TPU_FLIGHTREC_DIR"] = fr_dir
+        env["HEAT_TPU_FLIGHTREC_RANK"] = str(rank)
         env["HEAT_TPU_RESTART_EPOCH"] = str(epoch)
         env["PYTHONUNBUFFERED"] = "1"
         # scrub accelerator plumbing HERE (popping inside the worker is too
@@ -644,6 +770,8 @@ def main() -> int:
         heartbeat_timeout=hb_timeout,
         restart_budget=restart_budget,
         generation_deadline=gen_deadline,
+        flightrec_dir=fr_dir,
+        telemetry_dir=tdir,
     )
     res = sup.run()
     for log in open_logs:
@@ -676,7 +804,6 @@ def main() -> int:
     # shadows a real rank's counters — watchdog.dumps/kills + restarts are
     # now part of the SAME post-hoc report as comm.*/health.* (satellite:
     # the dump_stacks_then_kill return value used to be dropped)
-    tdir = os.path.join(tmpdir, "telemetry")
     launcher_counters = dict(res.counters)
     launcher_counters["watchdog.dumps"] += _WATCHDOG["dumps"]
     launcher_counters["watchdog.kills"] += _WATCHDOG["kills"]
@@ -699,6 +826,31 @@ def main() -> int:
         f"watchdog.kills={launcher_counters['watchdog.kills']}",
         flush=True,
     )
+    # flight-recorder post-mortem (ISSUE 7): failed generations were
+    # analyzed + harvested by the supervisor at teardown (one verdict per
+    # generation in res.postmortems); on success the final generation's
+    # rings are still live under fr_dir — analyze them now so even a green
+    # run ends with an explicit `POSTMORTEM verdict=clean` attestation
+    pm = _load_standalone("heat_postmortem", "scripts/postmortem.py")
+    for v in res.postmortems:
+        print(pm.summary_line(v, epoch=v.get("epoch")), flush=True)
+    if res.ok:
+        # the FINAL generation succeeded (possibly after restarts): its
+        # rings are still live under fr_dir — analyze them so every green
+        # run ends with an explicit clean attestation, restarts or not
+        verdict = pm.analyze_dir(
+            fr_dir,
+            heartbeat_dir=hb_dir,
+            telemetry_dir=tdir,
+            expected_ranks=list(range(n_proc)),
+        )
+        print(pm.summary_line(verdict), flush=True)
+        if verdict.get("verdict") != "clean" and ok:
+            # a green run whose rings do NOT read clean is itself a finding
+            # (a rank lost its ring, streams diverged without failing, ...)
+            print("launcher: postmortem disagrees with the green markers:")
+            print(pm.render(verdict))
+            ok = False
     if not res.ok:
         # merged diagnostic report: the give-up contract of the supervisor
         import json as _json
@@ -711,10 +863,9 @@ def main() -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1:
-        _target = (
-            train_worker
-            if os.environ.get("MPDRYRUN_MODE", "dryrun") == "train"
-            else worker
+        _mode = os.environ.get("MPDRYRUN_MODE", "dryrun")
+        _target = {"train": train_worker, "postmortem": postmortem_worker}.get(
+            _mode, worker
         )
         _target(
             int(sys.argv[1]),

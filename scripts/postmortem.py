@@ -1,0 +1,509 @@
+"""Cross-rank collective post-mortem: name the diverging rank, seq, straggler.
+
+    python scripts/postmortem.py FLIGHT_DIR [--heartbeats DIR]
+                                 [--telemetry DIR] [--json OUT] [--context N]
+
+Merges the per-rank flight-recorder rings (``flight_rank<k>.ring``,
+written crash-durably by ``heat_tpu.utils.flightrec``) — optionally
+joined with the heartbeat beacons and the telemetry JSONL exports — into
+ONE verdict:
+
+- ``desync``   — the first sequence number where rank fingerprints
+  ``(op, gshape, dtype, src/dst split, wire bytes)`` differ: the classic
+  SPMD divergence (a rank-conditional extra/missing collective).  Ranks
+  are grouped by fingerprint; a minority group is named as deviating.
+- ``straggler`` — fingerprints agree on the common window but one rank's
+  sequence stops short: that rank is stuck at its last staged collective
+  while its peers moved on.  Wait-time evidence (the ``comm.<name>.wait``
+  histograms exported through telemetry) is attached when available.
+- ``clean``    — identical streams AND every rank's ring ends in a
+  ``shutdown`` record (written by ``bootstrap.finalize_distributed``).
+- ``inconclusive`` — no rings, no collective records, or identical
+  streams without shutdown markers (a global stall looks like this:
+  every rank stuck at the SAME collective).
+
+Deliberately stdlib-only and standalone-loadable (the supervisor loads
+this file via ``spec_from_file_location`` from a process that never
+imports jax); the ring-format reader is borrowed from
+``heat_tpu/utils/flightrec.py``, itself loaded standalone, so there is
+exactly one parser for the on-disk format.
+
+Exit code: 0 when a verdict was produced (including ``clean``), 1 when
+no rings were found/readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FLIGHTREC_PATH = os.path.join(_REPO, "heat_tpu", "utils", "flightrec.py")
+_flightrec = None
+
+
+def _flightrec_mod():
+    """The ring-format reader, loaded standalone (never imports heat_tpu)."""
+    global _flightrec
+    if _flightrec is None:
+        in_pkg = sys.modules.get("heat_tpu.utils.flightrec")
+        if in_pkg is not None:  # already imported (in-process tests)
+            _flightrec = in_pkg
+            return _flightrec
+        spec = importlib.util.spec_from_file_location(
+            "heat_postmortem_flightrec", _FLIGHTREC_PATH
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _flightrec = mod
+    return _flightrec
+
+
+# ---------------------------------------------------------------------- #
+# loading
+# ---------------------------------------------------------------------- #
+def load_rings(flight_dir: str) -> Dict[int, dict]:
+    """rank → parsed ring (unreadable files are skipped, not fatal — the
+    black box must yield whatever it can after any crash)."""
+    fr = _flightrec_mod()
+    rings: Dict[int, dict] = {}
+    for path in fr.find_ring_files(flight_dir):
+        try:
+            ring = fr.read_ring(path)
+        except (OSError, ValueError):
+            continue
+        rings[int(ring["rank"])] = ring
+    return rings
+
+
+def load_heartbeats(hb_dir: Optional[str]) -> Dict[int, dict]:
+    """rank → last heartbeat payload (+ file age in ``age_s``)."""
+    import glob
+    import time
+
+    out: Dict[int, dict] = {}
+    if not hb_dir:
+        return out
+    for path in sorted(glob.glob(os.path.join(hb_dir, "rank*.json"))):
+        base = os.path.basename(path)[len("rank") : -len(".json")]
+        try:
+            rank = int(base)
+        except ValueError:
+            continue
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+            rec["age_s"] = round(time.time() - os.path.getmtime(path), 1)
+        except (OSError, ValueError):
+            continue
+        out[rank] = rec
+    return out
+
+
+def load_wait_hists(telemetry_dir: Optional[str]) -> Dict[int, Dict[str, dict]]:
+    """rank → {histogram name → summary} for the ``*.wait`` histograms in
+    the per-rank telemetry JSONL exports (last snapshot wins within a
+    rank, like the telemetry merge)."""
+    import glob
+
+    out: Dict[int, Dict[str, dict]] = {}
+    if not telemetry_dir:
+        return out
+    for path in sorted(glob.glob(os.path.join(telemetry_dir, "rank*.jsonl"))):
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("type") != "hist" or not str(rec.get("name", "")).endswith(
+                ".wait"
+            ):
+                continue
+            rank = int(rec.get("rank", 0))
+            out.setdefault(rank, {})[rec["name"]] = {
+                "count": int(rec.get("count", 0)),
+                "total_s": round(float(rec.get("total_s", 0.0)), 3),
+                "max_s": round(float(rec.get("max_s", 0.0)), 3),
+            }
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# analysis
+# ---------------------------------------------------------------------- #
+_FP_FIELDS = ("op", "gshape", "dtype", "src", "dst", "wire")
+
+
+def _fingerprint(rec: dict, fields: Tuple[str, ...] = _FP_FIELDS) -> Tuple:
+    return tuple(
+        tuple(v) if isinstance(v := rec.get(f), list) else v for f in fields
+    )
+
+
+def _common_fp_fields(recs: List[dict]) -> Tuple[str, ...]:
+    """The fingerprint fields comparable across ``recs``: when any record
+    was truncated (``trunc=1`` — the ring writer shed its bulky fields,
+    e.g. ``gshape``, to fit the slot), a field absent from SOME records is
+    dropped from the comparison rather than read as a divergence — slot
+    truncation is per-rank (payload byte lengths differ by rank) and must
+    never convict an innocent rank of desync."""
+    if not any(rec.get("trunc") for rec in recs):
+        return _FP_FIELDS
+    return tuple(f for f in _FP_FIELDS if all(f in rec for rec in recs))
+
+
+def _coll_by_seq(ring: dict) -> Dict[int, dict]:
+    return {
+        int(r["seq"]): r
+        for r in ring.get("records", [])
+        if r.get("k") == "coll" and "seq" in r
+    }
+
+
+def _fp_brief(rec: Optional[dict]) -> Optional[dict]:
+    if rec is None:
+        return None
+    out = {f: rec.get(f) for f in _FP_FIELDS if rec.get(f) is not None}
+    out["seq"] = rec.get("seq")
+    return out
+
+
+def analyze(
+    rings: Dict[int, dict],
+    heartbeats: Optional[Dict[int, dict]] = None,
+    waits: Optional[Dict[int, Dict[str, dict]]] = None,
+    expected_ranks: Optional[List[int]] = None,
+) -> dict:
+    """Merge per-rank rings into one verdict (see the module docstring for
+    the taxonomy).
+
+    ``expected_ranks`` is the world the caller launched (the supervisor
+    and the mp launcher pass it): a rank whose ring is MISSING can then
+    never hide inside a ``clean`` verdict — a lost black box on a known
+    rank is itself the finding.  Independent of it, a rank whose ring
+    exists but holds no collective records while peers progressed is
+    named a straggler stuck at seq 0 (died/wedged before its first
+    collective) instead of being silently dropped from the analysis."""
+    verdict: dict = {
+        "verdict": "inconclusive",
+        "ranks": sorted(rings),
+        "last_seq": {},
+        "first_divergent_seq": None,
+        "divergence": None,
+        "straggler": None,
+        "detail": "",
+    }
+    missing = (
+        sorted(set(int(r) for r in expected_ranks) - set(rings))
+        if expected_ranks is not None
+        else []
+    )
+    if missing:
+        verdict["missing_ranks"] = missing
+    if heartbeats:
+        verdict["heartbeats"] = {
+            str(r): {
+                k: hb.get(k)
+                for k in ("step", "seq", "collective", "status", "age_s")
+                if hb.get(k) is not None
+            }
+            for r, hb in sorted(heartbeats.items())
+        }
+    if not rings:
+        verdict["detail"] = "no flight-recorder ring files found" + (
+            f" for rank(s) {missing}" if missing else ""
+        )
+        return verdict
+    colls = {r: _coll_by_seq(ring) for r, ring in rings.items()}
+    with_colls = [r for r in sorted(colls) if colls[r]]
+    coll_less = [r for r in sorted(colls) if not colls[r]]
+    if not with_colls:
+        verdict["detail"] = "rings contain no collective records"
+        return verdict
+    last_seq = {r: max(colls[r]) for r in with_colls}
+    first_seq = {r: min(colls[r]) for r in with_colls}
+    verdict["last_seq"] = {str(r): last_seq[r] for r in with_colls}
+
+    # ---- desync: first seq (inside the window every ring still holds)
+    # where the rank fingerprints differ ------------------------------- #
+    lo = max(first_seq.values())
+    hi = min(last_seq.values())
+    for s in range(lo, hi + 1):
+        present = {r: colls[r].get(s) for r in with_colls}
+        held = [rec for rec in present.values() if rec is not None]
+        fields = _common_fp_fields(held)
+        groups: Dict[Tuple, List[int]] = {}
+        for r, rec in present.items():
+            if rec is not None:
+                groups.setdefault(_fingerprint(rec, fields), []).append(r)
+        if len(groups) > 1:
+            # minority group deviates (ties — e.g. 2 ranks — name all)
+            sizes = sorted(len(v) for v in groups.values())
+            minority = [
+                r for fp, rs in groups.items() if len(rs) == sizes[0] for r in rs
+            ]
+            majority_possible = sizes[0] < sizes[-1]
+            verdict["verdict"] = "desync"
+            verdict["first_divergent_seq"] = s
+            verdict["divergence"] = {
+                str(r): _fp_brief(present[r]) for r in with_colls
+            }
+            verdict["deviating_ranks"] = sorted(minority) if majority_possible else sorted(
+                with_colls
+            )
+            ops = ", ".join(
+                f"rank {r}: {present[r].get('op')}" for r in sorted(present)
+                if present[r] is not None
+            )
+            verdict["detail"] = (
+                f"rank fingerprints diverge at seq {s} ({ops})"
+                + (
+                    f"; minority rank(s) {sorted(minority)} deviate"
+                    if majority_possible
+                    else "; 2-way split — cannot vote on the deviant"
+                )
+            )
+            return verdict
+
+    # ---- straggler: identical window, but someone's stream stops short.
+    # A ring with NO collective records while peers progressed is the
+    # extreme case — that rank died or wedged before its first collective
+    # (seq 0), and silently dropping it would let a clean verdict lie. #
+    global_max = max(last_seq.values())
+    behind = sorted(r for r in with_colls if last_seq[r] < global_max)
+    if coll_less or behind:
+        if coll_less:
+            worst, worst_seq, stuck = min(coll_less), 0, None
+            behind = sorted(set(behind) | set(coll_less))
+        else:
+            worst = min(behind, key=lambda r: last_seq[r])
+            worst_seq = last_seq[worst]
+            stuck = colls[worst][worst_seq]
+        verdict["verdict"] = "straggler"
+        verdict["straggler"] = {
+            "rank": worst,
+            "ranks_behind": behind,
+            "seq": worst_seq,
+            "op": stuck.get("op") if stuck else None,
+            "fingerprint": _fp_brief(stuck),
+            "lag": global_max - worst_seq,
+            "peers_at": global_max,
+        }
+        if waits and waits.get(worst):
+            top = sorted(
+                waits[worst].items(), key=lambda kv: -kv[1]["total_s"]
+            )[:3]
+            verdict["straggler"]["wait"] = dict(top)
+        if stuck is not None:
+            verdict["detail"] = (
+                f"rank {worst} stuck at seq {worst_seq} "
+                f"{stuck.get('op')} while peers reached seq {global_max} "
+                f"(lag {global_max - worst_seq})"
+            )
+        else:
+            verdict["detail"] = (
+                f"rank {worst} staged no collectives (stuck at seq 0) "
+                f"while peers reached seq {global_max}"
+            )
+        if waits:
+            verdict["wait_per_rank"] = {
+                str(r): w for r, w in sorted(waits.items())
+            }
+        return verdict
+
+    # ---- identical streams: clean iff every ring ends in shutdown ----- #
+    def _has_shutdown(ring: dict) -> bool:
+        return any(r.get("k") == "shutdown" for r in ring.get("records", []))
+
+    if missing:
+        # a lost black box on a known rank can never hide inside `clean`:
+        # the surviving streams agree, but the world's story is incomplete
+        verdict["detail"] = (
+            f"rank(s) {missing} left no ring file while the surviving "
+            f"rank(s) agree through seq {global_max} — cannot attest clean"
+        )
+    elif all(_has_shutdown(rings[r]) for r in with_colls):
+        verdict["verdict"] = "clean"
+        verdict["detail"] = (
+            f"all {len(with_colls)} rank(s) agree through seq {global_max} "
+            "and recorded a clean shutdown"
+        )
+    else:
+        stuck = colls[with_colls[0]][global_max]
+        verdict["detail"] = (
+            f"all ranks at seq {global_max} ({stuck.get('op')}) with no "
+            "shutdown record — global stall, or the run was cut before "
+            "teardown"
+        )
+    return verdict
+
+
+def analyze_dir(
+    flight_dir: str,
+    heartbeat_dir: Optional[str] = None,
+    telemetry_dir: Optional[str] = None,
+    expected_ranks: Optional[List[int]] = None,
+) -> dict:
+    """Load + analyze one run's artifacts."""
+    rings = load_rings(flight_dir)
+    return analyze(
+        rings,
+        heartbeats=load_heartbeats(heartbeat_dir),
+        waits=load_wait_hists(telemetry_dir),
+        expected_ranks=expected_ranks,
+    )
+
+
+def summary_line(verdict: dict, epoch: Optional[int] = None) -> str:
+    """The one-line form launchers print (``POSTMORTEM verdict=…``)."""
+    parts = ["POSTMORTEM"]
+    if epoch is not None:
+        parts.append(f"epoch={epoch}")
+    parts.append(f"verdict={verdict.get('verdict')}")
+    s = verdict.get("straggler")
+    if s:
+        parts.append(f"rank={s['rank']} seq={s['seq']} op={s['op']} lag={s['lag']}")
+    elif verdict.get("first_divergent_seq") is not None:
+        parts.append(f"seq={verdict['first_divergent_seq']}")
+        dev = verdict.get("deviating_ranks")
+        if dev:
+            parts.append("ranks=" + ",".join(str(r) for r in dev))
+    return " ".join(parts)
+
+
+# ---------------------------------------------------------------------- #
+# rendering
+# ---------------------------------------------------------------------- #
+def render_grid(
+    rings: Dict[int, dict], around: Optional[int] = None, context: int = 5
+) -> str:
+    """seq × rank grid of collective fingerprints, centered on ``around``
+    (or the tail).  ``*`` marks rows where ranks disagree; ``·`` marks a
+    rank with no record at that seq."""
+    colls = {r: _coll_by_seq(ring) for r, ring in sorted(rings.items())}
+    ranks = [r for r in sorted(colls) if colls[r]]
+    if not ranks:
+        return "(no collective records)"
+    lo = min(min(c) for c in colls.values() if c)
+    hi = max(max(c) for c in colls.values() if c)
+    if around is None:
+        around = hi
+    s0 = max(lo, around - context)
+    s1 = min(hi, around + context)
+
+    def cell(rec: Optional[dict]) -> str:
+        if rec is None:
+            return "·"
+        bits = [str(rec.get("op"))]
+        if rec.get("gshape") is not None:
+            bits.append("x".join(str(v) for v in rec["gshape"]))
+        if rec.get("wire") is not None:
+            bits.append(f"{rec['wire']}B")
+        return " ".join(bits)
+
+    header = ["seq"] + [f"rank{r}" for r in ranks] + [""]
+    rows = []
+    for s in range(s0, s1 + 1):
+        recs = [colls[r].get(s) for r in ranks]
+        held = [rec for rec in recs if rec is not None]
+        fields = _common_fp_fields(held)
+        fps = {_fingerprint(rec, fields) for rec in held}
+        mark = "*" if (len(fps) > 1 or any(rec is None for rec in recs)) else ""
+        rows.append([str(s)] + [cell(rec) for rec in recs] + [mark])
+    widths = [max(len(r[i]) for r in [header] + rows) for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*row) for row in rows]
+    return "\n".join(lines)
+
+
+def render(verdict: dict, rings: Optional[Dict[int, dict]] = None) -> str:
+    out = [summary_line(verdict), verdict.get("detail", "")]
+    if verdict.get("missing_ranks"):
+        out.append(
+            "rank(s) with NO ring file: "
+            + ", ".join(str(r) for r in verdict["missing_ranks"])
+        )
+    # verdict dicts key ranks by str() (JSON round-trip safety): sort the
+    # report numerically or rank 10 renders before rank 2 at pod scale
+    by_rank = lambda kv: int(kv[0])  # noqa: E731
+    if verdict.get("last_seq"):
+        out.append(
+            "last staged seq per rank: "
+            + ", ".join(
+                f"rank {r}: {s}"
+                for r, s in sorted(verdict["last_seq"].items(), key=by_rank)
+            )
+        )
+    hbs = verdict.get("heartbeats")
+    if hbs:
+        for r, hb in sorted(hbs.items(), key=by_rank):
+            fields = " ".join(f"{k}={v}" for k, v in hb.items())
+            out.append(f"heartbeat rank {r}: {fields}")
+    s = verdict.get("straggler")
+    if s and s.get("wait"):
+        out.append(f"rank {s['rank']} blocking-wait evidence:")
+        for name, w in s["wait"].items():
+            out.append(
+                f"  {name}: n={w['count']} total={w['total_s']}s max={w['max_s']}s"
+            )
+    if rings:
+        around = verdict.get("first_divergent_seq")
+        if around is None and s:
+            around = s.get("seq")
+        out.append("")
+        out.append("-- collective timeline (seq × rank) --")
+        out.append(render_grid(rings, around=around))
+    return "\n".join(line for line in out if line is not None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("flight_dir", help="directory holding flight_rank*.ring files")
+    ap.add_argument("--heartbeats", default=None, help="heartbeat beacon dir")
+    ap.add_argument("--telemetry", default=None, help="telemetry jsonl export dir")
+    ap.add_argument("--json", default=None, help="also write the verdict here")
+    ap.add_argument("--context", type=int, default=5,
+                    help="grid rows either side of the point of interest")
+    ap.add_argument("--expected-ranks", type=int, default=None, metavar="N",
+                    help="world size launched: a rank 0..N-1 whose ring is "
+                         "missing blocks a clean verdict")
+    args = ap.parse_args(argv)
+
+    rings = load_rings(args.flight_dir)
+    verdict = analyze(
+        rings,
+        heartbeats=load_heartbeats(args.heartbeats),
+        waits=load_wait_hists(args.telemetry),
+        expected_ranks=(
+            list(range(args.expected_ranks))
+            if args.expected_ranks is not None
+            else None
+        ),
+    )
+    print(render(verdict, rings))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(verdict, fh, indent=1)
+        print(f"\nverdict JSON written to {args.json}")
+    if not rings:
+        print(f"no flight_rank*.ring files under {args.flight_dir}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,14 @@ Each rank of a run writes ``rank<k>.jsonl`` (``heat_tpu.utils.telemetry
 - merged **histograms** (log-spaced bins sum exactly across ranks; the
   percentiles are recomputed from the merged bins);
 - a merged **timeline**: the first N spans of all ranks on one wall-clock
-  axis (span timestamps are exported in epoch seconds for this reason).
+  axis (span timestamps are exported in epoch seconds for this reason);
+- when a target directory also holds flight-recorder rings
+  (``flight_rank*.ring``, written crash-durably by
+  ``heat_tpu.utils.flightrec``), a per-rank **collective timeline** — the
+  seq × rank fingerprint grid centered on the first divergence or the
+  straggler's stuck sequence, plus the one-line post-mortem verdict
+  (``scripts/postmortem.py`` does the merge; this CLI just folds its view
+  into the report so one command reads a whole run's artifacts).
 
 Deliberately stdlib-only (no jax, no heat_tpu import): it must run
 instantly on a login node against artifacts scp'd from a pod.
@@ -233,6 +240,78 @@ def render(merged: dict, top: int = 20, timeline: int = 25) -> str:
     return "\n".join(out)
 
 
+_postmortem = None
+
+
+def _postmortem_mod():
+    """``scripts/postmortem.py`` loaded standalone (it lives next to this
+    file; both are stdlib-only) — the ONE implementation of ring loading,
+    verdict analysis and the seq × rank grid.  None when the file is
+    missing (a stripped install): the report then simply has no
+    collective-timeline section."""
+    global _postmortem
+    if _postmortem is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "postmortem.py")
+        if not os.path.exists(path):
+            return None
+        spec = importlib.util.spec_from_file_location("telemetry_report_postmortem", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _postmortem = mod
+    return _postmortem
+
+
+def _jsonl_ranks(d: str) -> List[int]:
+    """Rank numbers of the ``rank<k>.jsonl`` files in ``d`` — every rank
+    that exported telemetry there was part of the world, so they double as
+    the analyzer's expected-ranks hint (a rank with telemetry but no ring
+    lost its black box, and must not hide inside a clean verdict)."""
+    out = []
+    for path in find_rank_files(d):
+        base = os.path.basename(path)
+        try:
+            out.append(int(base[len("rank") : -len(".jsonl")]))
+        except ValueError:
+            continue
+    return sorted(set(out))
+
+
+def flightrec_section(dirs: List[str], context: int = 5) -> str:
+    """The collective-timeline section for every target directory holding
+    ``flight_rank*.ring`` files; '' when none do (the common telemetry-only
+    invocation prints nothing extra).  The verdict gets the same evidence
+    the supervisor's analyzer gets: the dir's own telemetry jsonl for wait
+    attribution, and its jsonl rank set as expected ranks."""
+    pm = _postmortem_mod()
+    if pm is None:
+        return ""
+    out = []
+    for d in dirs:
+        rings = pm.load_rings(d)
+        if not rings:
+            continue
+        verdict = pm.analyze(
+            rings,
+            waits=pm.load_wait_hists(d),
+            expected_ranks=_jsonl_ranks(d) or None,
+        )
+        around = verdict.get("first_divergent_seq")
+        if around is None and verdict.get("straggler"):
+            around = verdict["straggler"].get("seq")
+        out.append(f"\n-- collective timeline (seq × rank) from {d} --")
+        out.append(pm.summary_line(verdict))
+        if verdict.get("missing_ranks"):
+            out.append(
+                "rank(s) with telemetry but NO ring file: "
+                + ", ".join(str(r) for r in verdict["missing_ranks"])
+            )
+        out.append(pm.render_grid(rings, around=around, context=context))
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("targets", nargs="+", help="telemetry dirs and/or rank*.jsonl files")
@@ -240,17 +319,37 @@ def main(argv=None) -> int:
     ap.add_argument("--top", type=int, default=20, help="span-summary rows to print")
     ap.add_argument("--timeline", type=int, default=25,
                     help="timeline rows to print (0 disables)")
+    ap.add_argument("--context", type=int, default=5,
+                    help="collective-grid rows either side of the divergence")
     args = ap.parse_args(argv)
 
     paths = []
     for t in args.targets:
         paths.extend(find_rank_files(t))
     paths = sorted(dict.fromkeys(paths))  # de-dup, stable order
+    section = flightrec_section(
+        [t for t in args.targets if os.path.isdir(t)], context=args.context
+    )
     if not paths:
-        print(f"no rank*.jsonl files found under {args.targets}", file=sys.stderr)
+        # a dir holding ONLY flight-recorder rings is a legitimate target:
+        # the supervisor's harvested epoch dirs contain rings but no
+        # telemetry jsonl, and the timeline is exactly what a post-mortem
+        # reader comes for
+        if section:
+            print(f"no rank*.jsonl telemetry files under {args.targets}; "
+                  "rendering the flight-recorder timeline only")
+            print(section)
+            return 0
+        print(
+            f"no rank*.jsonl files (nor flight_rank*.ring files) found "
+            f"under {args.targets}",
+            file=sys.stderr,
+        )
         return 1
     merged = merge_files(paths)
     print(render(merged, top=args.top, timeline=args.timeline))
+    if section:
+        print(section)
     if args.json:
         # the timeline can be huge; the JSON artifact keeps it whole (the
         # text rendering is the bounded view)

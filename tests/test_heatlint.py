@@ -29,6 +29,7 @@ from heat_tpu.analysis.rules import (
     NakedBlockingWaitRule,
     RankConditionalCollectiveRule,
     RawEntropyRule,
+    SeqStampBypassRule,
     UseAfterDonateRule,
 )
 
@@ -559,6 +560,80 @@ class TestHT107:
 
 
 # ---------------------------------------------------------------------- #
+# HT108 — collective staging bypassing the seq-stamp choke point
+# ---------------------------------------------------------------------- #
+class TestHT108:
+    def test_direct_execute_plan_flagged(self):
+        fs = run_rule(SeqStampBypassRule(), """
+            from heat_tpu.core import redistribution
+            def f(comm, array, plan):
+                return redistribution.execute_plan(comm, array, plan)
+        """)
+        assert [f.detail for f in fs] == ["execute_plan"]
+        assert fs[0].rule == "HT108"
+
+    def test_resharding_device_put_flagged(self):
+        fs = run_rule(SeqStampBypassRule(), """
+            import jax
+            def f(comm, x):
+                return jax.device_put(x._jarray, comm.sharding(x.ndim, 1))
+        """)
+        assert [f.detail for f in fs] == ["device_put"]
+
+    def test_named_sharding_target_flagged(self):
+        fs = run_rule(SeqStampBypassRule(), """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def f(mesh, x):
+                return jax.device_put(x._parray, NamedSharding(mesh, P("dcn")))
+        """)
+        assert [f.detail for f in fs] == ["device_put"]
+
+    def test_host_upload_not_flagged(self):
+        # device_put of HOST data onto a sharding is placement (an upload
+        # scatter), not collective traffic staged around the choke point
+        fs = run_rule(SeqStampBypassRule(), """
+            import jax
+            import jax.numpy as jnp
+            def f(comm, host, new, sh):
+                a = jax.device_put(host, comm.sharding(2, 0))
+                b = jax.device_put(jnp.asarray(new), sh)
+                return a, b
+        """)
+        assert fs == []
+
+    def test_single_device_put_not_flagged(self):
+        fs = run_rule(SeqStampBypassRule(), """
+            import jax
+            def f(x, d):
+                return jax.device_put(x._jarray, d)
+        """)
+        assert fs == []
+
+    def test_accounting_layer_sanctioned(self):
+        src = """
+            import jax
+            def resplit_tiled(self, array, split, plan):
+                from . import redistribution
+                return redistribution.execute_plan(self, array, plan)
+        """
+        assert run_rule(
+            SeqStampBypassRule(), src, path="heat_tpu/core/communication.py"
+        ) == []
+        assert run_rule(
+            SeqStampBypassRule(), src, path="heat_tpu/core/redistribution.py"
+        ) == []
+
+    def test_suppression_works(self):
+        fs = run_rule(SeqStampBypassRule(), """
+            from heat_tpu.core import redistribution
+            def f(comm, array, plan):
+                return redistribution.execute_plan(comm, array, plan)  # heatlint: disable=HT108 bench harness
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------- #
 # framework: suppressions, baseline, discovery, CLI
 # ---------------------------------------------------------------------- #
 class TestFramework:
@@ -596,6 +671,7 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
+            "HT108",
         ]
 
     def test_select_unknown_rule_raises(self):

@@ -64,9 +64,91 @@ def test_n_process_spmd_tier(n_proc, devs):
         # seam, bit-exact vs the monolithic oracle (ISSUE 6: the chunked
         # pipeline's per-tile SPMD programs over a real multi-process mesh)
         assert f"[{pid}] RESPLIT-BUDGETED tiles=3" in out, out[-2000:]
+        # ...and seq-stamped every staged collective into its crash-durable
+        # flight-recorder ring (ISSUE 7): lockstep SPMD means every rank
+        # reports the IDENTICAL final sequence number
+        assert re.search(rf"\[{pid}\] FLIGHTREC seq=\d+ op=", out), out[-2000:]
+    seqs = set(re.findall(r"\] FLIGHTREC seq=(\d+) op=", out))
+    assert len(seqs) == 1, f"ranks disagree on the collective seq: {seqs}"
     # ...and the launcher merged them into ONE multi-rank report (ISSUE 3
     # acceptance: scripts/telemetry_report.py folds the mp lane's rank files)
     assert f"TELEMETRY-MERGED ranks={n_proc}" in out, out[-2000:]
+    # ...and the green run's rings read CLEAN end to end (ISSUE 7: every
+    # rank's stream identical AND terminated by a shutdown record)
+    assert "POSTMORTEM verdict=clean" in out, out[-2000:]
+
+
+@pytest.mark.heavy
+@pytest.mark.slow
+@pytest.mark.chaos  # runs in the chaos CI lane too (-m chaos)
+def test_postmortem_names_hung_rank_and_seq():
+    """ISSUE 7 acceptance (a): one rank of a live 2-process gloo world hangs
+    inside a staged collective (injected ``comm.collective`` hang at a known
+    iteration) → the supervisor's heartbeat monitor tears the world down →
+    the harvested flight-recorder rings name the hung rank AND the exact
+    collective sequence it hung on (the stamp is written before the fault
+    site fires, so the ring's last record IS the wedged collective)."""
+    proc = mpd.launch(
+        timeout=700,
+        n_proc=2,
+        devs_per_proc=4,
+        mode="postmortem",
+        extra_env={
+            "MPDRYRUN_HANG_RANK": 1,
+            "MPDRYRUN_CHAOS_AT": 3,
+            # short staleness budget: the postmortem worker pre-touches its
+            # beacon before the heavy bring-up imports, so 25 s covers
+            # bring-up while keeping post-hang detection fast
+            "MPDRYRUN_HB_TIMEOUT": 25,
+        },
+    )
+    out = proc.stdout
+    # a wedged world is a FAILED run: restart budget 0 -> supervisor gives
+    # up after the teardown, with the post-mortem in its report
+    assert proc.returncode != 0
+    assert "SUPERVISOR GAVE UP" in out, out[-3000:]
+    # the victim announced the seq of the collective it was armed to hang
+    # on; the analyzer must name that rank and that exact seq/op
+    m = re.search(r"\[1\] PM-HANG expect_seq=(\d+)", out)
+    assert m, out[-3000:]
+    expect_seq = int(m.group(1))
+    verdict = f"POSTMORTEM epoch=0 verdict=straggler rank=1 seq={expect_seq} op=resplit"
+    assert verdict in out, out[-3000:]
+    # the heartbeat beacons carried the flight recorder's seq, so the
+    # supervisor's staleness line shows SEMANTIC progress, not just mtime
+    assert re.search(r"heartbeat stale .*stuck at seq \d+ resplit", out), out[-3000:]
+
+
+@pytest.mark.heavy
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_postmortem_names_first_divergent_seq():
+    """ISSUE 7 acceptance (b): one rank of a 3-process world stages a
+    rank-conditional EXTRA collective (the classic SPMD desync) → the
+    analyzer reports the first divergent sequence and names the deviating
+    rank by majority vote across the 3 fingerprint streams."""
+    proc = mpd.launch(
+        timeout=700,
+        n_proc=3,
+        devs_per_proc=2,
+        mode="postmortem",
+        extra_env={
+            "MPDRYRUN_DESYNC_RANK": 1,
+            "MPDRYRUN_CHAOS_AT": 3,
+            "MPDRYRUN_HB_TIMEOUT": 25,
+        },
+    )
+    out = proc.stdout
+    assert proc.returncode != 0
+    assert "SUPERVISOR GAVE UP" in out, out[-3000:]
+    m = re.search(r"\[1\] PM-DESYNC expect_seq=(\d+)", out)
+    assert m, out[-3000:]
+    expect_seq = int(m.group(1))
+    # first divergent seq = the extra collective's stamp; rank 1 is the
+    # minority fingerprint group among 3 ranks
+    assert f"POSTMORTEM epoch=0 verdict=desync seq={expect_seq} ranks=1" in out, (
+        out[-3000:]
+    )
 
 
 @pytest.mark.heavy
