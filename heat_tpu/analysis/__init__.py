@@ -2,15 +2,20 @@
 
 Two halves, one contract set:
 
-- **heatlint** (:mod:`.framework`, :mod:`.rules`): a plugin-based AST
-  linter (CLI: ``scripts/heatlint.py``) with rules HT101–HT106 encoding
-  the no-host-sync, SPMD-consistency, donation, byte-accounting, broadcast-
-  seeding, and metadata-immutability contracts.  Gates CI against a
-  committed baseline.
+- **heatlint** (:mod:`.framework`, :mod:`.rules`, and the interprocedural
+  engine :mod:`.callgraph` + :mod:`.summaries`): a plugin-based AST linter
+  (CLI: ``scripts/heatlint.py``) with lexical rules HT101–HT108 (host
+  syncs, SPMD-consistency, donation, byte-accounting, broadcast seeding,
+  metadata immutability, deadline scopes, seq-stamp choke point) and the
+  HT2xx family that propagates effect summaries through a package-wide
+  call graph (static desync, transitive host sync, interprocedural
+  use-after-donate, transitively undeadlined blocking) — each the static
+  twin of a runtime failure mode.  Gates CI against a committed baseline;
+  unresolved-call conclusions are downgraded to non-gating ``info``.
 - **runtime sanitizer** (:mod:`heat_tpu.core.sanitation`, armed by
   ``HEAT_TPU_CHECKS=1``): a metadata-only validator at the dispatch tails
   and factory/resplit boundaries — the dynamic complement for what the
-  lexical rules cannot see.
+  static rules cannot see.
 
 See doc/source/design.md "Static contracts".
 """
@@ -20,15 +25,19 @@ from .framework import (
     LintContext,
     Rule,
     all_rules,
+    disabled_rules_for,
     lint_file,
     lint_paths,
     load_baseline,
     register,
     render_json,
+    render_sarif,
     render_text,
     split_by_baseline,
     write_baseline,
 )
+from . import callgraph  # noqa: F401
+from . import summaries  # noqa: F401
 from . import rules  # noqa: F401  — registers the built-in rules on import
 
 __all__ = [
@@ -36,13 +45,17 @@ __all__ = [
     "LintContext",
     "Rule",
     "all_rules",
+    "callgraph",
+    "disabled_rules_for",
     "lint_file",
     "lint_paths",
     "load_baseline",
     "register",
     "render_json",
+    "render_sarif",
     "render_text",
     "rules",
     "split_by_baseline",
+    "summaries",
     "write_baseline",
 ]

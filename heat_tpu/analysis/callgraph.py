@@ -1,0 +1,726 @@
+"""Package-wide call graph for heatlint's interprocedural (HT2xx) passes.
+
+The lexical rules (HT101–HT108) stop at function boundaries on purpose; the
+HT2xx family needs to know *who calls whom* so effect summaries can flow
+through helpers.  This module extracts per-file **structure facts** — defs,
+classes with bases, import aliases (module- and function-level: the
+codebase's lazy-import idiom), module-level jit aliases — and resolves call
+descriptors against them:
+
+- ``self.method()`` resolves through the enclosing class, then program-
+  resolvable base classes;
+- module-qualified calls (``manipulations.resplit(...)``, ``_redist.
+  execute_plan(...)``) resolve through the alias table, chasing re-exports
+  (``from .core.factories import arange`` in ``__init__.py``) a bounded
+  number of hops;
+- bare names resolve through nested defs (innermost first), module-level
+  defs, local/module jit aliases, then imports.
+
+**The unresolved bucket is explicit, never silently dropped.**  Every call
+that cannot be resolved lands in :attr:`CallGraph.unresolved` with a
+*reason*, split into two honesty classes (see design.md "Static
+contracts"):
+
+- *poisoning* (``benign=False``): getattr-style dynamic dispatch, calls of
+  parameters/locals/lambdas, unknown bare names — the callee could stage
+  anything, so any HT2xx conclusion that depends on this call site is
+  downgraded to ``info`` severity (never a gating false positive);
+- *benign* (``benign=True``): method calls on unknown receivers
+  (``x.save()``) and externally-inherited methods.  These are **assumed
+  collective-free** because collective entry points are matched lexically
+  by name wherever they appear (``comm.Allreduce`` emits its atom whether
+  or not ``comm`` resolves) — an accepted, documented false-negative class.
+
+Stdlib-only and standalone-loadable (the synthetic-package trick in
+``scripts/heatlint.py``): never imports jax, numpy, or heat_tpu proper.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+FuncKey = Tuple[str, str]  # (path, qualname)
+
+# re-export chase / base-class walk bound: deep enough for any sane package
+# layout, small enough that a pathological alias cycle terminates fast
+_CHASE_DEPTH = 8
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+# -------------------------------------------------------------------- #
+# shared AST helpers (rules.py re-exports these for compatibility)
+# -------------------------------------------------------------------- #
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'np.random.seed' for Attribute/Name chains, None for anything else."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def last_attr(call: ast.Call) -> Optional[str]:
+    """Final attribute of a call target: 'item' for ``x.y.item()``."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def module_name_for_path(path: str) -> str:
+    """Dotted module name derived from the (posix-normalized) file path.
+
+    Resolution matches by *suffix*, so the name only has to be consistent
+    across the linted tree, not anchored at any particular filesystem root.
+    """
+    p = path[:-3] if path.endswith(".py") else path
+    parts = [seg for seg in p.split("/") if seg not in ("", ".", "..")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else "<root>"
+
+
+# -------------------------------------------------------------------- #
+# serializable structure facts (cacheable per file, keyed by content hash)
+# -------------------------------------------------------------------- #
+
+
+@dataclass
+class CallDesc:
+    """One call site, pre-resolution: everything resolution needs, nothing
+    tied to the live AST (so it round-trips through the summary cache)."""
+
+    dotted: Optional[str]  # "self._account" / "np.asarray" / "fn" / None
+    attr: Optional[str]  # final attribute or bare name
+    line: int = 0
+    col: int = 0
+    args: Tuple[Optional[str], ...] = ()  # positional arg Name ids (or None)
+    dynamic: Optional[str] = None  # "getattr" | "dynamic-expression" | None
+    donate_kwarg: bool = False  # lexical donate=True at the call site (HT103's)
+
+    def to_json(self) -> dict:
+        return {
+            "dotted": self.dotted,
+            "attr": self.attr,
+            "line": self.line,
+            "col": self.col,
+            "args": list(self.args),
+            "dynamic": self.dynamic,
+            "donate_kwarg": self.donate_kwarg,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CallDesc":
+        return cls(
+            dotted=d.get("dotted"),
+            attr=d.get("attr"),
+            line=int(d.get("line", 0)),
+            col=int(d.get("col", 0)),
+            args=tuple(d.get("args", ())),
+            dynamic=d.get("dynamic"),
+            donate_kwarg=bool(d.get("donate_kwarg", False)),
+        )
+
+
+@dataclass
+class FuncFacts:
+    """Structure facts for one def (module function, method, or nested def)."""
+
+    qualname: str
+    name: str
+    line: int
+    col: int
+    params: Tuple[str, ...] = ()
+    class_name: Optional[str] = None
+    decorators: Tuple[str, ...] = ()
+    # name-resolution scope material
+    local_lambdas: Tuple[str, ...] = ()
+    local_assigned: Tuple[str, ...] = ()
+    # local jit/alias table: name -> (target bare name, donated positions)
+    local_aliases: Dict[str, Tuple[str, Tuple[int, ...]]] = field(default_factory=dict)
+
+    @property
+    def is_public(self) -> bool:
+        if any(part.startswith("_") for part in self.qualname.split(".")):
+            return False
+        # a dotted qualname without a class context is a def nested inside a
+        # function — local, never a public API surface
+        return self.class_name is not None or "." not in self.qualname
+
+    def to_json(self) -> dict:
+        return {
+            "qualname": self.qualname,
+            "name": self.name,
+            "line": self.line,
+            "col": self.col,
+            "params": list(self.params),
+            "class_name": self.class_name,
+            "decorators": list(self.decorators),
+            "local_lambdas": list(self.local_lambdas),
+            "local_assigned": list(self.local_assigned),
+            "local_aliases": {k: [v[0], list(v[1])] for k, v in self.local_aliases.items()},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FuncFacts":
+        return cls(
+            qualname=d["qualname"],
+            name=d["name"],
+            line=int(d.get("line", 1)),
+            col=int(d.get("col", 0)),
+            params=tuple(d.get("params", ())),
+            class_name=d.get("class_name"),
+            decorators=tuple(d.get("decorators", ())),
+            local_lambdas=tuple(d.get("local_lambdas", ())),
+            local_assigned=tuple(d.get("local_assigned", ())),
+            local_aliases={
+                k: (v[0], tuple(v[1])) for k, v in d.get("local_aliases", {}).items()
+            },
+        )
+
+
+@dataclass
+class ClassFacts:
+    name: str
+    methods: Dict[str, str] = field(default_factory=dict)  # method name -> qualname
+    bases: Tuple[str, ...] = ()  # dotted base expressions
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "methods": dict(self.methods), "bases": list(self.bases)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ClassFacts":
+        return cls(name=d["name"], methods=dict(d.get("methods", {})), bases=tuple(d.get("bases", ())))
+
+
+@dataclass
+class FileFacts:
+    path: str
+    module: str
+    is_package: bool = False  # __init__.py
+    functions: Dict[str, FuncFacts] = field(default_factory=dict)
+    classes: Dict[str, ClassFacts] = field(default_factory=dict)
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> dotted target
+    star_imports: Tuple[str, ...] = ()  # dotted targets of `from X import *`
+    # module-level `name = jax.jit(fn, donate_argnums=...)` / `name = fn`
+    module_aliases: Dict[str, Tuple[str, Tuple[int, ...]]] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "module": self.module,
+            "is_package": self.is_package,
+            "functions": {k: v.to_json() for k, v in self.functions.items()},
+            "classes": {k: v.to_json() for k, v in self.classes.items()},
+            "imports": dict(self.imports),
+            "star_imports": list(self.star_imports),
+            "module_aliases": {k: [v[0], list(v[1])] for k, v in self.module_aliases.items()},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FileFacts":
+        return cls(
+            path=d["path"],
+            module=d["module"],
+            is_package=bool(d.get("is_package", False)),
+            functions={k: FuncFacts.from_json(v) for k, v in d.get("functions", {}).items()},
+            classes={k: ClassFacts.from_json(v) for k, v in d.get("classes", {}).items()},
+            imports=dict(d.get("imports", {})),
+            star_imports=tuple(d.get("star_imports", ())),
+            module_aliases={
+                k: (v[0], tuple(v[1])) for k, v in d.get("module_aliases", {}).items()
+            },
+        )
+
+
+# -------------------------------------------------------------------- #
+# structure extraction (one walk per file, shares the LintContext tree)
+# -------------------------------------------------------------------- #
+
+
+def _jit_target_and_donated(call: ast.Call) -> Optional[Tuple[str, Tuple[int, ...]]]:
+    """('fn', (0, 1)) when ``call`` is jax.jit/jit of a bare name with a
+    literal donate_argnums (() when absent/dynamic)."""
+    if call_name(call) not in ("jax.jit", "jit"):
+        return None
+    if not call.args or not isinstance(call.args[0], ast.Name):
+        return None
+    donated: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                donated = tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+            elif isinstance(v, ast.Constant) and isinstance(v.value, int):
+                donated = (v.value,)
+    return call.args[0].id, donated
+
+
+def call_desc(call: ast.Call) -> CallDesc:
+    """Build the serializable descriptor for one Call node."""
+    dynamic = None
+    if isinstance(call.func, ast.Call):
+        inner = call_name(call.func)
+        dynamic = "getattr" if inner == "getattr" else "dynamic-expression"
+    elif not isinstance(call.func, (ast.Name, ast.Attribute)):
+        dynamic = "dynamic-expression"
+    dn = dotted_name(call.func) if dynamic is None else None
+    if dynamic is None and dn is None and isinstance(call.func, ast.Attribute):
+        # attribute chain rooted at a non-Name (e.g. ``a[0].item()``,
+        # ``f().close()``): receiver unknowable, keep the attr for lexical
+        # matching but mark the root dynamic
+        dynamic = None  # receiver-unknown is decided at resolution
+    donate_kwarg = any(
+        kw.arg == "donate"
+        and isinstance(kw.value, ast.Constant)
+        and kw.value.value is True
+        for kw in call.keywords
+    )
+    return CallDesc(
+        dotted=dn,
+        attr=last_attr(call),
+        line=getattr(call, "lineno", 0),
+        col=getattr(call, "col_offset", 0),
+        args=tuple(a.id if isinstance(a, ast.Name) else None for a in call.args),
+        dynamic=dynamic,
+        donate_kwarg=donate_kwarg,
+    )
+
+
+def extract_structure(ctx) -> FileFacts:
+    """One pre-order pass over ``ctx.tree`` (a framework.LintContext, duck-
+    typed) collecting every structure fact resolution needs."""
+    path = ctx.path
+    facts = FileFacts(
+        path=path,
+        module=module_name_for_path(path),
+        is_package=path.endswith("/__init__.py") or path == "__init__.py",
+    )
+
+    def scope_of(node: ast.AST) -> str:
+        return ctx.qualname(node)
+
+    for node in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+        qn = scope_of(node)
+        a = node.args
+        params = tuple(p.arg for p in list(a.posonlyargs) + list(a.args))
+        parent = ctx.parent(node)
+        class_name = parent.name if isinstance(parent, ast.ClassDef) else None
+        if class_name is not None and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        decorators = tuple(
+            d for d in (dotted_name(dec) for dec in node.decorator_list) if d
+        )
+        ff = FuncFacts(
+            qualname=qn,
+            name=node.name,
+            line=node.lineno,
+            col=node.col_offset,
+            params=params,
+            class_name=class_name,
+            decorators=decorators,
+        )
+        lambdas, assigned = [], []
+        aliases: Dict[str, Tuple[str, Tuple[int, ...]]] = {}
+        for sub in ast.walk(node):
+            if sub is node or ctx.enclosing_function(sub) is not node:
+                continue
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and isinstance(
+                sub.targets[0], ast.Name
+            ):
+                tgt = sub.targets[0].id
+                if isinstance(sub.value, ast.Lambda):
+                    lambdas.append(tgt)
+                elif isinstance(sub.value, ast.Call):
+                    jt = _jit_target_and_donated(sub.value)
+                    if jt is not None:
+                        if tgt in aliases:
+                            assigned.append(tgt)  # rebound: not a stable alias
+                        else:
+                            aliases[tgt] = jt
+                    else:
+                        assigned.append(tgt)
+                elif isinstance(sub.value, ast.Name):
+                    if tgt in aliases:
+                        assigned.append(tgt)
+                    else:
+                        aliases[tgt] = (sub.value.id, ())
+                else:
+                    assigned.append(tgt)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)) and isinstance(
+                getattr(sub, "target", None), ast.Name
+            ):
+                assigned.append(sub.target.id)
+            elif isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    assigned.extend(
+                        n.id for n in ast.walk(tgt) if isinstance(n, ast.Name)
+                    )
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                assigned.extend(
+                    n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name)
+                )
+            elif isinstance(sub, ast.withitem) and sub.optional_vars is not None:
+                assigned.extend(
+                    n.id for n in ast.walk(sub.optional_vars) if isinstance(n, ast.Name)
+                )
+        # a name assigned more than once is not a stable alias
+        for name in list(aliases):
+            if name in assigned or name in lambdas:
+                del aliases[name]
+                assigned.append(name)
+        ff.local_lambdas = tuple(lambdas)
+        ff.local_assigned = tuple(assigned)
+        ff.local_aliases = aliases
+        facts.functions[qn] = ff
+
+    for node in ctx.walk(ast.ClassDef):
+        # only top-level classes participate in resolution (nested classes
+        # are vanishingly rare in this codebase)
+        cf = ClassFacts(
+            name=node.name,
+            bases=tuple(b for b in (dotted_name(bb) for bb in node.bases) if b),
+        )
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cf.methods[item.name] = scope_of(item)
+        # last definition wins, same as Python itself
+        facts.classes[node.name] = cf
+
+    star: List[str] = []
+    for node in ctx.walk(ast.Import):
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            facts.imports.setdefault(bound, target)
+    pkg_parts = facts.module.split(".")
+    for node in ctx.walk(ast.ImportFrom):
+        # resolve the relative base against this file's dotted module name
+        if node.level:
+            keep = len(pkg_parts) if facts.is_package else len(pkg_parts) - 1
+            keep -= node.level - 1
+            if keep < 0:
+                continue  # beyond our root: unresolvable, leave unaliased
+            base = ".".join(pkg_parts[:keep])
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+        else:
+            base = node.module or ""
+        for alias in node.names:
+            if alias.name == "*":
+                star.append(base)
+                continue
+            bound = alias.asname or alias.name
+            facts.imports.setdefault(bound, f"{base}.{alias.name}" if base else alias.name)
+    facts.star_imports = tuple(star)
+
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            tgt = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                jt = _jit_target_and_donated(node.value)
+                if jt is not None:
+                    facts.module_aliases[tgt] = jt
+            elif isinstance(node.value, ast.Name):
+                facts.module_aliases[tgt] = (node.value.id, ())
+    return facts
+
+
+# -------------------------------------------------------------------- #
+# resolution
+# -------------------------------------------------------------------- #
+
+# reasons whose unknown callee could stage ANYTHING: conclusions that
+# depend on such a call site are downgraded to info (the honesty policy)
+POISONING_REASONS = frozenset(
+    {
+        "getattr",
+        "dynamic-expression",
+        "lambda",
+        "param-callable",
+        "local-callable",
+        "unknown-name",
+        "missing-attr",
+        "missing-module",
+        "ambiguous-module",
+    }
+)
+
+
+@dataclass
+class Resolution:
+    kind: str  # "resolved" | "external" | "unresolved"
+    target: Optional[FuncKey] = None
+    reason: str = ""
+    # donated positions carried by a jit alias on the resolution path
+    donates_override: Optional[Tuple[int, ...]] = None
+
+    @property
+    def benign(self) -> bool:
+        return self.kind != "unresolved" or self.reason not in POISONING_REASONS
+
+
+class CallGraph:
+    """Resolves :class:`CallDesc` against the linted tree's structure facts.
+
+    ``unresolved`` is the honesty bucket: every unresolvable call site with
+    its reason, for the JSON report and the downgrade policy — nothing is
+    silently dropped.
+    """
+
+    def __init__(self, facts: Dict[str, FileFacts]):
+        self.facts = facts
+        self.modules: Dict[str, str] = {}  # dotted module -> path
+        for path, ff in facts.items():
+            self.modules[ff.module] = path
+        self.top_segments = {m.split(".")[0] for m in self.modules}
+        self.functions: Dict[FuncKey, FuncFacts] = {}
+        for path, ff in facts.items():
+            for qn, fn in ff.functions.items():
+                self.functions[(path, qn)] = fn
+        self.unresolved: List[dict] = []
+
+    # ----------------- module / member lookups ----------------- #
+
+    def resolve_module(self, target: str) -> Optional[str]:
+        """Path of the program module named ``target`` (suffix-matched)."""
+        p = self.modules.get(target)
+        if p is not None:
+            return p
+        suffix = "." + target
+        hits = [path for mod, path in self.modules.items() if mod.endswith(suffix)]
+        if len(hits) == 1:
+            return hits[0]
+        return None  # absent or ambiguous
+
+    def _member(self, path: str, name: str, depth: int = 0):
+        """Resolve ``name`` inside module at ``path``: a def, a class, a
+        re-export, or a jit alias.  Returns ("func", key, donated) /
+        ("class", path, ClassFacts) / None."""
+        if depth > _CHASE_DEPTH:
+            return None
+        ff = self.facts[path]
+        if name in ff.functions:
+            return ("func", (path, name), None)
+        if name in ff.classes:
+            return ("class", path, ff.classes[name])
+        if name in ff.module_aliases:
+            target, donated = ff.module_aliases[name]
+            inner = self._member(path, target, depth + 1)
+            if inner is not None and inner[0] == "func":
+                return ("func", inner[1], donated or inner[2])
+            return inner
+        if name in ff.imports:
+            return self._dotted_member(ff.imports[name], depth + 1)
+        for starmod in ff.star_imports:
+            sp = self.resolve_module(starmod)
+            if sp is not None:
+                hit = self._member(sp, name, depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _dotted_member(self, dotted: str, depth: int = 0):
+        if depth > _CHASE_DEPTH:
+            return None
+        parts = dotted.split(".")
+        mp = self.resolve_module(dotted)
+        if mp is not None:
+            return ("module", mp, None)
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            path = self.resolve_module(prefix)
+            if path is None:
+                continue
+            cur = self._member(path, parts[i], depth + 1)
+            for extra in parts[i + 1 :]:
+                if cur is None:
+                    return None
+                if cur[0] == "class":
+                    qn = cur[2].methods.get(extra)
+                    cur = ("func", (cur[1], qn), None) if qn else None
+                elif cur[0] == "module":
+                    cur = self._member(cur[1], extra, depth + 1)
+                else:
+                    return None
+            return cur
+        return None
+
+    def _class_method(self, path: str, cf: ClassFacts, name: str, depth: int = 0):
+        """Method lookup through the program-resolvable part of the MRO."""
+        if depth > _CHASE_DEPTH:
+            return None, False
+        qn = cf.methods.get(name)
+        if qn is not None:
+            return (path, qn), True
+        all_bases_resolved = True
+        for base in cf.bases:
+            hit = self._resolve_class(path, base)
+            if hit is None:
+                all_bases_resolved = False
+                continue
+            bpath, bcf = hit
+            key, complete = self._class_method(bpath, bcf, name, depth + 1)
+            if key is not None:
+                return key, True
+            all_bases_resolved = all_bases_resolved and complete
+        return None, all_bases_resolved
+
+    def _resolve_class(self, from_path: str, dotted: str):
+        ff = self.facts[from_path]
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            if parts[0] in ff.classes:
+                return from_path, ff.classes[parts[0]]
+            hit = self._member(from_path, parts[0])
+        else:
+            root = ff.imports.get(parts[0])
+            hit = self._dotted_member(
+                (root + "." + ".".join(parts[1:])) if root else dotted
+            )
+        if hit is not None and hit[0] == "class":
+            return hit[1], hit[2]
+        return None
+
+    # ----------------- the resolver ----------------- #
+
+    def resolve(self, caller: FuncKey, desc: CallDesc) -> Resolution:
+        res = self._resolve(caller, desc)
+        if res.kind == "unresolved":
+            self.unresolved.append(
+                {
+                    "caller_path": caller[0],
+                    "caller": caller[1],
+                    "line": desc.line,
+                    "call": desc.dotted or desc.attr or "<dynamic>",
+                    "reason": res.reason,
+                    "benign": res.benign,
+                }
+            )
+        return res
+
+    def _resolve(self, caller: FuncKey, desc: CallDesc) -> Resolution:
+        if desc.dynamic is not None:
+            return Resolution("unresolved", reason=desc.dynamic)
+        path, caller_qn = caller
+        ff = self.facts[path]
+        fn = ff.functions.get(caller_qn)
+        dn = desc.dotted
+        if dn is None:
+            # attribute chain rooted at a non-Name: receiver unknowable
+            return Resolution("unresolved", reason="receiver-unknown")
+        parts = dn.split(".")
+
+        if parts[0] == "self":
+            if fn is None or fn.class_name is None:
+                return Resolution("unresolved", reason="self-outside-class")
+            if len(parts) != 2:
+                return Resolution("unresolved", reason="receiver-unknown")
+            cf = ff.classes.get(fn.class_name)
+            if cf is None:
+                return Resolution("unresolved", reason="missing-method")
+            key, complete = self._class_method(path, cf, parts[1])
+            if key is not None:
+                return Resolution("resolved", target=key)
+            # not found: inherited from an external base is benign; a class
+            # with a fully-visible MRO missing the method is suspicious but
+            # still treated as inherited (properties, __getattr__)
+            return Resolution("unresolved", reason="inherited-or-missing")
+
+        if len(parts) == 1:
+            name = parts[0]
+            # nested defs, innermost scope first
+            scope = caller_qn.split(".")
+            for i in range(len(scope), 0, -1):
+                cand = ".".join(scope[:i] + [name])
+                if cand in ff.functions:
+                    return Resolution("resolved", target=(path, cand))
+            if fn is not None:
+                if name in fn.local_aliases:
+                    target, donated = fn.local_aliases[name]
+                    inner = self._resolve(
+                        caller, CallDesc(dotted=target, attr=target, line=desc.line)
+                    )
+                    if inner.kind == "resolved" and donated:
+                        inner.donates_override = donated
+                    return inner
+                if name in fn.local_lambdas:
+                    return Resolution("unresolved", reason="lambda")
+                if name in fn.params:
+                    return Resolution("unresolved", reason="param-callable")
+                if name in fn.local_assigned:
+                    return Resolution("unresolved", reason="local-callable")
+            hit = self._member(path, name)
+            if hit is not None:
+                if hit[0] == "func":
+                    return Resolution(
+                        "resolved", target=hit[1], donates_override=hit[2]
+                    )
+                if hit[0] == "class":
+                    qn = hit[2].methods.get("__init__")
+                    if qn is not None:
+                        return Resolution("resolved", target=(hit[1], qn))
+                    return Resolution("external", reason="constructor")
+                return Resolution("unresolved", reason="module-not-callable")
+            if name in _BUILTIN_NAMES:
+                return Resolution("external", reason="builtin")
+            return Resolution("unresolved", reason="unknown-name")
+
+        # dotted: expand the root through the alias tables
+        root = parts[0]
+        target_root = None
+        if fn is not None and root in fn.local_aliases:
+            target_root = fn.local_aliases[root][0]
+        if target_root is None:
+            target_root = ff.imports.get(root)
+        if target_root is None and root in ff.classes:
+            # ClassName.method(...)
+            key, _ = self._class_method(path, ff.classes[root], parts[1])
+            if key is not None and len(parts) == 2:
+                return Resolution("resolved", target=key)
+            return Resolution("unresolved", reason="missing-method")
+        if target_root is None:
+            if fn is not None and (
+                root in fn.params or root in fn.local_assigned
+            ):
+                # x.method(): receiver is a value — assumed effect-free
+                # (collectives are matched lexically by name elsewhere)
+                return Resolution("unresolved", reason="receiver-unknown")
+            if root in _BUILTIN_NAMES:
+                return Resolution("external", reason="builtin")
+            return Resolution("unresolved", reason="receiver-unknown")
+        full = target_root + "." + ".".join(parts[1:])
+        hit = self._dotted_member(full)
+        if hit is not None:
+            if hit[0] == "func":
+                return Resolution("resolved", target=hit[1], donates_override=hit[2])
+            if hit[0] == "class":
+                qn = hit[2].methods.get("__init__")
+                if qn is not None:
+                    return Resolution("resolved", target=(hit[1], qn))
+                return Resolution("external", reason="constructor")
+            return Resolution("unresolved", reason="module-not-callable")
+        if full.split(".")[0] in self.top_segments:
+            return Resolution("unresolved", reason="missing-attr")
+        return Resolution("external", reason="external-module")

@@ -23,7 +23,16 @@ Vocabulary:
   suppressed; ``--write-baseline`` regenerates the file.
 
 Rules register themselves with :func:`register`; :mod:`.rules` holds the
-built-in set (HT101–HT106).
+built-in set: the lexical rules HT101–HT108 and the interprocedural HT2xx
+family (which runs over a package-wide :class:`~.summaries.Program` built
+from :mod:`.callgraph` + :mod:`.summaries`).
+
+Findings carry a ``severity``: ``"error"`` gates CI (and is what the
+baseline matches); ``"info"`` is the honesty downgrade for interprocedural
+conclusions that depend on an unresolved call — reported, never gating.
+Interprocedural findings also carry a ``trace`` (``entry → helper → sink``,
+one ``{path, qualname, line}`` hop each) rendered in text, JSON, and SARIF
+``codeFlows``.
 """
 
 from __future__ import annotations
@@ -34,7 +43,7 @@ import json
 import os
 import re
 import tokenize
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
 
 __all__ = [
@@ -50,6 +59,8 @@ __all__ = [
     "write_baseline",
     "render_text",
     "render_json",
+    "render_sarif",
+    "disabled_rules_for",
 ]
 
 # -------------------------------------------------------------------- #
@@ -68,6 +79,10 @@ class Finding:
     message: str
     qualname: str = "<module>"  # enclosing def/class chain
     detail: str = ""  # short stable token (offending name), keys the fingerprint
+    severity: str = "error"  # "error" gates; "info" = unresolved-call downgrade
+    # interprocedural call chain, entry -> ... -> sink; each hop
+    # {"path": ..., "qualname": ..., "line": ...}
+    trace: List[dict] = field(default_factory=list)
 
     @property
     def fingerprint(self) -> str:
@@ -77,7 +92,7 @@ class Finding:
         return f"{self.path}:{self.rule}:{self.qualname}:{self.detail}"
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
@@ -85,8 +100,15 @@ class Finding:
             "message": self.message,
             "qualname": self.qualname,
             "detail": self.detail,
+            "severity": self.severity,
             "fingerprint": self.fingerprint,
         }
+        if self.trace:
+            d["trace"] = list(self.trace)
+        return d
+
+    def trace_text(self) -> str:
+        return " -> ".join(f"{h['path']}:{h['qualname']}" for h in self.trace)
 
 
 # -------------------------------------------------------------------- #
@@ -103,7 +125,9 @@ _SUPPRESS_FILE_RE = re.compile(rf"#\s*heatlint:\s*disable-file=({_CODES})")
 
 class LintContext:
     """Parsed module + the shared lookups rules need: source lines, parent
-    links, enclosing-scope qualnames, and inline suppressions."""
+    links, enclosing-scope qualnames, inline suppressions, and a pre-order
+    node index so every rule (and the interprocedural passes) share ONE
+    parse + ONE walk per file instead of re-walking the tree per rule."""
 
     def __init__(self, path: str, source: str, tree: Optional[ast.AST] = None):
         self.path = path.replace(os.sep, "/")
@@ -112,6 +136,8 @@ class LintContext:
         self.tree = tree if tree is not None else ast.parse(source, filename=path)
         self.parents: Dict[ast.AST, ast.AST] = {}
         self._qualnames: Dict[ast.AST, str] = {}
+        self._order: List[ast.AST] = []  # pre-order (document order)
+        self._by_type: Dict[type, List[ast.AST]] = {}
         self._index(self.tree, None, ())
         self._line_suppressions: Dict[int, set] = {}
         self._file_suppressions: set = set()
@@ -120,6 +146,8 @@ class LintContext:
     def _index(self, node: ast.AST, parent: Optional[ast.AST], scope: Tuple[str, ...]):
         if parent is not None:
             self.parents[node] = parent
+        self._order.append(node)
+        self._by_type.setdefault(type(node), []).append(node)
         self._qualnames[node] = ".".join(scope) if scope else "<module>"
         child_scope = scope
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
@@ -127,6 +155,20 @@ class LintContext:
             self._qualnames[node] = ".".join(child_scope)
         for child in ast.iter_child_nodes(node):
             self._index(child, node, child_scope)
+
+    def walk(self, *types: type) -> List[ast.AST]:
+        """All nodes (document order), optionally filtered by exact node
+        types — the shared single-walk index every rule uses instead of
+        ``ast.walk(ctx.tree)``."""
+        if not types:
+            return self._order
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        seen_types = [t for t in types if t in self._by_type]
+        if len(seen_types) == 1:
+            return self._by_type[seen_types[0]]
+        wanted = tuple(types)
+        return [n for n in self._order if isinstance(n, wanted)]
 
     def _scan_suppressions(self) -> None:
         # tokenize so only REAL comments suppress: a docstring that merely
@@ -209,13 +251,20 @@ class LintContext:
 
 class Rule:
     """One invariant.  Subclass, set ``code``/``name``/``description``,
-    implement :meth:`check`, and decorate with :func:`register`."""
+    implement :meth:`check` (per-file rules) or set ``program_level = True``
+    and implement :meth:`check_program` (interprocedural rules, which
+    receive the package-wide :class:`~.summaries.Program`), and decorate
+    with :func:`register`."""
 
     code: str = "HT000"
     name: str = "unnamed"
     description: str = ""
+    program_level: bool = False
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def check_program(self, program) -> Iterable[Finding]:  # pragma: no cover
         raise NotImplementedError
 
 
@@ -244,28 +293,66 @@ def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
 
 
 # -------------------------------------------------------------------- #
+# per-directory rule configuration
+# -------------------------------------------------------------------- #
+
+# Lint scope is wider than library code, but not every contract applies
+# everywhere: benchmarks and tutorials are host-driving entry points, so
+# host syncs (HT101 + its interprocedural twin HT202), raw local entropy
+# (HT105), and unbounded timing waits (HT107/HT204 — block_until_ready IS
+# the measurement) are legitimate there.  Rank-conditional collectives
+# (HT102/HT201), donation misuse (HT103/HT203), and the accounting/stamp
+# bypasses stay ON — a desync hazard deadlocks a benchmark world exactly
+# like a library one.  First matching prefix wins; the table lives here
+# (not in CLI flags) so every invocation — CLI, tests, CI — agrees.
+DIR_RULE_CONFIG: Tuple[Tuple[str, frozenset], ...] = (
+    ("benchmarks/", frozenset({"HT101", "HT105", "HT107", "HT202", "HT204"})),
+    ("tutorials/", frozenset({"HT101", "HT105", "HT107", "HT202", "HT204"})),
+)
+
+
+def disabled_rules_for(path: str) -> frozenset:
+    """Rule codes disabled for ``path`` by the per-directory config table."""
+    p = path.replace(os.sep, "/")
+    for prefix, disabled in DIR_RULE_CONFIG:
+        if p.startswith(prefix) or f"/{prefix}" in p:
+            return disabled
+    return frozenset()
+
+
+# -------------------------------------------------------------------- #
 # driver
 # -------------------------------------------------------------------- #
 
 
-def lint_file(path: str, rules: Sequence[Rule]) -> List[Finding]:
+def _parse_context(path: str):
+    """LintContext for ``path``, or an HT000 Finding on a syntax error —
+    the ONE place read/parse/error handling lives (lint_file and lint_paths
+    both route through it, so the two drivers cannot drift)."""
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
     try:
-        ctx = LintContext(path, source)
+        return LintContext(path, source)
     except SyntaxError as exc:
-        return [
-            Finding(
-                rule="HT000",
-                path=path.replace(os.sep, "/"),
-                line=exc.lineno or 1,
-                col=exc.offset or 0,
-                message=f"syntax error: {exc.msg}",
-                detail="syntax-error",
-            )
-        ]
+        return Finding(
+            rule="HT000",
+            path=path.replace(os.sep, "/"),
+            line=exc.lineno or 1,
+            col=exc.offset or 0,
+            message=f"syntax error: {exc.msg}",
+            detail="syntax-error",
+        )
+
+
+def lint_file(path: str, rules: Sequence[Rule]) -> List[Finding]:
+    ctx = _parse_context(path)
+    if isinstance(ctx, Finding):
+        return [ctx]
     findings: List[Finding] = []
+    disabled = disabled_rules_for(ctx.path)
     for rule in rules:
+        if rule.program_level or rule.code in disabled:
+            continue
         findings.extend(f for f in rule.check(ctx) if f is not None)
     return findings
 
@@ -299,12 +386,44 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def lint_paths(
-    paths: Sequence[str], select: Optional[Sequence[str]] = None
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    cache_path: Optional[str] = None,
+    unresolved_out: Optional[List[dict]] = None,
 ) -> List[Finding]:
+    """Lint ``paths`` with every selected rule — ONE parse + ONE walk index
+    per file shared by all lexical rules AND the interprocedural passes,
+    which additionally share the summary cache at ``cache_path`` (keyed by
+    file content hash; None disables caching).  When ``unresolved_out`` is
+    given, the call graph's unresolved bucket (every unresolvable call with
+    its reason — the honesty policy's audit trail) is appended to it."""
     rules = all_rules(select)
+    file_rules = [r for r in rules if not r.program_level]
+    program_rules = [r for r in rules if r.program_level]
     findings: List[Finding] = []
+    contexts: Dict[str, LintContext] = {}
     for path in iter_python_files(paths):
-        findings.extend(lint_file(path, rules))
+        ctx = _parse_context(path)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+            continue
+        contexts[ctx.path] = ctx
+        disabled = disabled_rules_for(ctx.path)
+        for rule in file_rules:
+            if rule.code in disabled:
+                continue
+            findings.extend(f for f in rule.check(ctx) if f is not None)
+    if program_rules and contexts:
+        from . import summaries as _summaries  # lazy: only when HT2xx selected
+
+        program = _summaries.build_program(contexts, cache_path=cache_path)
+        for rule in program_rules:
+            for f in rule.check_program(program):
+                if f is None or rule.code in disabled_rules_for(f.path):
+                    continue
+                findings.append(f)
+        if unresolved_out is not None:
+            unresolved_out.extend(program.graph.unresolved)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -381,31 +500,157 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
 # -------------------------------------------------------------------- #
 
 
+def _fmt_finding(f: Finding, suffix: str = "") -> List[str]:
+    lines = [f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message} [in {f.qualname}]{suffix}"]
+    if f.trace:
+        lines.append(f"    via {f.trace_text()}")
+    return lines
+
+
 def render_text(
-    new: Sequence[Finding], grandfathered: Sequence[Finding], verbose_baselined: bool = False
+    new: Sequence[Finding],
+    grandfathered: Sequence[Finding],
+    verbose_baselined: bool = False,
+    info: Sequence[Finding] = (),
+    show_info: bool = False,
 ) -> str:
-    lines = []
+    lines: List[str] = []
     for f in new:
-        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message} [in {f.qualname}]")
+        lines.extend(_fmt_finding(f))
     if verbose_baselined:
         for f in grandfathered:
-            lines.append(
-                f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message} [in {f.qualname}] (baselined)"
-            )
-    lines.append(
+            lines.extend(_fmt_finding(f, " (baselined)"))
+    if show_info:
+        for f in info:
+            lines.extend(_fmt_finding(f, " (info — unresolved-call downgrade)"))
+    summary = (
         f"heatlint: {len(new) + len(grandfathered)} finding(s) "
         f"({len(new)} new, {len(grandfathered)} baselined)"
     )
+    if info:
+        summary += f", {len(info)} info (non-gating{'' if show_info else '; --show-info to list'})"
+    lines.append(summary)
     return "\n".join(lines)
 
 
-def render_json(new: Sequence[Finding], grandfathered: Sequence[Finding]) -> str:
-    return json.dumps(
-        {
-            "version": 1,
-            "new": [f.to_dict() for f in new],
-            "baselined": [f.to_dict() for f in grandfathered],
-            "counts": {"new": len(new), "baselined": len(grandfathered)},
+def render_json(
+    new: Sequence[Finding],
+    grandfathered: Sequence[Finding],
+    info: Sequence[Finding] = (),
+    unresolved: Optional[Sequence[dict]] = None,
+) -> str:
+    payload = {
+        "version": 2,
+        "new": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in grandfathered],
+        "info": [f.to_dict() for f in info],
+        "counts": {
+            "new": len(new),
+            "baselined": len(grandfathered),
+            "info": len(info),
         },
-        indent=2,
+    }
+    if unresolved is not None:
+        payload["unresolved_calls"] = list(unresolved)
+    return json.dumps(payload, indent=2)
+
+
+# -------------------------------------------------------------------- #
+# SARIF 2.1.0 (github/codeql-action/upload-sarif -> PR annotations)
+# -------------------------------------------------------------------- #
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _sarif_location(path: str, line: int, col: int, message: Optional[str] = None) -> dict:
+    loc = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path, "uriBaseId": "%SRCROOT%"},
+            "region": {"startLine": max(1, line), "startColumn": max(1, col + 1)},
+        }
+    }
+    if message:
+        loc["message"] = {"text": message}
+    return loc
+
+
+def _sarif_result(f: Finding, level: str, baselined: bool = False) -> dict:
+    result = {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f"{f.message} [in {f.qualname}]"},
+        "locations": [_sarif_location(f.path, f.line, f.col)],
+        "partialFingerprints": {"heatlintFingerprint/v1": f.fingerprint},
+    }
+    if f.trace:
+        # the interprocedural call chain maps onto one SARIF threadFlow:
+        # entry -> helper -> sink, one location per hop
+        result["codeFlows"] = [
+            {
+                "threadFlows": [
+                    {
+                        "locations": [
+                            {
+                                "location": _sarif_location(
+                                    h["path"],
+                                    h.get("line", 1),
+                                    0,
+                                    f"{h['path']}:{h['qualname']}",
+                                )
+                            }
+                            for h in f.trace
+                        ]
+                    }
+                ]
+            }
+        ]
+    if baselined:
+        result["suppressions"] = [
+            {"kind": "external", "justification": "heatlint baseline (grandfathered)"}
+        ]
+    return result
+
+
+def render_sarif(
+    new: Sequence[Finding],
+    grandfathered: Sequence[Finding],
+    info: Sequence[Finding] = (),
+    rules: Optional[Sequence[Rule]] = None,
+) -> str:
+    """SARIF 2.1.0 log: new findings at ``error``, info findings at
+    ``note``, baselined findings at ``note`` with an external suppression
+    (so code-scanning shows them resolved instead of re-announcing them)."""
+    rule_meta = [
+        {
+            "id": r.code,
+            "name": r.name,
+            "shortDescription": {"text": r.description or r.name},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for r in (rules if rules is not None else all_rules())
+    ]
+    results = (
+        [_sarif_result(f, "error") for f in new]
+        + [_sarif_result(f, "note") for f in info]
+        + [_sarif_result(f, "note", baselined=True) for f in grandfathered]
     )
+    log = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "heatlint",
+                        "informationUri": "doc/source/design.md",
+                        "rules": rule_meta,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(log, indent=2)

@@ -1,4 +1,4 @@
-"""Built-in heatlint rules HT101–HT106: the runtime's distributed invariants.
+"""Built-in heatlint rules: the runtime's distributed invariants.
 
 Each rule encodes one contract established by earlier rounds of perf,
 robustness, and telemetry work (see doc/source/design.md "Static
@@ -13,10 +13,31 @@ contracts" for the full table):
 - HT107 — no naked blocking collective waits bypassing comm.deadline
 - HT108 — no collective staging bypassing the seq-stamp choke point
 
-All analyses are intentionally *lexical and intra-procedural*: false
+The HT1xx analyses are intentionally *lexical and intra-procedural*: false
 negatives across call boundaries are accepted; false positives are kept
 low enough that the committed baseline stays short and new code rarely
 needs a suppression.
+
+The HT2xx family closes exactly those call-boundary false negatives with
+the interprocedural engine (:mod:`.callgraph` + :mod:`.summaries`) — each
+rule is the static twin of a runtime failure mode the earlier PRs made
+observable:
+
+- HT201 — static desync: the collective footprint differs across the arms
+  of a rank-dependent branch anywhere in the transitive call chain (the
+  lint-time counterpart of postmortem's ``desync`` verdict)
+- HT202 — transitive host sync: a public API function whose call chain
+  reaches a host sync lexical HT101 cannot see at the entry
+- HT203 — interprocedural use-after-donate: a name is read after a call
+  that donates it inside the callee (HT103 is intra-function only)
+- HT204 — transitively undeadlined blocking: a blocking wait reachable
+  from a public entry with no ``comm.deadline`` scope on any path (the
+  lint-time counterpart of ``health.deadline.trips``)
+
+HT2xx findings carry the full call-chain trace (``entry → helper →
+sink``); conclusions that depend on an *unresolved* call (getattr
+dispatch, lambdas, callables passed as values) are downgraded to ``info``
+severity — reported, never gating, never a false positive.
 """
 
 from __future__ import annotations
@@ -24,71 +45,29 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Optional, Set, Tuple
 
+from .callgraph import call_name, dotted_name, last_attr  # noqa: F401  — dotted_name re-exported (pre-interprocedural public helper)
 from .framework import Finding, LintContext, Rule, register
+from .summaries import (
+    BLOCKING_ATTRS,
+    COLLECTIVES,
+    HOST_SANCTIONED_DEFS,
+    HOST_SANCTIONED_MODULES,
+    MATERIALIZERS,
+    RANK_ATTRS,
+    RANK_CALLS,
+    RANK_NAMES,
+    WAIT_SANCTIONED_MODULES,
+    Program,
+    _has_ambiguity,
+    _iter_atoms,
+    _strip,
+    module_matches,
+    rank_marker,
+    subtree_mentions_device_value,
+)
 
-# -------------------------------------------------------------------- #
-# shared AST helpers
-# -------------------------------------------------------------------- #
-
-
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """'np.random.seed' for Attribute/Name chains, None for anything else."""
-    parts: List[str] = []
-    cur = node
-    while isinstance(cur, ast.Attribute):
-        parts.append(cur.attr)
-        cur = cur.value
-    if isinstance(cur, ast.Name):
-        parts.append(cur.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def call_name(call: ast.Call) -> Optional[str]:
-    return dotted_name(call.func)
-
-
-def last_attr(call: ast.Call) -> Optional[str]:
-    """Final attribute of a call target: 'item' for ``x.y.item()``."""
-    if isinstance(call.func, ast.Attribute):
-        return call.func.attr
-    if isinstance(call.func, ast.Name):
-        return call.func.id
-    return None
-
-
-# calls that END a device-value expression: their result is host data, so a
-# float()/int()/np.asarray around them is not an additional sync
-_MATERIALIZERS = {"host_fetch", "numpy", "tolist", "item"}
-
-
-def subtree_mentions_device_value(node: ast.AST) -> bool:
-    """Heuristic for 'this expression is a device value': it touches the raw
-    jax array plumbing (``._jarray``/``._parray``/``.larray``) or directly
-    calls into jnp/lax/jax.numpy — UNLESS the expression already routes
-    through a sanctioned materialization call (``host_fetch``/``numpy()``),
-    in which case the value is host-side by the time it is consumed."""
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call) and last_attr(sub) in _MATERIALIZERS:
-            return False
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Attribute) and sub.attr in (
-            "_jarray",
-            "_parray",
-            "larray",
-        ):
-            return True
-        if isinstance(sub, ast.Call):
-            dn = call_name(sub)
-            if dn and (
-                dn.startswith("jnp.") or dn.startswith("lax.") or dn.startswith("jax.numpy.")
-            ):
-                return True
-    return False
-
-
-def module_matches(path: str, suffixes: Tuple[str, ...]) -> bool:
-    return any(path.endswith(s) for s in suffixes)
+# compatibility alias (pre-interprocedural name)
+_MATERIALIZERS = MATERIALIZERS
 
 
 def branch_exclusive(ctx: LintContext, a: ast.AST, b: ast.AST) -> bool:
@@ -144,28 +123,11 @@ class HostSyncRule(Rule):
     name = "host-sync-in-library"
     description = "blocking device→host read outside sanctioned materialization points"
 
-    # modules whose JOB is materialization (printing, I/O)
-    SANCTIONED_MODULES = (
-        "core/printing.py",
-        "core/io.py",
-    )
+    # modules whose JOB is materialization (printing, I/O) — shared with the
+    # interprocedural summaries, which treat them as propagation barriers
+    SANCTIONED_MODULES = HOST_SANCTIONED_MODULES
     # the materialization API itself + host-boundary helpers
-    SANCTIONED_DEFS = {
-        "numpy",
-        "item",
-        "tolist",
-        "host_fetch",
-        "host_fetch_all",
-        "__array__",
-        "__bool__",
-        "__int__",
-        "__float__",
-        "__complex__",
-        "__index__",
-        "__torch_proxy__",
-        "__repr__",
-        "__str__",
-    }
+    SANCTIONED_DEFS = HOST_SANCTIONED_DEFS
 
     def _sanctioned(self, ctx: LintContext, node: ast.AST) -> bool:
         fn = ctx.enclosing_function(node)
@@ -179,9 +141,7 @@ class HostSyncRule(Rule):
         if module_matches(ctx.path, self.SANCTIONED_MODULES):
             return []
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.walk(ast.Call):
             if self._sanctioned(ctx, node):
                 continue
             la = last_attr(node)
@@ -245,35 +205,16 @@ class RankConditionalCollectiveRule(Rule):
     name = "rank-conditional-collective"
     description = "collective call inside a rank-conditional branch (SPMD divergence)"
 
-    COLLECTIVES: Set[str] = {
-        # Communication public API (MPI names)
-        "Allreduce", "Allgather", "Alltoall", "Bcast", "Send", "Reduce",
-        "Scatter", "Gather", "ReduceScatter", "Scan", "Exscan",
-        "Iallreduce", "Iallgather", "Ialltoall", "Ibcast", "Isend", "Irecv",
-        "Barrier", "resplit", "resplit_", "redistribute_",
-        # collective-by-contract host boundary (every process must call)
-        "host_fetch", "numpy", "process_allgather", "sync_global_devices",
-        # raw lax collectives
-        "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
-        "ppermute", "psum_scatter", "pbroadcast",
-    }
-    # rank-identity markers, by syntactic shape (each tuple drives
-    # _rank_conditional — extend HERE to widen detection)
-    RANK_ATTRS = ("rank",)  # comm.rank, self.rank, ...
-    RANK_CALLS = ("process_index", "axis_index")  # jax.process_index(), ...
-    RANK_NAMES = ("rank", "process_id", "pid")  # bare local variables
+    # the collective vocabulary and rank-identity markers are shared with
+    # the interprocedural summaries (summaries.py) so HT102 and HT201 can
+    # never disagree about what counts as a collective or a rank test
+    COLLECTIVES: Set[str] = set(COLLECTIVES)
+    RANK_ATTRS = RANK_ATTRS
+    RANK_CALLS = RANK_CALLS
+    RANK_NAMES = RANK_NAMES
 
     def _rank_conditional(self, test: ast.AST) -> Optional[str]:
-        for sub in ast.walk(test):
-            if isinstance(sub, ast.Attribute) and sub.attr in self.RANK_ATTRS:
-                return dotted_name(sub) or sub.attr
-            if isinstance(sub, ast.Call):
-                la = last_attr(sub)
-                if la in self.RANK_CALLS:
-                    return la
-            if isinstance(sub, ast.Name) and sub.id in self.RANK_NAMES:
-                return sub.id
-        return None
+        return rank_marker(test)
 
     def _arm_collectives(self, arm) -> dict:
         """collective name → [call nodes] for one branch arm."""
@@ -288,9 +229,7 @@ class RankConditionalCollectiveRule(Rule):
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, (ast.If, ast.While)):
-                continue
+        for node in ctx.walk(ast.If, ast.While):
             marker = self._rank_conditional(node.test)
             if marker is None:
                 continue
@@ -335,9 +274,8 @@ class UseAfterDonateRule(Rule):
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                out.extend(self._check_function(ctx, node))
+        for node in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            out.extend(self._check_function(ctx, node))
         return out
 
     def _jit_donated_positions(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
@@ -484,9 +422,7 @@ class CollectiveAccountingRule(Rule):
         if not module_matches(ctx.path, self.TARGET_SUFFIX):
             return []
         out = []
-        for cls in ast.walk(ctx.tree):
-            if not isinstance(cls, ast.ClassDef):
-                continue
+        for cls in ctx.walk(ast.ClassDef):
             for fn in cls.body:
                 if not isinstance(fn, ast.FunctionDef):
                     continue
@@ -558,17 +494,14 @@ class RawEntropyRule(Rule):
         if module_matches(ctx.path, self.SANCTIONED_MODULES):
             return []
         imports_stdlib_random = False
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Import):
-                if any(a.name == "random" for a in node.names):
-                    imports_stdlib_random = True
-            elif isinstance(node, ast.ImportFrom):
-                if node.module == "random":
-                    imports_stdlib_random = True
+        for node in ctx.walk(ast.Import):
+            if any(a.name == "random" for a in node.names):
+                imports_stdlib_random = True
+        for node in ctx.walk(ast.ImportFrom):
+            if node.module == "random":
+                imports_stdlib_random = True
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.walk(ast.Call):
             dn = call_name(node)
             if dn is None:
                 continue
@@ -632,7 +565,7 @@ class MetadataMutationRule(Rule):
         if module_matches(ctx.path, self.SANCTIONED_MODULES):
             return []
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk(ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete):
             targets: List[ast.expr] = []
             if isinstance(node, ast.Assign):
                 targets = list(node.targets)
@@ -687,12 +620,10 @@ class NakedBlockingWaitRule(Rule):
     description = "blocking collective wait outside a comm.deadline scope"
 
     # the wrapper itself and the guard implementation are the two places a
-    # raw blocking wait is the point
-    SANCTIONED_MODULES = (
-        "core/communication.py",
-        "utils/health.py",
-    )
-    BLOCKING_ATTRS = {"Barrier", "Wait", "block_until_ready", "sync_global_devices"}
+    # raw blocking wait is the point (shared with summaries.py, which uses
+    # the same lists as propagation barriers for HT204)
+    SANCTIONED_MODULES = WAIT_SANCTIONED_MODULES
+    BLOCKING_ATTRS = BLOCKING_ATTRS
 
     def _under_deadline(self, ctx: LintContext, node: ast.AST) -> bool:
         """True when an ancestor ``with`` arms a deadline (``comm.deadline``
@@ -710,9 +641,7 @@ class NakedBlockingWaitRule(Rule):
         if module_matches(ctx.path, self.SANCTIONED_MODULES):
             return []
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.walk(ast.Call):
             la = last_attr(node)
             if la not in self.BLOCKING_ATTRS:
                 continue
@@ -795,9 +724,7 @@ class SeqStampBypassRule(Rule):
         if module_matches(ctx.path, self.SANCTIONED_MODULES):
             return []
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.walk(ast.Call):
             la = last_attr(node)
             if la == "execute_plan":
                 f = ctx.finding(
@@ -823,4 +750,382 @@ class SeqStampBypassRule(Rule):
                     )
                     if f is not None:
                         out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT2xx — the interprocedural family (callgraph + summaries engine)
+# -------------------------------------------------------------------- #
+
+
+def _trace_dicts(chain) -> List[dict]:
+    return [{"path": p, "qualname": q, "line": ln} for p, q, ln in chain]
+
+
+@register
+class StaticDesyncRule(Rule):
+    """Static desync: the collective footprint differs across the arms of a
+    rank-dependent branch *anywhere in the transitive call chain* — the
+    lint-time counterpart of postmortem's ``desync`` verdict (and of the
+    chaos-CI ``MPDRYRUN_DESYNC_RANK`` worker, whose rank-conditional extra
+    collective is exactly this shape one helper deep).
+
+    Lexical differences (a collective called directly in one arm) are
+    HT102's finding and are NOT re-reported here; HT201 fires only when
+    the divergence is call-borne (the witness collective sits >= 1 hop
+    down), which is precisely what HT102 provably misses.  Arms whose
+    comparison crosses a poisoning unresolved call (getattr dispatch,
+    callables passed as values) yield an ``info`` finding — "cannot prove
+    SPMD-uniform" — never a gating false positive."""
+
+    code = "HT201"
+    name = "static-desync"
+    description = "rank-conditional branch whose arms stage different collective footprints"
+    program_level = True
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for key in sorted(program.effects):
+            eff = program.effects[key]
+            path, qual = key
+            for atom in eff["rank_branches"]:
+                _tag, marker, line, arm_a, arm_b, kind = atom
+                if program.is_suppressed(self.code, path, line):
+                    continue
+                na = program.norm_arm(key, arm_a)
+                nb = program.norm_arm(key, arm_b)
+                sa, sb = _strip(na), _strip(nb)
+                if sa == sb:
+                    continue
+                i = 0
+                while i < min(len(sa), len(sb)) and sa[i] == sb[i]:
+                    i += 1
+                candidates = [n for n in (na[i:i + 1] + nb[i:i + 1])]
+                ambiguous = _has_ambiguity(na) or _has_ambiguity(nb)
+                # lexical collective NAME sets per arm — exactly what set-
+                # based HT102 compares, so the hand-off below is precise
+                lex_a = {at[1] for at in _iter_atoms(arm_a) if at[0] == "coll"}
+                lex_b = {at[1] for at in _iter_atoms(arm_b) if at[0] == "coll"}
+                witness = next(
+                    (c for c in candidates if c.kind == "coll" and len(c.chain) > 1),
+                    None,
+                )
+                order_mismatch = False
+                if witness is None:
+                    depth0 = [c for c in candidates if c.kind == "coll"]
+                    # HT102 fires ONLY when the name is lexically present in
+                    # exactly one arm; a depth-0 ORDER difference (same name
+                    # set, different sequence) is invisible to it and stays
+                    # ours to report
+                    if depth0 and not ambiguous:
+                        w = depth0[0]
+                        if (w.data in lex_a) != (w.data in lex_b):
+                            continue  # one-arm-only lexical: HT102's finding
+                        witness = w
+                        order_mismatch = True
+                    elif not ambiguous:
+                        # remaining structural difference (loop/either of
+                        # resolved parts): report with the branch itself
+                        witness = candidates[0] if candidates else None
+                elif witness.data in lex_a and witness.data in lex_b:
+                    order_mismatch = True
+                if witness is None or witness.kind != "coll":
+                    severity = "info"
+                    detail = f"unproven@{marker}"
+                    message = (
+                        f"cannot prove the collective footprint is identical across "
+                        f"the arms of this branch on `{marker}`: the comparison "
+                        "crosses an unresolved or data-conditional call — verify "
+                        "manually that every rank stages the same collectives"
+                        if ambiguous
+                        else f"the arms of this branch on `{marker}` stage different "
+                        "collective structure (loop/branch shape differs across "
+                        "ranks) — ranks taking different arms will desynchronize"
+                    )
+                    if not ambiguous:
+                        severity = "error"
+                        detail = f"structure@{marker}"
+                else:
+                    coll = witness.data
+                    severity = "info" if ambiguous else "error"
+                    hops = " -> ".join(f"{q2}" for _p2, q2, _l2 in witness.chain)
+                    if order_mismatch:
+                        message = (
+                            f"collective `{coll}` is staged in a DIFFERENT ORDER "
+                            f"across the arms of a branch conditioned on `{marker}` "
+                            f"(first divergence {len(witness.chain) - 1} call(s) deep, "
+                            f"{hops}): ranks taking different arms post the same "
+                            "collectives in different sequence and desynchronize — "
+                            "the static counterpart of a postmortem `desync` verdict"
+                        )
+                    else:
+                        message = (
+                            f"collective `{coll}` is staged on only one arm of a branch "
+                            f"conditioned on `{marker}`, {len(witness.chain) - 1} call(s) "
+                            f"deep ({hops}): ranks that skip the branch never post it — "
+                            "the static counterpart of a postmortem `desync` verdict"
+                        )
+                    detail = f"{coll}@{marker}"
+                f = Finding(
+                    rule=self.code,
+                    path=path,
+                    line=line,
+                    col=0,
+                    message=message,
+                    qualname=qual,
+                    detail=detail,
+                    severity=severity,
+                    trace=_trace_dicts(witness.chain if witness is not None else ((path, qual, line),)),
+                )
+                out.append(f)
+        return out
+
+
+@register
+class TransitiveHostSyncRule(Rule):
+    """Transitive host sync: a public API function whose call chain reaches
+    a blocking device->host read that lexical HT101 cannot pin on the entry
+    — either a naked sink hidden in a private helper (HT101 flags the
+    helper's line; this rule names the public surfaces it poisons), a
+    suppressed sink (downgraded to ``info``: a human vouched for the site,
+    not for every caller), or a ``float()``/``int()``/``np.asarray`` cast
+    of a call whose device-ness is only visible interprocedurally (the
+    callee returns a device value — HT101's lexical heuristic provably
+    misses these)."""
+
+    code = "HT202"
+    name = "transitive-host-sync"
+    description = "public API whose call chain reaches a host sync invisible to lexical HT101"
+    program_level = True
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for rep in sorted(
+            program.sync_reports,
+            key=lambda r: (r.entry[0], r.entry[1], r.entry_line, r.detail),
+        ):
+            path, qual = rep.entry
+            if program.is_suppressed(self.code, path, rep.entry_line):
+                continue
+            sink_path, sink_qual, sink_line = rep.chain[-1]
+            if rep.vis == "cast":
+                message = (
+                    f"`{rep.detail.split('-')[0]}()` of `{sink_qual}(...)` is a hidden "
+                    f"device->host sync: `{sink_qual}` returns a device value "
+                    f"({sink_path}:{sink_line}), so this cast blocks like `.item()` — "
+                    "route through host_fetch/numpy() or keep the value on device"
+                )
+            else:
+                suffix = (
+                    " (the sink is suppressed at its site; suppressions vouch for "
+                    "the helper, not for every public caller)"
+                    if rep.vis == "suppressed"
+                    else ""
+                )
+                message = (
+                    f"public API `{qual}` reaches a naked host sync `{rep.detail}` "
+                    f"in `{sink_qual}` ({sink_path}:{sink_line}), "
+                    f"{len(rep.chain) - 1} call(s) deep: callers expecting async "
+                    f"dispatch stall on the device stream{suffix}"
+                )
+            out.append(
+                Finding(
+                    rule=self.code,
+                    path=path,
+                    line=rep.entry_line,
+                    col=0,
+                    message=message,
+                    qualname=qual,
+                    detail=f"{rep.detail}@{sink_qual}",
+                    severity="info" if rep.vis == "suppressed" else "error",
+                    trace=_trace_dicts(rep.chain),
+                )
+            )
+        return out
+
+
+@register
+class InterproceduralUseAfterDonateRule(Rule):
+    """Interprocedural use-after-donate: a name is read after being passed
+    to a call that donates that parameter *inside the callee* (directly or
+    transitively).  HT103 only sees ``donate=True`` kwargs and locally-
+    jitted ``donate_argnums`` — a helper that donates its argument is
+    invisible to it, and the caller's later read returns garbage or raises
+    only under certain layouts.  Call sites HT103 already covers (lexical
+    donate kwarg, the caller's own jit aliases) are excluded."""
+
+    code = "HT203"
+    name = "interprocedural-use-after-donate"
+    description = "name read after a call that donates it inside the callee"
+    program_level = True
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for key in sorted(program.effects):
+            eff = program.effects[key]
+            path, qual = key
+            ctx = program.contexts.get(path)
+            caller_facts = program.facts[path].functions.get(qual)
+            if ctx is None or caller_facts is None:
+                continue
+            events = []
+            for cid, (desc_json, line, _dl) in enumerate(eff["calls"]):
+                if desc_json.get("donate_kwarg"):
+                    continue  # lexical donation: HT103's finding
+                dotted = desc_json.get("dotted") or ""
+                alias = caller_facts.local_aliases.get(dotted)
+                if alias is not None and alias[1]:
+                    # caller's own jit alias WITH donate_argnums: HT103's
+                    # finding.  A plain rename (`h = _helper`) carries no
+                    # lexical donation — HT103 is blind to it, so it is ours.
+                    continue
+                r = program.resolved[key][cid]
+                if r.kind != "resolved":
+                    continue
+                callee_don = program.donates.get(r.target, {})
+                positions = set(callee_don) | set(r.donates_override or ())
+                args = desc_json.get("args", [])
+                for p in sorted(positions):
+                    if p < len(args) and args[p]:
+                        events.append(
+                            (line, desc_json.get("col", 0), args[p], r.target,
+                             callee_don.get(p))
+                        )
+            if not events:
+                continue
+            fn_node = next(
+                (
+                    n
+                    for n in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef)
+                    if ctx.qualname(n) == qual
+                ),
+                None,
+            )
+            if fn_node is None:
+                continue
+            call_index = {
+                (c.lineno, c.col_offset): c
+                for c in ast.walk(fn_node)
+                if isinstance(c, ast.Call)
+            }
+            for line, col, name, target, dinfo in events:
+                call = call_index.get((line, col))
+                if call is None:
+                    continue
+                out.extend(
+                    self._uses_after(program, ctx, fn_node, call, name, key, target, dinfo)
+                )
+        return out
+
+    def _uses_after(self, program, ctx, fn, call, name, key, target, dinfo):
+        path, qual = key
+        donate_key = (call.end_lineno or call.lineno, call.end_col_offset or 0)
+        stmt = call
+        for anc in [call] + ctx.ancestors(call):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+        ):
+            return  # x = helper(x): the donation rebinds, taint never lands
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return  # control leaves the frame at the donating call
+        rebound_at = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == name and isinstance(
+                node.ctx, ast.Store
+            ):
+                at = (node.lineno, node.col_offset)
+                if at > donate_key and (rebound_at is None or at < rebound_at):
+                    rebound_at = at
+        chain = ((path, qual, call.lineno),) + (dinfo.chain if dinfo else ())
+        callee_qual = target[1]
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Name)
+                and node.id == name
+                and isinstance(node.ctx, ast.Load)
+            ):
+                continue
+            at = (node.lineno, node.col_offset)
+            if at <= donate_key:
+                continue
+            if rebound_at is not None and at > rebound_at:
+                continue
+            if branch_exclusive(ctx, call, node):
+                continue
+            if program.is_suppressed(self.code, path, node.lineno):
+                continue
+            yield Finding(
+                rule=self.code,
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"`{name}` is read after `{callee_qual}(...)` at line "
+                    f"{call.lineno} donated it inside the callee "
+                    f"({dinfo.chain[-1][0]}:{dinfo.chain[-1][2]} donates)"
+                    if dinfo
+                    else f"`{name}` is read after `{callee_qual}(...)` at line "
+                    f"{call.lineno} donated it inside the callee"
+                ),
+                qualname=ctx.qualname(node),
+                detail=name,
+                severity="error",
+                trace=_trace_dicts(chain),
+            )
+
+
+@register
+class TransitiveUndeadlinedBlockingRule(Rule):
+    """Transitively undeadlined blocking: a public library entry whose call
+    chain reaches a naked blocking wait (``Barrier()``, ``Wait``,
+    ``block_until_ready``, ``sync_global_devices``) with NO
+    ``comm.deadline(...)`` scope on any hop of the path — the lint-time
+    counterpart of a ``health.deadline.trips`` increment that never fires
+    because nothing armed the watchdog.  A deadline anywhere on the path
+    (around the wait itself, or around any call on the chain) satisfies
+    the rule; a wait suppressed at its site propagates as ``info``."""
+
+    code = "HT204"
+    name = "transitive-undeadlined-blocking"
+    description = "public entry reaching a blocking wait with no deadline on any path"
+    program_level = True
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for rep in sorted(
+            program.wait_reports,
+            key=lambda r: (r.entry[0], r.entry[1], r.entry_line, r.detail),
+        ):
+            path, qual = rep.entry
+            if program.is_suppressed(self.code, path, rep.entry_line):
+                continue
+            sink_path, sink_qual, sink_line = rep.chain[-1]
+            suffix = (
+                " (suppressed at its site; the suppression vouches for the "
+                "helper, not for every public caller)"
+                if rep.vis == "suppressed"
+                else ""
+            )
+            out.append(
+                Finding(
+                    rule=self.code,
+                    path=path,
+                    line=rep.entry_line,
+                    col=0,
+                    message=(
+                        f"public entry `{qual}` reaches blocking wait `{rep.detail}` "
+                        f"in `{sink_qual}` ({sink_path}:{sink_line}) with no "
+                        f"comm.deadline scope on any path — a dead peer hangs this "
+                        f"API forever; arm `with comm.deadline(...)` around the call "
+                        f"or at the wait site{suffix}"
+                    ),
+                    qualname=qual,
+                    detail=f"{rep.detail}@{sink_qual}",
+                    severity="info" if rep.vis == "suppressed" else "error",
+                    trace=_trace_dicts(rep.chain),
+                )
+            )
         return out

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import _complexsafe, devices, sanitation, types
-from .communication import sanitize_comm
+from .communication import Communication, sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 
@@ -399,12 +399,18 @@ def ravel_multi_index(multi_index, dims, mode: str = "raise", order: str = "C"):
     dims_t = tuple(int(d) for d in dims)
     if mode == "raise":
         # numpy contract: out-of-bounds multi-indices are an error; validate
-        # eagerly, then index with clip semantics
-        for j, d in zip(js, dims_t):
-            lo = int(jnp.min(j)) if j.size else 0
-            hi = int(jnp.max(j)) if j.size else 0
-            if lo < 0 or hi >= d:
-                raise ValueError(f"invalid entry in coordinates array (range [{lo}, {hi}] for dim {d})")
+        # eagerly, then index with clip semantics.  ONE sanctioned host_fetch
+        # for every axis's (min, max) pair (retried + deadline-guarded, see
+        # choose()) instead of 2*ndim naked int() syncs
+        checks = [(j, d) for j, d in zip(js, dims_t) if j.size]
+        if checks:
+            bounds = Communication.host_fetch(
+                jnp.stack([jnp.stack([jnp.min(j), jnp.max(j)]) for j, _ in checks])
+            )
+            for (_j, d), bound in zip(checks, bounds):
+                lo, hi = int(bound[0]), int(bound[1])
+                if lo < 0 or hi >= d:
+                    raise ValueError(f"invalid entry in coordinates array (range [{lo}, {hi}] for dim {d})")
         mode = "clip"
     res = jnp.ravel_multi_index(tuple(js), dims_t, mode=mode, order=order)
     proto = next((m for m in multi_index if isinstance(m, _D)), None)

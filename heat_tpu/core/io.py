@@ -131,6 +131,31 @@ def _proc_info(data) -> tuple:
     return jax.process_count(), jax.process_index()
 
 
+# a cross-process barrier in a save path should fail loudly, not hang the
+# world when a peer is dead: every io-layer sync_global_devices runs under
+# this deadline (the elastic-runtime contract, PR 5) unless the caller
+# already armed a tighter one
+_IO_SYNC_DEADLINE = 600.0
+
+
+def _bounded_sync(tag: str) -> None:
+    """``sync_global_devices`` under a collective deadline: raises
+    ``CollectiveTimeoutError`` (after a stack dump) instead of blocking
+    forever on a dead peer.  An already-armed caller deadline governs (its
+    remaining budget is re-armed, never loosened); otherwise the generous
+    io default applies."""
+    from jax.experimental import multihost_utils
+
+    from ..utils import health as _health
+
+    active = _health.active_deadline()
+    budget = active.remaining() if active is not None else _IO_SYNC_DEADLINE
+    with _health.deadline(budget):
+        _health.guard_blocking(
+            lambda: multihost_utils.sync_global_devices(tag), f"io.sync:{tag}"
+        )
+
+
 def _token_ring_write(data, tag: str, body) -> None:
     """Rank-ordered single-writer-at-a-time file writes for multi-process
     runs — the reference's token-ring fallback when parallel HDF5 is absent
@@ -158,8 +183,6 @@ def _token_ring_write(data, tag: str, body) -> None:
         arr = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
         _note_chunk(arr.nbytes)
         slabs = [(tuple(slice(0, s) for s in arr.shape), arr)]
-    from jax.experimental import multihost_utils
-
     failure = None
     for r in range(nproc):
         if failure is None and r == rank and (r == 0 or not only_rank0):
@@ -167,7 +190,7 @@ def _token_ring_write(data, tag: str, body) -> None:
                 body(r == 0, slabs if only_rank0 else _iter_hyperslabs(data))
             except Exception as e:  # noqa: BLE001 — re-raised after the ring
                 failure = e
-        multihost_utils.sync_global_devices(f"token_ring:{tag}:{r}")
+        _bounded_sync(f"token_ring:{tag}:{r}")
     if failure is not None:
         raise failure
 
@@ -585,8 +608,6 @@ def save_zarr(data: DNDarray, path: str) -> None:
     """
     import json
 
-    from jax.experimental import multihost_utils
-
     if not isinstance(data, DNDarray):
         from . import factories
 
@@ -617,7 +638,7 @@ def save_zarr(data: DNDarray, path: str) -> None:
         with open(os.path.join(path, ".zarray"), "w") as f:
             json.dump(meta, f)
     if nproc > 1:
-        multihost_utils.sync_global_devices("zarr:descriptor")
+        _bounded_sync("zarr:descriptor")
     np_dtype = data.dtype.np_dtype()
     if split is None:
         if rank == 0 or nproc == 1:
@@ -641,7 +662,7 @@ def save_zarr(data: DNDarray, path: str) -> None:
                 os.path.join(path, ".".join(idx))
             )
     if nproc > 1:
-        multihost_utils.sync_global_devices("zarr:chunks-written")
+        _bounded_sync("zarr:chunks-written")
 
 
 def load_zarr(path: str, dtype=None, split: Optional[int] = None,

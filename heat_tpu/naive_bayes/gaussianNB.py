@@ -63,11 +63,14 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         self.classes_ = wrap(classes)
         self.class_count_ = wrap(counts)
         if self.priors is not None:
-            pr = jnp.asarray(self.priors, dtype=means.dtype)
-            if pr.shape[0] != int(classes.shape[0]):
+            # priors are HOST data (user-provided): validate before device
+            # placement so no device->host sync is needed at all
+            pr_host = np.asarray(self.priors, dtype=np.float64)
+            if pr_host.shape[0] != int(classes.shape[0]):
                 raise ValueError("Number of priors must match number of classes")
-            if not np.isclose(float(jnp.sum(pr)), 1.0):
+            if not np.isclose(pr_host.sum(), 1.0):
                 raise ValueError("The sum of the priors should be 1")
+            pr = jnp.asarray(pr_host, dtype=means.dtype)
             self.class_prior_ = wrap(pr)
         else:
             fcounts = counts.astype(means.dtype)

@@ -107,7 +107,7 @@ def _guard_counters(opt_state) -> dict:
 
     def _local(x):
         if getattr(x, "is_fully_addressable", True):
-            return jax.device_get(x)
+            return jax.device_get(x)  # heatlint: disable=HT101 local-shard read, never collective
         import numpy as _np
 
         # one value per DISTINCT shard index: each group's counter is

@@ -2,20 +2,26 @@
 """heatlint CLI — static analysis of heat_tpu's distributed invariants.
 
 Usage:
-    python scripts/heatlint.py heat_tpu/                    # gate vs baseline
+    python scripts/heatlint.py heat_tpu/ benchmarks/ tutorials/
     python scripts/heatlint.py heat_tpu/ --json out.json    # machine output
+    python scripts/heatlint.py heat_tpu/ --sarif out.sarif  # PR annotations
     python scripts/heatlint.py heat_tpu/ --write-baseline   # regenerate
     python scripts/heatlint.py --list-rules
 
-Exit codes: 0 = clean (no findings beyond the committed baseline),
-1 = new findings, 2 = usage error.
+Exit codes: 0 = clean (no ERROR findings beyond the committed baseline),
+1 = new error findings, 2 = usage error.  ``info``-severity findings (the
+interprocedural rules' unresolved-call downgrades) never gate — they are
+counted in the summary, listed with ``--show-info``, and carried in the
+JSON/SARIF output at note level.
 
 Suppressions: ``# heatlint: disable=HT101`` on the offending line,
 ``# heatlint: disable-file=HT101`` anywhere for the whole file.
 The baseline (default: .heatlint-baseline.json next to the repo root)
 grandfathers pre-existing findings by fingerprint — line drift does not
 invalidate it, and ``--write-baseline`` regenerates it after intentional
-changes.
+changes.  The interprocedural passes cache per-file effect summaries in
+``.heatlint-summaries.json`` (keyed by content hash; ``--no-cache``
+disables, ``--summaries-cache`` relocates).
 """
 
 from __future__ import annotations
@@ -37,7 +43,10 @@ def _load_analysis():
     A synthetic parent package keeps the relative imports working."""
     name = "_heatlint_analysis"
     if name in sys.modules:
-        return sys.modules[name]
+        # a second loader in the same process (two test modules both
+        # importing the CLI) must get the FRAMEWORK back, not the synthetic
+        # parent package
+        return sys.modules[name + ".framework"]
     pkg_dir = os.path.join(REPO, "heat_tpu", "analysis")
     pkg = types.ModuleType(name)
     pkg.__path__ = [pkg_dir]
@@ -59,11 +68,13 @@ all_rules = _fw.all_rules
 lint_paths = _fw.lint_paths
 load_baseline = _fw.load_baseline
 render_json = _fw.render_json
+render_sarif = _fw.render_sarif
 render_text = _fw.render_text
 split_by_baseline = _fw.split_by_baseline
 write_baseline = _fw.write_baseline
 
 DEFAULT_BASELINE = os.path.join(REPO, ".heatlint-baseline.json")
+DEFAULT_SUMMARIES_CACHE = os.path.join(REPO, ".heatlint-summaries.json")
 
 
 def main(argv=None) -> int:
@@ -85,7 +96,27 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--json", metavar="FILE", help="write JSON findings to FILE ('-' = stdout)")
     ap.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="write SARIF 2.1.0 findings to FILE (for codeql-action/upload-sarif)",
+    )
+    ap.add_argument(
         "--show-baselined", action="store_true", help="also print grandfathered findings"
+    )
+    ap.add_argument(
+        "--show-info",
+        action="store_true",
+        help="also print info-severity (non-gating, unresolved-call-downgraded) findings",
+    )
+    ap.add_argument(
+        "--summaries-cache",
+        default=DEFAULT_SUMMARIES_CACHE,
+        help="interprocedural summary cache file (default: %(default)s)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the interprocedural summary cache",
     )
     ap.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
     args = ap.parse_args(argv)
@@ -99,8 +130,12 @@ def main(argv=None) -> int:
         ap.error("no paths given (try: heat_tpu/)")
 
     select = [c for c in (args.select or "").split(",") if c.strip()] or None
+    cache_path = None if args.no_cache else args.summaries_cache
+    unresolved: list = []
     try:
-        findings = lint_paths(args.paths, select=select)
+        findings = lint_paths(
+            args.paths, select=select, cache_path=cache_path, unresolved_out=unresolved
+        )
     except ValueError as exc:
         print(f"heatlint: {exc}", file=sys.stderr)
         return 2
@@ -118,6 +153,16 @@ def main(argv=None) -> int:
 
     for f in findings:
         f.path = _norm(f.path)
+        for hop in f.trace:
+            hop["path"] = _norm(hop["path"])
+    for u in unresolved:
+        u["caller_path"] = _norm(u["caller_path"])
+
+    # info findings (unresolved-call downgrades) are reported, never gated,
+    # never baselined: a baseline entry would imply a human signed off on a
+    # conclusion the analysis itself says it cannot prove
+    errors = [f for f in findings if f.severity == "error"]
+    info = [f for f in findings if f.severity != "error"]
 
     if args.write_baseline:
         if select:
@@ -141,25 +186,42 @@ def main(argv=None) -> int:
             for r in _fw.load_baseline_records(args.baseline)
             if r.get("path") not in linted
         ]
-        write_baseline(args.baseline, list(findings) + preserved)
+        write_baseline(args.baseline, list(errors) + preserved)
         print(
-            f"heatlint: wrote {len(findings)} finding(s) to {args.baseline}"
+            f"heatlint: wrote {len(errors)} finding(s) to {args.baseline}"
             + (f" (+{len(preserved)} preserved outside the linted paths)" if preserved else "")
+            + (f" ({len(info)} info finding(s) not baselined)" if info else "")
         )
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
-    new, grandfathered = split_by_baseline(findings, baseline)
+    new, grandfathered = split_by_baseline(errors, baseline)
 
     if args.json:
-        payload = render_json(new, grandfathered)
+        # the unresolved bucket rides along in the machine output: the
+        # honesty policy's audit trail of every call the engine could not
+        # place, with its reason — never silently dropped
+        payload = render_json(new, grandfathered, info=info, unresolved=unresolved)
         if args.json == "-":
             print(payload)
         else:
             with open(args.json, "w", encoding="utf-8") as fh:
                 fh.write(payload + "\n")
 
-    print(render_text(new, grandfathered, verbose_baselined=args.show_baselined))
+    if args.sarif:
+        sarif = render_sarif(new, grandfathered, info=info, rules=all_rules(select))
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            fh.write(sarif + "\n")
+
+    print(
+        render_text(
+            new,
+            grandfathered,
+            verbose_baselined=args.show_baselined,
+            info=info,
+            show_info=args.show_info,
+        )
+    )
     return 1 if new else 0
 
 

@@ -671,7 +671,7 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
-            "HT108",
+            "HT108", "HT201", "HT202", "HT203", "HT204",
         ]
 
     def test_select_unknown_rule_raises(self):
