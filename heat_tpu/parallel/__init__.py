@@ -16,11 +16,23 @@ from .sample_sort import order_statistics_1d, sample_sort_1d
 from .pipeline import pipeline_apply
 from . import supervisor
 from .supervisor import Supervisor, SupervisorResult
+from . import scheduler
+from .scheduler import Job, JobJournal, JobRejected, JournalSchemaError, Scheduler
+from . import serving
+from .serving import make_executor
 
 __all__ = [
     "Supervisor",
     "SupervisorResult",
     "supervisor",
+    "Scheduler",
+    "Job",
+    "JobJournal",
+    "JobRejected",
+    "JournalSchemaError",
+    "scheduler",
+    "serving",
+    "make_executor",
     "pipeline_apply",
     "ring_map",
     "halo_exchange",

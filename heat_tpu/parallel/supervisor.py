@@ -120,6 +120,7 @@ class SupervisorResult:
     counters: Dict[str, int]
     failures: List[str] = field(default_factory=list)
     postmortems: List[dict] = field(default_factory=list)
+    jobs: Optional[dict] = None
 
     def report(self) -> dict:
         """Merged diagnostic structure (printed/JSON-dumped by launchers on
@@ -127,8 +128,11 @@ class SupervisorResult:
         line).  ``postmortems`` carries one flight-recorder verdict per
         failed generation (``scripts/postmortem.py``): the report no
         longer just says "rank died / went stale", it names the first
-        divergent collective sequence or the straggler rank."""
-        return {
+        divergent collective sequence or the straggler rank.  ``jobs``
+        (when a serving scheduler's journal was configured) accounts every
+        accepted job per generation — accepted/completed/retried/shed/
+        failed, plus ``lost``, the count the chaos lane pins at zero."""
+        rep = {
             "ok": self.ok,
             "restarts": self.restarts,
             "generations": self.generations,
@@ -137,6 +141,9 @@ class SupervisorResult:
             "failures": list(self.failures),
             "postmortems": list(self.postmortems),
         }
+        if self.jobs is not None:
+            rep["jobs"] = dict(self.jobs)
+        return rep
 
 
 class Supervisor:
@@ -176,6 +183,7 @@ class Supervisor:
         grace: float = 3.0,
         flightrec_dir: Optional[str] = None,
         telemetry_dir: Optional[str] = None,
+        job_journal: Optional[str] = None,
     ):
         self.spawn = spawn
         self.n_ranks = int(n_ranks)
@@ -192,6 +200,12 @@ class Supervisor:
         # comm.<name>.wait straggler evidence into it
         self.flightrec_dir = flightrec_dir
         self.telemetry_dir = telemetry_dir
+        # serving integration: when the workers run a scheduler journaling
+        # to `job_journal`, the final report carries a per-generation jobs
+        # section (accepted/completed/retried/shed/failed + lost) merged
+        # from that journal — scheduler.py is loaded standalone, so this
+        # process still never imports jax
+        self.job_journal = job_journal
         self.counters: Dict[str, int] = {
             "watchdog.dumps": 0,
             "watchdog.kills": 0,
@@ -276,23 +290,51 @@ class Supervisor:
         os.path.dirname(os.path.abspath(__file__)), "..", "..", "scripts",
         "postmortem.py",
     )
-    _postmortem_mod = None
+    _SCHEDULER_PATH = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scheduler.py"
+    )
+    _tool_mods: Dict[str, object] = {}
 
     @classmethod
-    def _load_postmortem(cls):
-        """scripts/postmortem.py loaded standalone (this process must never
-        import jax); None when the file is missing (a stripped install) —
-        the supervisor then degrades to the pre-PR-7 report."""
-        if cls._postmortem_mod is None:
-            path = os.path.normpath(cls._POSTMORTEM_PATH)
+    def _load_tool(cls, modname: str, path: str):
+        """The ONE standalone-loader for the supervisor's stdlib-only
+        diagnostic companions (postmortem analyzer, scheduler journal
+        replayer) — this process must never import jax.  None when the
+        file is missing (a stripped install): the report then degrades
+        gracefully, it never loses the supervision result over a
+        diagnostics module."""
+        if modname not in cls._tool_mods:
+            path = os.path.normpath(path)
             if not os.path.exists(path):
                 return None
-            spec = importlib.util.spec_from_file_location("heat_postmortem", path)
+            spec = importlib.util.spec_from_file_location(modname, path)
             mod = importlib.util.module_from_spec(spec)
             sys.modules[spec.name] = mod
             spec.loader.exec_module(mod)
-            cls._postmortem_mod = mod
-        return cls._postmortem_mod
+            cls._tool_mods[modname] = mod
+        return cls._tool_mods[modname]
+
+    def _jobs_section(self) -> Optional[dict]:
+        """The per-generation job accounting merged from the scheduler
+        journal; None when no journal was configured or nothing was
+        written.  Diagnostics must never kill the supervisor: a corrupt /
+        newer-schema journal — or a scheduler.py that fails to load —
+        degrades to an ``error`` entry, not a crash."""
+        if not self.job_journal or not os.path.exists(self.job_journal):
+            return None
+        try:
+            sched = self._load_tool("heat_scheduler", self._SCHEDULER_PATH)
+            if sched is None:
+                return None
+            return sched.jobs_summary(sched.replay_journal(self.job_journal))
+        except Exception as e:
+            return {"error": f"journal replay failed: {e!r}"}
+
+    @classmethod
+    def _load_postmortem(cls):
+        """scripts/postmortem.py via :meth:`_load_tool` (kept as a named
+        entry point — the run loop calls it at every teardown)."""
+        return cls._load_tool("heat_postmortem", cls._POSTMORTEM_PATH)
 
     def _run_postmortem(self, epoch: int, failure: str) -> Optional[dict]:
         """Analyze the dead generation's rings, then HARVEST them (move
@@ -351,6 +393,7 @@ class Supervisor:
                         counters=dict(self.counters),
                         failures=failures,
                         postmortems=postmortems,
+                        jobs=self._jobs_section(),
                     )
                 failure = self._check_failure(procs, gen_wall_start)
                 if failure is not None:
@@ -390,6 +433,7 @@ class Supervisor:
                     counters=dict(self.counters),
                     failures=failures,
                     postmortems=postmortems,
+                    jobs=self._jobs_section(),
                 )
             epoch += 1
             self.counters["health.restarts"] += 1

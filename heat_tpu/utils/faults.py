@@ -28,6 +28,17 @@ two halves of that story:
                             recovers from
   ``dist.init``             each ``jax.distributed.initialize`` attempt in
                             ``bootstrap.init_distributed``
+  ``sched.dispatch``        every scheduler dispatch attempt
+                            (``parallel.scheduler.Scheduler``), fired inside
+                            the armed per-job deadline — ``fail``/``delay``
+                            exercise the retry path, ``hang`` proves a wedged
+                            dispatch trips as THAT job's failure (not a
+                            wedged queue), ``exit`` SIGKILLs a serving rank
+                            mid-queue (the chaos lane's journal-replay
+                            scenario)
+  ``sched.journal.write``   every append to the scheduler's crash-durable
+                            job journal — makes torn-record and
+                            journal-loss recovery deterministically testable
   ========================  ====================================================
 
 - **retry with backoff**: :func:`call_with_retries` — capped, jittered

@@ -30,6 +30,21 @@ worker resumes from the newest verified checkpoint and prints a
 ``MPDRYRUN_FAULT_SPEC`` (e.g. ``proc.exit:exit=5``) to SIGKILL one rank
 deterministically — epoch 0 only, so the restarted world survives.
 
+``MPDRYRUN_MODE=serve`` runs the elastic serving scenario (ISSUE 10):
+every rank runs the IDENTICAL multi-tenant scheduler
+(``heat_tpu.parallel.scheduler``) over ``MPDRYRUN_JOBS`` mixed jobs
+(matmul / solve / KMeans / NN-forward, three tenants, mixed priorities)
+against a ``MPDRYRUN_QUEUE``-bounded queue — overflow is shed with
+``JobRejected``, never buffered.  Rank 0 journals every job transition
+into ``{telemetry}/sched_journal.jsonl``; on a restart epoch every rank
+replays that journal and requeues the accepted-but-unfinished jobs
+exactly once (``SCHED-RECOVERED requeued=R``), so a rank SIGKILLed
+mid-queue (``sched.dispatch:exit=N``) loses ZERO accepted jobs.  The
+launcher prints the journal-derived attestation
+``SCHED jobs=N done=K requeued=R shed=S failed=F lost=L`` plus the
+per-tenant SLO table, and the supervisor report carries the per-generation
+``jobs`` section.
+
 Run:  python scripts/multiprocess_dryrun.py                    (launcher, 2×4)
       MPDRYRUN_NPROC=4 MPDRYRUN_DEVS=2 python scripts/multiprocess_dryrun.py
       python scripts/multiprocess_dryrun.py WORKER_ID          (internal)
@@ -598,6 +613,146 @@ def postmortem_worker(pid: int, port: int, tmpdir: str) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# serve worker (MPDRYRUN_MODE=serve): the elastic multi-tenant serving
+# scenario — a scheduler queue survives a SIGKILLed rank via journal replay
+# ---------------------------------------------------------------------- #
+SERVE_MARKER = "SERVE-OK"
+
+
+def _serve_jobs(sched_mod, n_jobs: int, deadline_s: float):
+    """The deterministic mixed job list — IDENTICAL on every rank (and on
+    every generation), so the SPMD world schedules in lockstep.  Kinds
+    rotate through the four serving workloads; tenants and priorities
+    rotate so the admission/priority machinery sees real variety."""
+    kinds = ("matmul", "solve", "kmeans", "nn_forward")
+    tenants = ("acme", "globex", "initech")
+    payloads = {
+        "matmul": lambda i: {"n": 16, "seed": i},
+        "solve": lambda i: {"n": 8},
+        "kmeans": lambda i: {"n": 32, "k": 2, "seed": i % 3},
+        "nn_forward": lambda i: {"batch": 4, "features": 8, "seed": i},
+    }
+    jobs = []
+    for i in range(n_jobs):
+        kind = kinds[i % len(kinds)]
+        jobs.append(
+            sched_mod.Job(
+                f"job{i:03d}",
+                kind,
+                tenant=tenants[i % len(tenants)],
+                priority=i % 3,
+                deadline_s=deadline_s,
+                retry_budget=1,
+                payload=payloads[kind](i),
+            )
+        )
+    return jobs
+
+
+def serve_worker(pid: int, port: int, tmpdir: str) -> None:
+    """Multi-tenant serving under the supervising launcher.
+
+    Every rank runs the identical scheduler over the identical submissions
+    (SPMD lockstep: divergent scheduling would stage divergent
+    collectives).  Rank 0 journals; on ``HEAT_TPU_RESTART_EPOCH > 0``
+    every rank replays rank 0's journal and requeues the
+    accepted-but-unfinished jobs exactly once instead of resubmitting —
+    a DONE job is never executed twice, an in-flight one is never lost.
+    Arm ``MPDRYRUN_FAULT_RANK`` + ``MPDRYRUN_FAULT_SPEC=sched.dispatch:exit=N``
+    to SIGKILL one rank at its Nth dispatch (epoch 0 only)."""
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+    faulthandler.dump_traceback_later(
+        float(os.environ.get("MPDRYRUN_WATCHDOG", "450")), exit=True
+    )
+    n_proc = int(os.environ.get("MPDRYRUN_NPROC", N_PROC))
+    devs = int(os.environ.get("MPDRYRUN_DEVS", DEVS_PER_PROC))
+    os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=n_proc, process_id=pid
+    )
+    sys.path.insert(0, REPO)
+
+    import heat_tpu as ht
+
+    ht.core.bootstrap.init_distributed(num_processes=n_proc, process_id=pid)
+    from heat_tpu.utils import telemetry
+
+    telemetry.enable()
+    comm = ht.communication.get_comm()
+    hb = _make_heartbeat(pid)
+    hb.beat(step=0, status="bring-up")
+
+    from heat_tpu.parallel import scheduler as sched_mod
+    from heat_tpu.parallel import serving
+
+    n_jobs = int(os.environ.get("MPDRYRUN_JOBS", "20"))
+    max_queue = int(os.environ.get("MPDRYRUN_QUEUE", "18"))
+    deadline_s = float(os.environ.get("MPDRYRUN_JOB_DEADLINE", "300"))
+    journal_path = os.path.join(tmpdir, "telemetry", "sched_journal.jsonl")
+    epoch = ht.core.bootstrap.restart_epoch()
+    sch = sched_mod.Scheduler(
+        serving.make_executor(comm),
+        max_queue=max_queue,
+        max_batch=4,
+        # only rank 0 writes (one journal per scheduler WORLD — the ranks
+        # schedule in lockstep, so one rank's record stream is the truth);
+        # every rank READS it on recovery
+        journal=sched_mod.JobJournal(journal_path) if pid == 0 else None,
+        batch_key=serving.batch_key,
+    )
+    # seq-stamped lockstep attestation: the serving dispatches are GSPMD
+    # programs whose collectives live INSIDE jit (never staged through
+    # Communication), so the flight-recorder ring would otherwise hold no
+    # collective records and a green run could not read `clean`.  One
+    # accounted resplit before and after the drain puts an identical
+    # bracket in every rank's stream — rings then prove the ranks entered
+    # and left the serving loop in lockstep.
+    def _lockstep_stamp():
+        ht.reshape(
+            ht.arange(comm.size * comm.size, dtype=ht.float32, split=0),
+            (comm.size, comm.size),
+        ).resplit(1)
+
+    _lockstep_stamp()
+    requeued = 0
+    if epoch > 0:
+        requeued = sch.recover(journal_path)
+        print(f"[{pid}] SCHED-RECOVERED epoch={epoch} requeued={requeued}", flush=True)
+    else:
+        for job in _serve_jobs(sched_mod, n_jobs, deadline_s):
+            try:
+                sch.submit(job)
+            except sched_mod.JobRejected as e:
+                # load shedding is an IMMEDIATE structured answer — the
+                # submit loop keeps going, nothing blocks
+                print(f"[{pid}] SCHED-SHED id={e.job_id} reason={e.reason}", flush=True)
+    hb.beat(status="serving")
+    rep = sch.run(beat=hb.beat)
+    _lockstep_stamp()
+    done = rep["by_state"].get(sched_mod.DONE, 0)
+    failed = rep["by_state"].get(sched_mod.FAILED, 0)
+    shed = rep["by_state"].get(sched_mod.SHED, 0)
+    print(
+        f"[{pid}] {SERVE_MARKER} jobs={len(rep['jobs'])} done={done} "
+        f"failed={failed} shed={shed} requeued={requeued} "
+        f"reconciled={rep['reconciled']}",
+        flush=True,
+    )
+    telemetry.flush(os.path.join(tmpdir, "telemetry"))
+    print(f"[{pid}] telemetry: rank file exported", flush=True)
+    print(f"[{pid}] {MARKER}", flush=True)
+    faulthandler.cancel_dump_traceback_later()
+    ht.core.bootstrap.finalize_distributed()
+
+
+# ---------------------------------------------------------------------- #
 # train worker (MPDRYRUN_MODE=train): the kill-and-resume chaos scenario
 # ---------------------------------------------------------------------- #
 def train_worker(pid: int, port: int, tmpdir: str) -> None:
@@ -711,8 +866,12 @@ def main() -> int:
     fr_dir = os.path.join(tmpdir, "flightrec")
     tdir = os.path.join(tmpdir, "telemetry")
     restart_budget = int(
-        os.environ.get("MPDRYRUN_RESTARTS", "2" if mode == "train" else "0")
+        os.environ.get("MPDRYRUN_RESTARTS", "2" if mode in ("train", "serve") else "0")
     )
+    # serve mode: rank 0's scheduler journals into the telemetry dir (the
+    # launcher's attestation, the supervisor's jobs section and the SLO
+    # table all read THIS file)
+    job_journal = os.path.join(tdir, "sched_journal.jsonl") if mode == "serve" else None
     # per-generation deadline below the callers' outer timeout, so a hang is
     # reaped by this launcher — which can kill its children — rather than by
     # the caller killing the launcher and orphaning the workers
@@ -772,6 +931,7 @@ def main() -> int:
         generation_deadline=gen_deadline,
         flightrec_dir=fr_dir,
         telemetry_dir=tdir,
+        job_journal=job_journal,
     )
     res = sup.run()
     for log in open_logs:
@@ -826,6 +986,43 @@ def main() -> int:
         f"watchdog.kills={launcher_counters['watchdog.kills']}",
         flush=True,
     )
+    # serving attestation (ISSUE 10): the whole run's job accounting,
+    # merged from the scheduler journal by the supervisor — every ACCEPTED
+    # job must have reached DONE or a named FAILED across however many
+    # generations it took; `lost` counts the ones that did neither, and a
+    # single lost job fails the run
+    if job_journal is not None:
+        sched_mod = _load_standalone("heat_scheduler", "heat_tpu/parallel/scheduler.py")
+        if res.jobs is None:
+            print("launcher: serve mode but no job journal was written")
+            ok = False
+        elif "error" in res.jobs:
+            print(f"launcher: job journal unreadable: {res.jobs['error']}")
+            ok = False
+        else:
+            print(sched_mod.attestation_line(res.jobs), flush=True)
+            if ok and res.jobs["lost"] != 0:
+                print(
+                    "launcher: accepted job(s) neither DONE nor FAILED — "
+                    "the zero-loss contract is broken"
+                )
+                ok = False
+            # the journal must have seen EVERY client submission: a rank
+            # killed mid-submit-loop would otherwise yield a green lost=0
+            # attestation over silently vanished requests
+            expected_jobs = int(os.environ.get("MPDRYRUN_JOBS", "20"))
+            if ok and res.jobs["jobs"] != expected_jobs:
+                print(
+                    f"launcher: journal saw {res.jobs['jobs']} of "
+                    f"{expected_jobs} submitted jobs — submissions vanished"
+                )
+                ok = False
+        # per-tenant SLO table: queue-wait + execution latency percentiles
+        # from the journal and the ranks' already-merged sched.job spans
+        # (spans= skips re-parsing every rank file)
+        slo = trep.slo_section([tdir], spans=merged["timeline"])
+        if slo:
+            print(slo, flush=True)
     # flight-recorder post-mortem (ISSUE 7): failed generations were
     # analyzed + harvested by the supervisor at teardown (one verdict per
     # generation in res.postmortems); on success the final generation's
@@ -864,9 +1061,11 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1:
         _mode = os.environ.get("MPDRYRUN_MODE", "dryrun")
-        _target = {"train": train_worker, "postmortem": postmortem_worker}.get(
-            _mode, worker
-        )
+        _target = {
+            "train": train_worker,
+            "postmortem": postmortem_worker,
+            "serve": serve_worker,
+        }.get(_mode, worker)
         _target(
             int(sys.argv[1]),
             int(os.environ["MPDRYRUN_PORT"]),

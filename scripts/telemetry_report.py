@@ -23,7 +23,14 @@ Each rank of a run writes ``rank<k>.jsonl`` (``heat_tpu.utils.telemetry
   seq × rank fingerprint grid centered on the first divergence or the
   straggler's stuck sequence, plus the one-line post-mortem verdict
   (``scripts/postmortem.py`` does the merge; this CLI just folds its view
-  into the report so one command reads a whole run's artifacts).
+  into the report so one command reads a whole run's artifacts);
+- when serving artifacts are present — ``sched.job`` telemetry spans in
+  the rank files and/or a scheduler journal (``sched_journal*.jsonl``,
+  ``heat_tpu.parallel.scheduler``) — a per-tenant **SLO table**: job
+  counts by outcome plus p50/p99 queue wait and execution latency (span
+  durations when exported; journal record timestamps otherwise, so a
+  journal-only dir — all a SIGKILLed rank leaves behind — still yields
+  the full table).
 
 Deliberately stdlib-only (no jax, no heat_tpu import): it must run
 instantly on a login node against artifacts scp'd from a pod.
@@ -39,7 +46,7 @@ import json
 import math
 import os
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
 def find_rank_files(target: str) -> List[str]:
@@ -312,6 +319,165 @@ def flightrec_section(dirs: List[str], context: int = 5) -> str:
     return "\n".join(out)
 
 
+_scheduler = None
+
+
+def _scheduler_mod():
+    """``heat_tpu/parallel/scheduler.py`` loaded standalone (stdlib-only,
+    like this CLI) — the ONE implementation of journal replay.  None when
+    the file is missing (a stripped install): the report then has no SLO
+    section from journals (spans still render)."""
+    global _scheduler
+    if _scheduler is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "heat_tpu", "parallel", "scheduler.py",
+        )
+        if not os.path.exists(path):
+            return None
+        spec = importlib.util.spec_from_file_location("telemetry_report_scheduler", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _scheduler = mod
+    return _scheduler
+
+
+def find_journals(target: str) -> List[str]:
+    """Scheduler journal files under a directory, or the file itself."""
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "sched_journal*.jsonl")))
+    base = os.path.basename(target)
+    if base.startswith("sched_journal") and os.path.exists(target):
+        return [target]
+    return []
+
+
+def _pctl(values: List[float], q: float) -> float:
+    """Exact upper percentile of a small sample (serving job counts are
+    human-scale; no need for the histogram approximation here)."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(math.ceil(q * len(vs))) - 1))
+    return vs[idx]
+
+
+def slo_section(targets: List[str], spans: Optional[List[dict]] = None) -> str:
+    """The per-tenant serving SLO table, from whichever artifacts exist:
+
+    - ``sched.job`` spans in the rank files — the high-fidelity latency
+      source (queue wait rides the span attrs, execution latency is the
+      span duration);
+    - scheduler journals (``sched_journal*.jsonl``) — the complete outcome
+      accounting (incl. shed jobs, which never execute and so never span),
+      with record-timestamp latencies as the fallback when no spans were
+      exported (a SIGKILLed rank flushes no telemetry; its journal
+      survives).
+
+    '' when neither is present (the common non-serving invocation prints
+    nothing extra).  ``spans`` lets a caller that already parsed the rank
+    files (``main`` passes ``merged['timeline']``) skip the second read —
+    the rank files are otherwise re-parsed here."""
+    if spans is None:
+        spans = []
+        for t in targets:
+            for p in find_rank_files(t):
+                for rec in _read_records(p):
+                    if rec.get("type") == "span" and rec.get("name") == "sched.job":
+                        spans.append(rec)
+    else:
+        spans = [s for s in spans
+                 if s.get("type") == "span" and s.get("name") == "sched.job"]
+    # every rank of an SPMD serve world emits an identical span per job —
+    # dedup by job id or the per-rank copies would multiply the job
+    # counts and skew the percentiles
+    seen_jobs = set()
+    deduped = []
+    for rec in spans:
+        jid = (rec.get("attrs") or {}).get("id")
+        if jid is not None:
+            if jid in seen_jobs:
+                continue
+            seen_jobs.add(jid)
+        deduped.append(rec)
+    spans = deduped
+    views: Dict[str, dict] = {}
+    notes: List[str] = []
+    for t in targets:
+        for jp in find_journals(t):
+            sched = _scheduler_mod()
+            if sched is None:
+                break
+            try:
+                views.update(sched.replay_journal(jp)["jobs"])
+            except Exception as e:  # a bad journal must not sink the report
+                notes.append(f"journal {jp}: unreadable ({e})")
+    if not spans and not views and not notes:
+        return ""
+    tenants: Dict[str, dict] = {}
+
+    def row(tenant: str) -> dict:
+        return tenants.setdefault(tenant, {
+            "jobs": 0, "done": 0, "failed": 0, "shed": 0,
+            "waits": [], "execs": [],
+        })
+
+    for v in views.values():
+        r = row(str(v.get("tenant", "default")))
+        r["jobs"] += 1
+        state = v.get("state")
+        if state == "done":
+            r["done"] += 1
+        elif state == "failed":
+            r["failed"] += 1
+        elif state == "shed":
+            r["shed"] += 1
+        if v.get("dispatch_t") and v.get("submit_t"):
+            r["waits"].append(max(0.0, v["dispatch_t"] - v["submit_t"]))
+        if v.get("exec_s") is not None:
+            r["execs"].append(float(v["exec_s"]))
+        elif v.get("finish_t") and v.get("dispatch_t"):
+            r["execs"].append(max(0.0, v["finish_t"] - v["dispatch_t"]))
+    by_tenant_spans: Dict[str, dict] = {}
+    for s in spans:
+        at = s.get("attrs") or {}
+        d = by_tenant_spans.setdefault(str(at.get("tenant", "default")),
+                                       {"waits": [], "execs": [], "outcomes": {}})
+        d["waits"].append(float(at.get("queue_wait_s", 0.0)))
+        d["execs"].append(float(s.get("dur_s", 0.0)))
+        oc = str(at.get("outcome", "?"))
+        d["outcomes"][oc] = d["outcomes"].get(oc, 0) + 1
+    for tenant, d in by_tenant_spans.items():
+        r = row(tenant)
+        # spans are the higher-fidelity latency source when both exist
+        r["waits"], r["execs"] = d["waits"], d["execs"]
+        if not views:  # spans-only dir: outcome counts from the spans too
+            r["jobs"] = sum(d["outcomes"].values())
+            r["done"] = d["outcomes"].get("done", 0)
+            r["failed"] = r["jobs"] - r["done"]
+    out = ["\n-- per-tenant serving SLO (sched.job spans + scheduler journal) --"]
+    out.extend(notes)
+    if tenants:
+        rows = []
+        for tenant in sorted(tenants):
+            r = tenants[tenant]
+            rows.append([
+                tenant, r["jobs"], r["done"], r["failed"], r["shed"],
+                f"{_pctl(r['waits'], 0.5) * 1e3:.1f}",
+                f"{_pctl(r['waits'], 0.99) * 1e3:.1f}",
+                f"{_pctl(r['execs'], 0.5) * 1e3:.1f}",
+                f"{_pctl(r['execs'], 0.99) * 1e3:.1f}",
+            ])
+        out.append(_fmt_table(rows, [
+            "tenant", "jobs", "done", "failed", "shed",
+            "wait_p50_ms", "wait_p99_ms", "exec_p50_ms", "exec_p99_ms",
+        ]))
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("targets", nargs="+", help="telemetry dirs and/or rank*.jsonl files")
@@ -330,26 +496,38 @@ def main(argv=None) -> int:
     section = flightrec_section(
         [t for t in args.targets if os.path.isdir(t)], context=args.context
     )
+    merged = merge_files(paths) if paths else None
+    # reuse the merge's already-parsed spans instead of re-reading every
+    # rank file just to pick out the sched.job records
+    slo = slo_section(
+        list(args.targets),
+        spans=merged["timeline"] if merged is not None else None,
+    )
     if not paths:
-        # a dir holding ONLY flight-recorder rings is a legitimate target:
-        # the supervisor's harvested epoch dirs contain rings but no
-        # telemetry jsonl, and the timeline is exactly what a post-mortem
-        # reader comes for
-        if section:
+        # a dir holding ONLY flight-recorder rings or a scheduler journal
+        # is a legitimate target: the supervisor's harvested epoch dirs
+        # contain rings but no telemetry jsonl, and a SIGKILLed serving
+        # rank leaves a journal and nothing else — the timeline / SLO
+        # table is exactly what a post-mortem reader comes for
+        if section or slo:
             print(f"no rank*.jsonl telemetry files under {args.targets}; "
-                  "rendering the flight-recorder timeline only")
-            print(section)
+                  "rendering the journal/ring artifacts only")
+            if section:
+                print(section)
+            if slo:
+                print(slo)
             return 0
         print(
-            f"no rank*.jsonl files (nor flight_rank*.ring files) found "
-            f"under {args.targets}",
+            f"no rank*.jsonl files (nor flight_rank*.ring / "
+            f"sched_journal*.jsonl files) found under {args.targets}",
             file=sys.stderr,
         )
         return 1
-    merged = merge_files(paths)
     print(render(merged, top=args.top, timeline=args.timeline))
     if section:
         print(section)
+    if slo:
+        print(slo)
     if args.json:
         # the timeline can be huge; the JSON artifact keeps it whole (the
         # text rendering is the bounded view)

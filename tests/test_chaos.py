@@ -19,6 +19,12 @@ Three scenario families:
   reaching the target step having lost at most ``checkpoint_every``
   steps.
 
+(A fourth family — the ISSUE 10 serving scenario, where a rank is
+SIGKILLed mid-job-queue and the scheduler's journal replay must lose zero
+accepted jobs — lives in tests/test_multiprocess.py
+``test_serve_sigkill_mid_queue_loses_zero_jobs``, chaos-marked so this
+lane runs it too.)
+
 No amount of in-process mocking proves these the way a real SIGKILL does.
 
 Marked ``chaos`` (+ ``slow``/``heavy``): runs in the dedicated chaos CI job,

@@ -108,6 +108,24 @@ class TestFaultModes:
             faults.parse_spec("io.write:explode=1")
         assert faults.parse_spec("") == {}
 
+    def test_scheduler_sites_armable(self):
+        """ISSUE 10 satellite: the serving fault sites (``sched.dispatch``,
+        ``sched.journal.write``) parse from the env grammar — the chaos
+        lane's SIGKILL-mid-queue arming — and fire like any other site.
+        The scheduler-side behavior (retry/deadline-trip/journal-refusal)
+        lives in tests/test_scheduler.py."""
+        specs = faults.parse_spec(
+            "sched.dispatch:exit=4;sched.journal.write:fail=1"
+        )
+        assert specs["sched.dispatch"].exit == 4
+        assert specs["sched.journal.write"].fail == 1
+        with faults.inject("sched.dispatch", fail=1):
+            with pytest.raises(faults.TransientFault):
+                faults.fire("sched.dispatch")
+        with faults.inject("sched.journal.write", hang=0, fail=1):
+            with pytest.raises(faults.TransientFault):
+                faults.fire("sched.journal.write")
+
 
 class TestBackoff:
     def test_schedule_exponential_and_capped(self):

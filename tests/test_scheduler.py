@@ -1,0 +1,977 @@
+"""Elastic multi-tenant job scheduler (ISSUE 10 tentpole).
+
+Four enforcement layers under test:
+
+- **admission control**: the bounded queue sheds with a structured
+  :class:`JobRejected` (``queue_full`` / ``tenant_cap`` /
+  ``deadline_infeasible``) *synchronously* — never a hang — and the
+  ``sched.*`` counters reconcile (every offered job is accepted or shed;
+  every accepted job ends done, failed or pending);
+- **per-job deadlines + retries**: an injected ``sched.dispatch`` hang
+  trips the armed deadline as THAT job's failure while the queue keeps
+  serving; transient faults retry with ``sched.<kind>.retries`` /
+  ``.exhausted`` counters;
+- **crash-durable journal**: submit→dispatch→done/failed record streams
+  replay exactly-once (torn final record tolerated, DONE jobs never
+  re-executed, newer-schema journals fail loud);
+- **graceful degradation**: ``drain()`` fails the remainder in priority
+  order with ``world_unavailable`` and the report names every outcome.
+
+Plus the jax-side serving executors (``parallel.serving``): all four job
+kinds, shape-keyed micro-batching through the PR 1 program cache, and the
+standalone-load contract (``scheduler.py`` must load with jax import
+BLOCKED, like ``supervisor.py`` — the supervising launcher replays
+journals without a backend).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from heat_tpu.parallel import scheduler as S  # noqa: E402
+from heat_tpu.utils import faults, health, profiler  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    S.reset_counters()
+    yield
+    S.reset_counters()
+
+
+def _stub_executor(log=None, results=None, fail=None):
+    """Executor double: records batches, optionally raises."""
+    calls = log if log is not None else []
+
+    def execute(jobs):
+        calls.append([j.job_id for j in jobs])
+        if fail is not None:
+            raise fail
+        if results is not None:
+            return [results(j) for j in jobs]
+        return [{"digest": float(len(j.job_id))} for j in jobs]
+
+    execute.calls = calls
+    return execute
+
+
+# ---------------------------------------------------------------------- #
+# admission control
+# ---------------------------------------------------------------------- #
+class TestAdmission:
+    def test_queue_full_sheds_immediately_not_blocks(self):
+        """Acceptance: a full queue answers with JobRejected{queue_full}
+        NOW — submit never blocks waiting for capacity."""
+        s = S.Scheduler(_stub_executor(), max_queue=3)
+        for i in range(3):
+            s.submit(S.Job(f"j{i}", "matmul"))
+        t0 = time.monotonic()
+        with pytest.raises(S.JobRejected) as ei:
+            s.submit(S.Job("overflow", "matmul"))
+        assert time.monotonic() - t0 < 1.0, "shedding must be synchronous"
+        assert ei.value.reason == S.QUEUE_FULL
+        assert ei.value.job_id == "overflow"
+        assert "queue_full" in str(ei.value)
+        assert S.counters()["sched.shed.queue_full"] == 1
+        # the shed job is still named in the report (every outcome named)
+        assert s.report()["jobs"]["overflow"]["state"] == S.SHED
+
+    def test_tenant_cap_protects_other_tenants(self):
+        s = S.Scheduler(_stub_executor(), max_queue=10, tenant_cap=2)
+        s.submit(S.Job("a1", "matmul", tenant="acme"))
+        s.submit(S.Job("a2", "matmul", tenant="acme"))
+        with pytest.raises(S.JobRejected) as ei:
+            s.submit(S.Job("a3", "matmul", tenant="acme"))
+        assert ei.value.reason == S.TENANT_CAP
+        # a DIFFERENT tenant still gets in: no cross-tenant starvation
+        s.submit(S.Job("b1", "matmul", tenant="globex"))
+        assert s.pending() == 3
+        # capacity frees as the capped tenant's jobs finish
+        s.run()
+        s.submit(S.Job("a4", "matmul", tenant="acme"))
+        assert s.pending() == 1
+
+    def test_deadline_infeasible_rejected_at_admission(self):
+        s = S.Scheduler(
+            _stub_executor(), min_exec_estimate={"kmeans": 1.0}
+        )
+        with pytest.raises(S.JobRejected) as ei:
+            s.submit(S.Job("k", "kmeans", deadline_s=0.5))
+        assert ei.value.reason == S.DEADLINE_INFEASIBLE
+        # at/below zero is infeasible for ANY kind, estimate or not
+        with pytest.raises(S.JobRejected) as ei2:
+            s.submit(S.Job("m", "matmul", deadline_s=0.0))
+        assert ei2.value.reason == S.DEADLINE_INFEASIBLE
+        # a feasible deadline and an unbounded job are both admitted
+        s.submit(S.Job("k2", "kmeans", deadline_s=5.0))
+        s.submit(S.Job("k3", "kmeans"))
+        assert s.pending() == 2
+
+    def test_duplicate_live_id_raises(self):
+        s = S.Scheduler(_stub_executor())
+        s.submit(S.Job("dup", "matmul"))
+        with pytest.raises(ValueError):
+            s.submit(S.Job("dup", "matmul"))
+
+
+# ---------------------------------------------------------------------- #
+# dispatch: priority, micro-batching, results
+# ---------------------------------------------------------------------- #
+class TestDispatch:
+    def test_priority_order_with_fifo_tiebreak(self):
+        log = []
+        s = S.Scheduler(_stub_executor(log), max_batch=1)
+        s.submit(S.Job("low1", "matmul", priority=0))
+        s.submit(S.Job("hi1", "matmul", priority=5))
+        s.submit(S.Job("low2", "matmul", priority=0))
+        s.submit(S.Job("hi2", "matmul", priority=5))
+        s.run()
+        assert log == [["hi1"], ["hi2"], ["low1"], ["low2"]]
+
+    def test_micro_batching_shares_one_dispatch(self):
+        log = []
+        s = S.Scheduler(_stub_executor(log), max_batch=4)
+        for i in range(4):
+            s.submit(S.Job(f"j{i}", "matmul", payload={"n": 16}))
+        s.run()
+        assert log == [["j0", "j1", "j2", "j3"]]
+        c = S.counters()
+        assert c["sched.dispatches"] == 1
+        assert c["sched.batched"] == 3  # 3 jobs rode a shared dispatch
+
+    def test_incompatible_payloads_do_not_batch(self):
+        log = []
+        s = S.Scheduler(_stub_executor(log), max_batch=4)
+        s.submit(S.Job("a", "matmul", payload={"n": 16}))
+        s.submit(S.Job("b", "matmul", payload={"n": 32}))
+        s.submit(S.Job("c", "solve", payload={"n": 16}))
+        s.run()
+        assert len(log) == 3
+
+    def test_non_jsonable_payload_fallback_keys_on_values_too(self):
+        """Review finding: the non-JSON fallback signature must include
+        payload VALUES — a keys-only signature would batch jobs whose
+        payloads differ, handing an executor incompatible work."""
+        blob = object()  # forces the non-JSON fallback
+        a = S.Job("a", "nn_forward", payload={"features": 8, "x": blob})
+        b = S.Job("b", "nn_forward", payload={"features": 16, "x": blob})
+        c = S.Job("c", "nn_forward", payload={"features": 8, "x": blob})
+        assert a.effective_batch_key() != b.effective_batch_key()
+        assert a.effective_batch_key() == c.effective_batch_key()
+
+    def test_custom_batch_key_overrides_grouping(self):
+        log = []
+        key = lambda j: j.kind  # noqa: E731 — data-blind compatibility
+        s = S.Scheduler(_stub_executor(log), max_batch=8, batch_key=key)
+        s.submit(S.Job("a", "matmul", payload={"seed": 1}))
+        s.submit(S.Job("b", "matmul", payload={"seed": 2}))
+        s.run()
+        assert log == [["a", "b"]]
+
+    def test_results_and_outcomes_delivered(self):
+        s = S.Scheduler(_stub_executor(results=lambda j: {"id": j.job_id}))
+        s.submit(S.Job("r1", "matmul", tenant="acme"))
+        s.run()
+        assert s.result("r1") == {"id": "r1"}
+        out = s.outcome("r1")
+        assert out["state"] == S.DONE and out["tenant"] == "acme"
+        assert out["queue_wait_s"] is not None and out["exec_s"] is not None
+
+    def test_non_transient_executor_error_fails_batch_named(self):
+        s = S.Scheduler(_stub_executor(fail=ValueError("boom")))
+        s.submit(S.Job("e1", "matmul"))
+        s.submit(S.Job("e2", "matmul"))
+        s.run()
+        for jid in ("e1", "e2"):
+            o = s.outcome(jid)
+            assert o["state"] == S.FAILED
+            assert o["reason"] == "error:ValueError"
+        # a programming error is NOT retried (only transient faults are)
+        assert "sched.matmul.retries" not in S.counters()
+
+
+# ---------------------------------------------------------------------- #
+# per-job deadlines + retries (fault sites sched.dispatch / journal.write)
+# ---------------------------------------------------------------------- #
+class TestDeadlineAndRetry:
+    def test_transient_faults_retried_with_counters(self):
+        s = S.Scheduler(_stub_executor(), retry_base_delay=0.001)
+        s.submit(S.Job("t1", "matmul", retry_budget=3))
+        base = profiler.counters().get("retry.sched.matmul", 0)
+        with faults.inject("sched.dispatch", fail=2):
+            s.run()
+        assert s.outcome("t1")["state"] == S.DONE
+        assert S.counters()["sched.matmul.retries"] == 2
+        assert "sched.matmul.exhausted" not in S.counters()
+        # faults.call_with_retries' own counters rode along
+        assert profiler.counters()["retry.sched.matmul"] == base + 2
+
+    def test_retry_budget_exhaustion_named_and_counted(self):
+        s = S.Scheduler(_stub_executor(), retry_base_delay=0.001)
+        s.submit(S.Job("x1", "solve", retry_budget=2))
+        with faults.inject("sched.dispatch", fail=-1):
+            s.run()
+        o = s.outcome("x1")
+        assert o["state"] == S.FAILED and o["reason"] == S.RETRIES_EXHAUSTED
+        c = S.counters()
+        assert c["sched.solve.exhausted"] == 1
+        assert c["sched.solve.retries"] == 2  # the budget was really spent
+
+    def test_hang_trips_as_jobs_failure_not_wedged_queue(self):
+        """Acceptance (satellite 1): an injected dispatch HANG under the
+        job's deadline surfaces as THAT job's deadline_expired failure —
+        the queue behind it keeps serving."""
+        log = []
+        s = S.Scheduler(_stub_executor(log), retry_base_delay=0.001)
+        s.submit(S.Job("wedged", "matmul", priority=9, deadline_s=0.5,
+                       retry_budget=1))
+        s.submit(S.Job("healthy", "solve", priority=0))
+        base = profiler.counters().get("health.deadline.trips", 0)
+        t0 = time.monotonic()
+        with faults.inject("sched.dispatch", hang=1):
+            s.run()
+        took = time.monotonic() - t0
+        assert took < 10.0, f"queue wedged for {took:.1f}s"
+        o = s.outcome("wedged")
+        assert o["state"] == S.FAILED and o["reason"] == S.DEADLINE_EXPIRED
+        # the victim's deadline trip is the health counter's business too
+        assert profiler.counters()["health.deadline.trips"] >= base + 1
+        # and the job BEHIND the wedge completed normally
+        assert s.outcome("healthy")["state"] == S.DONE
+        assert ["healthy"] in log
+
+    def test_expired_in_queue_fails_without_dispatch(self):
+        clock = {"t": 100.0}
+        log = []
+        s = S.Scheduler(_stub_executor(log), clock=lambda: clock["t"])
+        s.submit(S.Job("late", "matmul", deadline_s=5.0))
+        clock["t"] += 10.0  # the deadline passed while queued
+        s.run()
+        o = s.outcome("late")
+        assert o["state"] == S.FAILED and o["reason"] == S.DEADLINE_EXPIRED
+        assert log == []  # never dispatched with a blown budget
+
+    def test_expired_job_does_not_drag_live_batchmates(self):
+        clock = {"t": 0.0}
+        log = []
+        s = S.Scheduler(_stub_executor(log), clock=lambda: clock["t"],
+                        max_batch=4)
+        s.submit(S.Job("dead", "matmul", deadline_s=1.0))
+        clock["t"] += 2.0
+        s.submit(S.Job("live", "matmul"))  # same batch key
+        s.run()
+        assert s.outcome("dead")["reason"] == S.DEADLINE_EXPIRED
+        assert s.outcome("live")["state"] == S.DONE
+        assert log == [["live"]]
+
+    def test_world_broken_requeues_batch_instead_of_failing(self, tmp_path):
+        """Review follow-up: a transport death under a dispatch (executor
+        raises WorldBroken — serving converts XLA runtime errors) is NOT a
+        job outcome.  The batch goes back on the queue, the journal keeps
+        it DISPATCHED (so a restarted world's replay requeues it), and the
+        error propagates to the process owner."""
+        path = str(tmp_path / "j.jsonl")
+        s = S.Scheduler(
+            _stub_executor(fail=S.WorldBroken("peer died")), journal=path
+        )
+        s.submit(S.Job("w1", "matmul"))
+        s.submit(S.Job("w2", "matmul"))
+        with pytest.raises(S.WorldBroken):
+            s.run()
+        # nothing terminally failed; both jobs are pending again
+        assert s.pending() == 2
+        assert S.counters().get("sched.failed", 0) == 0
+        assert S.counters()["sched.world_broken"] == 1
+        rep = S.replay_journal(path)
+        assert rep["jobs"]["w1"]["state"] == S.DISPATCHED  # replay requeues
+        # a fresh scheduler (the restarted world) recovers and serves them
+        s2 = S.Scheduler(_stub_executor(), journal=None)
+        assert s2.recover(path) == 2
+        s2.run()
+        assert s2.outcome("w1")["state"] == S.DONE
+        assert s2.outcome("w2")["state"] == S.DONE
+
+    def test_mid_retry_expiry_sheds_alone_batchmates_survive(self):
+        """Review finding: a job whose budget expires BETWEEN retry
+        attempts fails alone — the surviving batch-mate's retry window is
+        its OWN budget, not the expired job's."""
+        log = []
+        s = S.Scheduler(_stub_executor(log), max_batch=4,
+                        retry_base_delay=0.2)
+        s.submit(S.Job("short", "matmul", deadline_s=0.05, retry_budget=2))
+        s.submit(S.Job("long", "matmul", deadline_s=100.0, retry_budget=2))
+        with faults.inject("sched.dispatch", fail=1):
+            s.run()  # first attempt fails; the ~0.2s backoff outlives "short"
+        assert s.outcome("short")["reason"] == S.DEADLINE_EXPIRED
+        assert s.outcome("long")["state"] == S.DONE
+        assert log[-1] == ["long"]  # the retry ran WITHOUT the expired job
+
+    def test_recover_attempts_counted_from_pre_restart_epochs_only(
+        self, tmp_path
+    ):
+        """Review finding: like the deadline anchor, restored attempt
+        counts must ignore the restarted generation's own racing dispatch
+        appends — every rank derives the identical count."""
+        path = str(tmp_path / "j.jsonl")
+        recs = [
+            {"type": "meta", "schema": S.SCHEMA_VERSION, "epoch": 0, "t": 1.0},
+            dict(S.Job("a", "matmul").to_submit_record(), t=1.0, epoch=0),
+            {"type": S.DISPATCHED, "id": "a", "seq": 1, "attempt": 1,
+             "t": 2.0, "epoch": 0},
+            # rank 0's fresh epoch-1 records, racing this rank's replay:
+            {"type": "meta", "schema": S.SCHEMA_VERSION, "epoch": 1, "t": 9.0},
+            {"type": "requeue", "id": "a", "t": 9.1, "epoch": 1},
+            {"type": S.DISPATCHED, "id": "a", "seq": 2, "attempt": 2,
+             "t": 9.2, "epoch": 1},
+        ]
+        with open(path, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        s = S.Scheduler(_stub_executor())
+        assert s.recover(path, epoch=1) == 1
+        assert s._jobs["a"].attempts == 1  # the epoch-1 record didn't count
+
+    def test_done_id_resubmit_after_recover_rejected_not_phantom(
+        self, tmp_path
+    ):
+        """Review finding: after recover(), reusing a DONE job's id must
+        raise ValueError (the in-process duplicate rule) — never slip
+        through and be attested DONE-with-None without executing."""
+        path = str(tmp_path / "j.jsonl")
+        s0 = S.Scheduler(_stub_executor(), journal=path)
+        s0.submit(S.Job("done-id", "matmul", tenant="acme"))
+        s0.run()
+        s1 = S.Scheduler(_stub_executor())
+        s1.recover(path)
+        with pytest.raises(ValueError):
+            s1.submit(S.Job("done-id", "matmul", payload={"new": "work"}))
+        assert s1.outcome("done-id")["state"] == S.DONE  # prior result visible
+
+    def test_replay_shed_record_never_erases_done(self, tmp_path):
+        """Review finding: a SHED record for an id already DONE (torn or
+        foreign sequence) must not flip completed work to shed in the
+        attestation."""
+        path = str(tmp_path / "j.jsonl")
+        j = S.JobJournal(path)
+        j.append(S.Job("a", "matmul").to_submit_record())
+        j.append({"type": S.DISPATCHED, "id": "a", "seq": 1, "attempt": 1})
+        j.append({"type": S.DONE, "id": "a"})
+        j.append({"type": S.SHED, "id": "a", "kind": "matmul",
+                  "tenant": "acme", "reason": S.QUEUE_FULL})
+        rep = S.replay_journal(path)
+        assert rep["jobs"]["a"]["state"] == S.DONE
+        summ = S.jobs_summary(rep)
+        assert summ["done"] == 1 and summ["shed"] == 0
+
+    def test_poison_job_retires_named_instead_of_crash_looping(self, tmp_path):
+        """Review finding: a job whose payload deterministically kills the
+        runtime (classified WorldBroken) must not crash-loop the restart
+        budget away.  Attempts accumulate across generations via replay;
+        past retry_budget + 1 dispatches the next WorldBroken fails the
+        job NAMED (world_broken) before the crash, so the following
+        generation retires it and serves the rest."""
+        path = str(tmp_path / "j.jsonl")
+        poison_raises = {"n": 0}
+
+        def executor(jobs):
+            if any(j.job_id == "poison" for j in jobs):
+                poison_raises["n"] += 1
+                raise S.WorldBroken("deterministic runtime death")
+            return [{"ok": True} for _ in jobs]
+
+        # generation 0: poison (retry_budget=0) + an innocent behind it
+        s0 = S.Scheduler(executor, journal=path, max_batch=1)
+        s0.submit(S.Job("poison", "matmul", priority=5, retry_budget=0))
+        s0.submit(S.Job("bystander", "solve"))
+        with pytest.raises(S.WorldBroken):
+            s0.run()  # attempts=1 <= budget+1: requeued, world dies
+        # generation 1: replay carries attempts=1; dispatch -> attempts=2
+        # > retry_budget+1 -> FAILED world_broken journaled pre-crash
+        s1 = S.Scheduler(executor, journal=S.JobJournal(path, epoch=1),
+                         max_batch=1)
+        assert s1.recover(path, epoch=1) == 2
+        assert s1._jobs["poison"].attempts == 1  # restored from the journal
+        with pytest.raises(S.WorldBroken):
+            s1.run()
+        assert s1.outcome("poison")["reason"] == S.WORLD_BROKEN
+        # generation 2: poison is terminal in the journal — NOT requeued;
+        # the bystander completes and nothing is lost
+        s2 = S.Scheduler(executor, journal=S.JobJournal(path, epoch=2),
+                         max_batch=1)
+        assert s2.recover(path, epoch=2) == 1
+        s2.run()
+        assert s2.outcome("bystander")["state"] == S.DONE
+        summ = S.jobs_summary(S.replay_journal(path))
+        assert summ["lost"] == 0 and summ["failed"] == 1
+        assert poison_raises["n"] == 2  # bounded: it never ran a third time
+
+    def test_wrong_length_result_list_fails_batch_named(self):
+        """Review finding: an executor returning the wrong number of
+        results is a BUG — fail the batch loudly, never attest jobs DONE
+        with someone else's result."""
+        s = S.Scheduler(lambda jobs: [{"only": "one"}], max_batch=4)
+        s.submit(S.Job("a", "matmul"))
+        s.submit(S.Job("b", "matmul"))
+        s.run()
+        for jid in ("a", "b"):
+            o = s.outcome(jid)
+            assert o["state"] == S.FAILED
+            assert o["reason"] == "error:ResultLengthMismatch"
+        # the scalar convenience still works for a single-job batch
+        s2 = S.Scheduler(lambda jobs: {"scalar": True})
+        s2.submit(S.Job("solo", "matmul"))
+        s2.run()
+        assert s2.result("solo") == {"scalar": True}
+
+    def test_journal_write_fault_propagates_loud_no_phantom_job(self, tmp_path):
+        """A scheduler that cannot journal must not silently accept work:
+        the sched.journal.write fault surfaces out of submit() AND the job
+        is truly not accepted — not queued, not counted, never executed
+        (review finding: journaling after the queue mutation left a
+        runnable job the journal knew nothing about)."""
+        path = str(tmp_path / "j.jsonl")
+        log = []
+        s = S.Scheduler(_stub_executor(log), journal=path)
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(faults.TransientFault):
+                s.submit(S.Job("phantom", "matmul"))
+        assert s.pending() == 0
+        assert "phantom" not in s._jobs
+        assert S.counters().get("sched.accepted", 0) == 0
+        s.run()
+        assert log == []  # nothing to execute: the raise meant NOT accepted
+        assert "phantom" not in S.replay_journal(path)["jobs"]
+        # the scheduler heals: the next submit journals and runs normally
+        s.submit(S.Job("real", "matmul"))
+        s.run()
+        assert S.replay_journal(path)["jobs"]["real"]["state"] == S.DONE
+
+    def test_journal_write_fault_during_shed_mutates_nothing(self, tmp_path):
+        s = S.Scheduler(_stub_executor(), journal=str(tmp_path / "j.jsonl"),
+                        max_queue=0)
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(faults.TransientFault):
+                s.submit(S.Job("over", "matmul"))
+        assert "over" not in s._jobs
+        assert S.counters().get("sched.shed", 0) == 0
+
+
+# ---------------------------------------------------------------------- #
+# journal: durability + replay edge cases (satellite 3)
+# ---------------------------------------------------------------------- #
+class TestJournal:
+    def _mk(self, tmp_path, name="sched_journal.jsonl"):
+        return str(tmp_path / name)
+
+    def test_header_and_roundtrip(self, tmp_path):
+        path = self._mk(tmp_path)
+        s = S.Scheduler(_stub_executor(), journal=path)
+        s.submit(S.Job("a", "matmul", tenant="acme", priority=2,
+                       payload={"n": 8}))
+        s.run()
+        first = json.loads(open(path).readline())
+        assert first["type"] == "meta" and first["schema"] == S.SCHEMA_VERSION
+        rep = S.replay_journal(path)
+        v = rep["jobs"]["a"]
+        assert v["state"] == S.DONE and v["tenant"] == "acme"
+        assert v["attempts"] == 1 and v["payload"] == {"n": 8}
+        assert rep["torn"] == 0
+
+    def test_torn_final_record_tolerated(self, tmp_path):
+        path = self._mk(tmp_path)
+        s = S.Scheduler(_stub_executor(), journal=path)
+        s.submit(S.Job("a", "matmul"))
+        s.submit(S.Job("b", "matmul"))
+        s.run()
+        with open(path, "a") as fh:  # SIGKILL mid-append: half a record
+            fh.write('{"type": "done", "id": "b", "t"')
+        rep = S.replay_journal(path)
+        assert rep["torn"] == 1
+        assert rep["jobs"]["a"]["state"] == S.DONE  # salvage, don't sink
+        assert rep["jobs"]["b"]["state"] == S.DONE
+
+    def test_newer_schema_fails_loud_never_misparses(self, tmp_path):
+        path = self._mk(tmp_path)
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"type": "meta", "schema": S.SCHEMA_VERSION + 1}) + "\n")
+            fh.write(json.dumps({"type": "submitted", "id": "a"}) + "\n")
+        with pytest.raises(S.JournalSchemaError) as ei:
+            S.replay_journal(path)
+        assert str(S.SCHEMA_VERSION + 1) in str(ei.value)
+        assert str(S.SCHEMA_VERSION) in str(ei.value)
+
+    def test_headerless_journal_fails_loud(self, tmp_path):
+        path = self._mk(tmp_path)
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"type": "submitted", "id": "a"}) + "\n")
+        with pytest.raises(S.JournalSchemaError):
+            S.replay_journal(path)
+
+    def test_crash_replay_requeues_in_flight_exactly_once(self, tmp_path):
+        """DISPATCHED-but-not-DONE requeues ONCE however many dispatch
+        records piled up; queued-never-dispatched requeues too; DONE does
+        not."""
+        path = self._mk(tmp_path)
+        j = S.JobJournal(path)
+        for jid in ("a", "b", "c"):
+            j.append(S.Job(jid, "matmul").to_submit_record())
+        j.append({"type": S.DISPATCHED, "id": "a", "seq": 1, "attempt": 1})
+        j.append({"type": S.DISPATCHED, "id": "a", "seq": 1, "attempt": 2})
+        j.append({"type": S.DONE, "id": "a"})
+        j.append({"type": S.DISPATCHED, "id": "b", "seq": 2, "attempt": 1})
+        j.append({"type": S.DISPATCHED, "id": "b", "seq": 2, "attempt": 2})
+        # crash: b in flight (2 attempts), c still queued, a done
+        log = []
+        s = S.Scheduler(_stub_executor(log))
+        n = s.recover(path)
+        assert n == 2
+        queued = sorted(x.job_id for x in s._queue)
+        assert queued == ["b", "c"]  # each exactly once, a absent
+        s.run()
+        assert sorted(sum(log, [])) == ["b", "c"]  # a never re-executed
+
+    def test_double_crash_no_duplicate_execution_of_done(self, tmp_path):
+        """Crash → recover (j2 done in gen 1) → crash again → recover: the
+        second replay must not re-run j2."""
+        path = self._mk(tmp_path)
+        j0 = S.JobJournal(path, epoch=0)
+        j0.append(S.Job("j1", "matmul").to_submit_record())
+        j0.append(S.Job("j2", "solve").to_submit_record())
+        j0.append({"type": S.DISPATCHED, "id": "j2", "seq": 1, "attempt": 1})
+        # generation 1: recovers, finishes j2, dispatches j1, crashes
+        log1 = []
+        s1 = S.Scheduler(_stub_executor(log1), journal=S.JobJournal(path, epoch=1))
+        assert s1.recover(path) == 2
+        s1.run()
+        assert sorted(sum(log1, [])) == ["j1", "j2"]
+        # fake gen 1 dying before it could close j1 out: strip j1's
+        # terminal record from the journal (j2's DONE stays)
+        lines = [
+            l for l in open(path).read().splitlines()
+            if not (
+                json.loads(l).get("id") == "j1"
+                and json.loads(l)["type"] in (S.DONE, S.FAILED)
+            )
+        ]
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        # generation 2: j1 requeues (in flight at the crash), j2 must NOT
+        log2 = []
+        s2 = S.Scheduler(_stub_executor(log2), journal=S.JobJournal(path, epoch=2))
+        assert s2.recover(path) == 1
+        s2.run()
+        assert sum(log2, []) == ["j1"]
+        final = S.replay_journal(path)
+        assert final["jobs"]["j1"]["state"] == S.DONE
+        assert final["jobs"]["j2"]["state"] == S.DONE
+        summ = S.jobs_summary(final)
+        assert summ["lost"] == 0 and summ["requeued"] == 3  # 2 in gen1 + 1 in gen2
+
+    def test_recovery_charges_journal_visible_deadline_time(self, tmp_path):
+        """Review finding: recovery must not grant a crashed job a fresh
+        wall budget per generation.  The charge is journal-derived (latest
+        PRE-restart record t − submit t), so every rank computes the same
+        remainder — and the restarted generation's own records (the fresh
+        epoch header, racing requeue appends) never move the anchor; an
+        already-expired job requeues and fails deadline_expired at
+        dispatch — named, not lost, and never executed."""
+        path = self._mk(tmp_path)
+        recs = [
+            {"type": "meta", "schema": S.SCHEMA_VERSION, "epoch": 0,
+             "t": 1000.0},
+            dict(S.Job("tight", "matmul", deadline_s=5.0).to_submit_record(),
+                 t=1000.0, epoch=0),
+            dict(S.Job("roomy", "matmul", deadline_s=500.0).to_submit_record(),
+                 t=1000.0, epoch=0),
+            {"type": S.DISPATCHED, "id": "tight", "seq": 1, "attempt": 1,
+             "t": 1008.0, "epoch": 0},  # 8 s of journal-visible life
+            # the restarted generation's header + a racing requeue append,
+            # stamped much later: must NOT feed the anchor
+            {"type": "meta", "schema": S.SCHEMA_VERSION, "epoch": 1,
+             "t": 5000.0},
+            {"type": "requeue", "id": "tight", "t": 5001.0, "epoch": 1},
+        ]
+        with open(path, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        log = []
+        s = S.Scheduler(_stub_executor(log))
+        assert s.recover(path, epoch=1) == 2
+        by_id = {x.job_id: x for x in s._queue}
+        assert by_id["tight"].deadline_s == pytest.approx(-3.0)  # 5 − 8
+        assert by_id["roomy"].deadline_s == pytest.approx(492.0)  # 500 − 8
+        s.run()
+        o = s.outcome("tight")
+        assert o["state"] == S.FAILED and o["reason"] == S.DEADLINE_EXPIRED
+        assert s.outcome("roomy")["state"] == S.DONE
+        assert sum(log, []) == ["roomy"]  # the expired job never executed
+
+    def test_no_restart_context_charges_nothing(self, tmp_path):
+        """recover() at epoch 0 (no supervised restart) leaves deadlines
+        untouched — there is no pre-restart generation to charge for."""
+        path = self._mk(tmp_path)
+        j = S.JobJournal(path, epoch=0)
+        j.append(S.Job("a", "matmul", deadline_s=5.0).to_submit_record())
+        s = S.Scheduler(_stub_executor())
+        assert s.recover(path, epoch=0) == 1
+        assert s._queue[0].deadline_s == 5.0
+
+    def test_resubmit_after_shed_survives_crash_replay(self, tmp_path):
+        """Review finding: a shed id that was later RE-submitted (which
+        submit() explicitly permits) must replay as the accepted job, not
+        the stale shed — or recovery silently drops an accepted job while
+        the attestation still says lost=0."""
+        path = self._mk(tmp_path)
+        j = S.JobJournal(path)
+        j.append({"type": S.SHED, "id": "x", "kind": "matmul",
+                  "tenant": "acme", "reason": S.QUEUE_FULL})
+        j.append(S.Job("x", "matmul", tenant="acme").to_submit_record())
+        j.append({"type": S.DISPATCHED, "id": "x", "seq": 1, "attempt": 1})
+        # crash here: x was accepted and in flight
+        rep = S.replay_journal(path)
+        assert rep["jobs"]["x"]["state"] == S.DISPATCHED
+        log = []
+        s = S.Scheduler(_stub_executor(log))
+        assert s.recover(path) == 1
+        s.run()
+        assert s.outcome("x")["state"] == S.DONE
+        assert S.jobs_summary(S.replay_journal(path))["lost"] == 1  # pre-recovery file
+        # runtime end-to-end: shed, resubmit, complete — counted once each
+        S.reset_counters()
+        s2 = S.Scheduler(_stub_executor(), max_queue=0)
+        with pytest.raises(S.JobRejected):
+            s2.submit(S.Job("y", "matmul"))
+        s2.max_queue = 4
+        s2.submit(S.Job("y", "matmul"))
+        s2.run()
+        assert s2.outcome("y")["state"] == S.DONE
+
+    def test_recovered_jobs_keep_priority_order(self, tmp_path):
+        path = self._mk(tmp_path)
+        j = S.JobJournal(path)
+        j.append(S.Job("lo", "matmul", priority=0).to_submit_record())
+        j.append(S.Job("hi", "matmul", priority=9).to_submit_record())
+        log = []
+        s = S.Scheduler(_stub_executor(log), max_batch=1)
+        s.recover(path)
+        s.run()
+        assert log == [["hi"], ["lo"]]
+
+    def test_shed_is_journaled_and_summarized(self, tmp_path):
+        path = self._mk(tmp_path)
+        s = S.Scheduler(_stub_executor(), max_queue=1, journal=path)
+        s.submit(S.Job("in", "matmul", tenant="acme"))
+        with pytest.raises(S.JobRejected):
+            s.submit(S.Job("out", "matmul", tenant="globex"))
+        s.run()
+        summ = S.jobs_summary(S.replay_journal(path))
+        assert summ == {
+            "jobs": 2, "accepted": 1, "done": 1, "failed": 0, "shed": 1,
+            "retried": 0, "requeued": 0, "lost": 0, "torn": 0,
+            "generations": {"0": {
+                "accepted": 1, "dispatched": 1, "completed": 1,
+                "failed": 0, "shed": 1, "requeued": 0,
+            }},
+        }
+        line = S.attestation_line(summ)
+        assert line == "SCHED jobs=2 done=1 requeued=0 shed=1 failed=0 lost=0"
+
+    def test_generations_attributed_by_epoch(self, tmp_path):
+        path = self._mk(tmp_path)
+        j0 = S.JobJournal(path, epoch=0)
+        j0.append(S.Job("a", "matmul").to_submit_record())
+        j1 = S.JobJournal(path, epoch=1)  # the restarted world re-opens
+        j1.append({"type": S.DISPATCHED, "id": "a", "seq": 1, "attempt": 1})
+        j1.append({"type": S.DONE, "id": "a"})
+        summ = S.jobs_summary(S.replay_journal(path))
+        assert summ["generations"]["0"]["accepted"] == 1
+        assert summ["generations"]["1"]["completed"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# graceful degradation + accounting
+# ---------------------------------------------------------------------- #
+class TestDrainAndReport:
+    def test_drain_fails_remainder_world_unavailable_priority_order(
+        self, tmp_path
+    ):
+        path = str(tmp_path / "j.jsonl")
+        s = S.Scheduler(_stub_executor(), journal=path)
+        s.submit(S.Job("lo", "matmul", priority=0, tenant="acme"))
+        s.submit(S.Job("hi", "matmul", priority=9, tenant="globex"))
+        s.submit(S.Job("mid", "matmul", priority=5, tenant="acme"))
+        n = s.drain()
+        assert n == 3 and s.pending() == 0
+        rep = s.report()
+        for jid in ("lo", "hi", "mid"):
+            assert rep["jobs"][jid]["state"] == S.FAILED
+            assert rep["jobs"][jid]["reason"] == S.WORLD_UNAVAILABLE
+        # priority order is visible in the journal's failure sequence
+        order = [json.loads(l)["id"] for l in open(path)
+                 if json.loads(l).get("type") == S.FAILED]
+        assert order == ["hi", "mid", "lo"]
+
+    def test_counters_reconcile_accepted_done_failed_shed(self):
+        """Acceptance: sched.* counters reconcile — offered = accepted +
+        shed, accepted = done + failed once the queue is empty."""
+        s = S.Scheduler(_stub_executor(), max_queue=3, retry_base_delay=0.001)
+        s.submit(S.Job("d1", "matmul"))
+        s.submit(S.Job("d2", "matmul"))
+        s.submit(S.Job("f1", "solve", retry_budget=0))
+        with pytest.raises(S.JobRejected):
+            s.submit(S.Job("s1", "matmul"))
+        with faults.inject("sched.dispatch", fail=1):
+            s.run()  # solve dispatches first? order: FIFO same priority
+        rep = s.report()
+        c = rep["counters"]
+        assert c["sched.accepted"] == 3 and c["sched.shed"] == 1
+        assert c.get("sched.done", 0) + c.get("sched.failed", 0) == 3
+        assert rep["pending"] == 0
+        assert rep["reconciled"] is True
+        assert json.loads(json.dumps(rep)) == rep  # report is JSON-able
+
+    def test_report_names_every_job(self):
+        s = S.Scheduler(_stub_executor(), max_queue=2)
+        s.submit(S.Job("a", "matmul"))
+        with pytest.raises(S.JobRejected):
+            s.submit(S.Job("b", "matmul", tenant="t",
+                           deadline_s=-1.0))
+        s.run()
+        rep = s.report()
+        assert set(rep["jobs"]) == {"a", "b"}
+        assert rep["by_state"] == {S.DONE: 1, S.SHED: 1}
+
+
+# ---------------------------------------------------------------------- #
+# telemetry spans (the SLO table's source)
+# ---------------------------------------------------------------------- #
+class TestTelemetrySpans:
+    def test_sched_job_events_carry_tenant_and_wait(self, tmp_path):
+        from heat_tpu.utils import telemetry
+
+        telemetry.enable()
+        try:
+            telemetry.reset()
+
+            def execute(jobs):  # solve requests fail, the rest complete
+                if jobs[0].kind == "solve":
+                    raise ValueError("no solver today")
+                return [{"ok": True} for _ in jobs]
+
+            s = S.Scheduler(execute, max_queue=4)
+            s.submit(S.Job("ok", "matmul", tenant="acme"))
+            s.submit(S.Job("bad", "solve", tenant="globex", retry_budget=0))
+            s.run()
+            path = telemetry.flush(str(tmp_path))
+            recs = [json.loads(l) for l in open(path)]
+            spans = [r for r in recs
+                     if r.get("type") == "span" and r["name"] == "sched.job"]
+            assert len(spans) == 2
+            by_id = {sp["attrs"]["id"]: sp for sp in spans}
+            assert by_id["ok"]["attrs"]["tenant"] == "acme"
+            assert by_id["ok"]["attrs"]["outcome"] == S.DONE
+            assert by_id["ok"]["attrs"]["queue_wait_s"] >= 0.0
+            assert by_id["ok"]["attrs"]["attempts"] == 1
+            # a FAILED job's event names its reason as the outcome — the
+            # SLO table's failed column comes from here on spans-only dirs
+            assert by_id["bad"]["attrs"]["outcome"] == "error:ValueError"
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+# ---------------------------------------------------------------------- #
+# jax-side serving executors (micro-batching through the program cache)
+# ---------------------------------------------------------------------- #
+class TestServingExecutors:
+    @pytest.fixture()
+    def served(self, ht):
+        from heat_tpu.parallel import serving
+
+        return S.Scheduler(
+            serving.make_executor(), batch_key=serving.batch_key,
+            max_queue=32,
+        )
+
+    def test_all_four_kinds_complete(self, served):
+        jobs = [
+            S.Job("m", "matmul", payload={"n": 16, "seed": 1}),
+            S.Job("s", "solve", payload={"n": 8}),
+            S.Job("k", "kmeans", payload={"n": 32, "k": 2}),
+            S.Job("f", "nn_forward", payload={"batch": 4, "features": 8}),
+        ]
+        for j in jobs:
+            served.submit(j)
+        rep = served.run()
+        assert rep["by_state"] == {S.DONE: 4}
+        for j in jobs:
+            assert isinstance(served.result(j.job_id)["digest"], float)
+
+    def test_same_shape_jobs_share_programs(self, served, ht):
+        """The PR 1 cache contract: the SECOND identical-shape matmul
+        request compiles nothing."""
+        served.submit(S.Job("warm", "matmul", payload={"n": 16, "seed": 3}))
+        served.run()
+        before = profiler.cache_stats()["misses"]
+        served.submit(S.Job("hit", "matmul", payload={"n": 16, "seed": 4}))
+        served.run()
+        assert profiler.cache_stats()["misses"] == before
+        assert served.result("hit")["digest"] != served.result("warm")["digest"]
+
+    def test_nn_forward_batches_stack_into_one_dispatch(self, served):
+        for i in range(3):
+            served.submit(S.Job(
+                f"f{i}", "nn_forward",
+                payload={"batch": 4, "features": 8, "seed": i},
+            ))
+        served.run()
+        c = S.counters()
+        assert c["sched.dispatches"] == 1 and c["sched.batched"] == 2
+        digests = {served.result(f"f{i}")["digest"] for i in range(3)}
+        assert len(digests) == 3  # per-job results, one shared forward
+
+    def test_batch_key_ignores_data_fields(self, ht):
+        from heat_tpu.parallel import serving
+
+        a = S.Job("a", "matmul", payload={"n": 16, "seed": 1})
+        b = S.Job("b", "matmul", payload={"n": 16, "seed": 2})
+        c = S.Job("c", "matmul", payload={"n": 32, "seed": 1})
+        assert serving.batch_key(a) == serving.batch_key(b)
+        assert serving.batch_key(a) != serving.batch_key(c)
+
+    def test_unknown_kind_fails_named(self, served):
+        served.submit(S.Job("u", "fft_of_doom"))
+        served.run()
+        o = served.outcome("u")
+        assert o["state"] == S.FAILED and o["reason"] == "error:ValueError"
+
+    def test_dispatch_runs_under_comm_deadline(self, served, ht):
+        """The armed scope IS the comm.deadline contextvar: a job with a
+        deadline sees an active health deadline during execution."""
+        seen = {}
+        orig = served.executor
+
+        def spying(jobs):
+            seen["deadline"] = health.active_deadline()
+            return orig(jobs)
+
+        served.executor = spying
+        served.submit(S.Job("d", "matmul", payload={"n": 16},
+                            deadline_s=120.0))
+        served.run()
+        assert seen["deadline"] is not None
+        assert served.outcome("d")["state"] == S.DONE
+
+
+# ---------------------------------------------------------------------- #
+# standalone-load contract (quick-lane import test, satellite 5)
+# ---------------------------------------------------------------------- #
+class TestStandaloneLoad:
+    def test_scheduler_loads_and_serves_with_jax_blocked(self, tmp_path):
+        """scheduler.py must load via spec_from_file_location and run a
+        full submit→dispatch→journal→replay cycle with jax AND numpy
+        imports blocked — the supervising launcher's requirement (it
+        replays journals in a process that never pays the backend
+        import), and the same contract supervisor.py keeps."""
+        code = (
+            "import importlib.util, json, sys;"
+            "sys.modules['jax'] = None; sys.modules['numpy'] = None;"
+            "spec = importlib.util.spec_from_file_location('s', sys.argv[1]);"
+            "m = importlib.util.module_from_spec(spec);"
+            "sys.modules['s'] = m; spec.loader.exec_module(m);"
+            "sch = m.Scheduler(lambda jobs: [{'ok': j.job_id} for j in jobs],"
+            " journal=sys.argv[2], max_queue=4);"
+            "sch.submit(m.Job('a', 'matmul', tenant='t'));"
+            "rep = sch.run();"
+            "assert rep['by_state'] == {'done': 1}, rep;"
+            "summ = m.jobs_summary(m.replay_journal(sys.argv[2]));"
+            "assert summ['done'] == 1 and summ['lost'] == 0, summ;"
+            "assert sys.modules.get('jax') is None and "
+            "sys.modules.get('numpy') is None;"
+            "print(m.attestation_line(summ))"
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", code,
+             os.path.join(REPO, "heat_tpu", "parallel", "scheduler.py"),
+             str(tmp_path / "j.jsonl")],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        assert p.stdout.strip() == (
+            "SCHED jobs=1 done=1 requeued=0 shed=0 failed=0 lost=0"
+        )
+
+    def test_package_exports(self, ht):
+        import heat_tpu
+
+        assert heat_tpu.parallel.Scheduler is S.Scheduler
+        assert heat_tpu.parallel.Job is S.Job
+        assert heat_tpu.parallel.JobRejected is S.JobRejected
+        assert callable(heat_tpu.parallel.make_executor)
+
+
+# ---------------------------------------------------------------------- #
+# supervisor integration: the jobs report section
+# ---------------------------------------------------------------------- #
+class TestSupervisorJobsSection:
+    def _sup(self):
+        spec = importlib.util.spec_from_file_location(
+            "sup_for_sched", os.path.join(REPO, "heat_tpu", "parallel",
+                                          "supervisor.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_report_gains_jobs_section_from_journal(self, tmp_path):
+        path = str(tmp_path / "sched_journal.jsonl")
+        sch = S.Scheduler(_stub_executor(), journal=path, max_queue=1)
+        sch.submit(S.Job("a", "matmul", tenant="acme"))
+        with pytest.raises(S.JobRejected):
+            sch.submit(S.Job("b", "matmul"))
+        sch.run()
+        sup = self._sup()
+
+        def spawn(rank, epoch, port):
+            return subprocess.Popen([sys.executable, "-c", "pass"])
+
+        res = sup.Supervisor(spawn, 1, poll_interval=0.05,
+                             job_journal=path).run()
+        assert res.ok and res.jobs is not None
+        assert res.jobs["done"] == 1 and res.jobs["shed"] == 1
+        assert res.jobs["lost"] == 0
+        rep = res.report()
+        assert rep["jobs"]["generations"]["0"]["completed"] == 1
+        assert json.loads(json.dumps(rep)) == rep
+
+    def test_no_journal_no_section(self):
+        sup = self._sup()
+
+        def spawn(rank, epoch, port):
+            return subprocess.Popen([sys.executable, "-c", "pass"])
+
+        res = sup.Supervisor(spawn, 1, poll_interval=0.05).run()
+        assert res.ok and res.jobs is None
+        assert "jobs" not in res.report()
+
+    def test_corrupt_journal_degrades_not_crashes(self, tmp_path):
+        path = str(tmp_path / "sched_journal.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"type": "meta", "schema": 99}) + "\n")
+        sup = self._sup()
+
+        def spawn(rank, epoch, port):
+            return subprocess.Popen([sys.executable, "-c", "pass"])
+
+        res = sup.Supervisor(spawn, 1, poll_interval=0.05,
+                             job_journal=path).run()
+        assert res.ok
+        assert "error" in res.jobs and "replay failed" in res.jobs["error"]

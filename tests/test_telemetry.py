@@ -463,3 +463,146 @@ class TestProfilerSatellites:
         c = profiler.counters()
         assert f"{key}.alive" not in c
         assert key not in profiler._providers  # pruned, not just skipped
+
+
+class TestServingSLOReport:
+    """ISSUE 10 satellite: scripts/telemetry_report.py renders the
+    per-tenant SLO table (p50/p99 queue wait + execution latency) from
+    whichever serving artifacts exist — sched.job spans, the scheduler
+    journal, or both.  A journal-only dir (all a SIGKILLed serving rank
+    leaves behind) is a legitimate target: exit 0, full table."""
+
+    def _trep(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report_slo",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "scripts", "telemetry_report.py"),
+        )
+        trep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(trep)
+        return trep
+
+    def _write_journal(self, d):
+        recs = [
+            {"type": "meta", "schema": 1, "epoch": 0, "t": 100.0},
+            {"type": "submitted", "id": "a", "kind": "matmul",
+             "tenant": "acme", "priority": 0, "t": 100.0},
+            {"type": "dispatched", "id": "a", "seq": 1, "attempt": 1,
+             "t": 100.25, "epoch": 0},
+            {"type": "done", "id": "a", "exec_s": 0.5, "t": 100.75,
+             "epoch": 0},
+            {"type": "submitted", "id": "b", "kind": "solve",
+             "tenant": "acme", "priority": 0, "t": 100.0},
+            {"type": "dispatched", "id": "b", "seq": 2, "attempt": 1,
+             "t": 101.0, "epoch": 0},
+            {"type": "failed", "id": "b", "reason": "deadline_expired",
+             "t": 101.5, "epoch": 0},
+            {"type": "shed", "id": "c", "kind": "matmul",
+             "tenant": "globex", "reason": "queue_full", "t": 100.1,
+             "epoch": 0},
+        ]
+        path = os.path.join(d, "sched_journal.jsonl")
+        with open(path, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        return path
+
+    def test_journal_only_dir_renders_slo_exit_zero(self, tmp_path, capsys):
+        trep = self._trep()
+        d = str(tmp_path)
+        self._write_journal(d)
+        assert trep.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "per-tenant serving SLO" in out
+        # acme: 2 jobs (1 done, 1 failed); globex: 1 shed
+        acme = [l for l in out.splitlines() if l.startswith("acme")][0]
+        assert acme.split()[1:5] == ["2", "1", "1", "0"]
+        globex = [l for l in out.splitlines() if l.startswith("globex")][0]
+        assert globex.split()[1:5] == ["1", "0", "0", "1"]
+        # journal-timestamp latencies: acme queue wait p50 = 250 ms
+        assert "250.0" in acme
+
+    def test_spans_only_dir_renders_slo(self, tmp_path, capsys):
+        trep = self._trep()
+        d = str(tmp_path)
+        spans = [
+            {"type": "span", "rank": 0, "name": "sched.job", "ts": 100.0,
+             "dur_s": 0.2, "self_s": 0.2, "depth": 0,
+             "attrs": {"id": "a", "tenant": "acme", "kind": "matmul",
+                       "outcome": "done", "queue_wait_s": 0.05,
+                       "attempts": 1}},
+            {"type": "span", "rank": 0, "name": "sched.job", "ts": 101.0,
+             "dur_s": 0.4, "self_s": 0.4, "depth": 0,
+             "attrs": {"id": "b", "tenant": "acme", "kind": "matmul",
+                       "outcome": "retries_exhausted", "queue_wait_s": 0.15,
+                       "attempts": 3}},
+        ]
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            for r in spans:
+                fh.write(json.dumps(r) + "\n")
+        assert trep.main([d, "--timeline", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "per-tenant serving SLO" in out
+        acme = [l for l in out.splitlines() if l.startswith("acme")][0]
+        # 2 jobs, 1 done, 1 failed — outcome counts from the span attrs
+        assert acme.split()[1:5] == ["2", "1", "1", "0"]
+        # exec p50 from span durations: 200 ms
+        assert "200.0" in acme
+
+    def test_spans_and_journal_merge(self, tmp_path, capsys):
+        """Both present: outcome counts come from the journal (it alone
+        knows shed jobs), latencies from the spans."""
+        trep = self._trep()
+        d = str(tmp_path)
+        self._write_journal(d)
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            fh.write(json.dumps(
+                {"type": "span", "rank": 0, "name": "sched.job", "ts": 100.0,
+                 "dur_s": 0.125, "self_s": 0.125, "depth": 0,
+                 "attrs": {"id": "a", "tenant": "acme", "kind": "matmul",
+                           "outcome": "done", "queue_wait_s": 0.0625,
+                           "attempts": 1}}) + "\n")
+        assert trep.main([d, "--timeline", "0"]) == 0
+        out = capsys.readouterr().out
+        acme = [l for l in out.splitlines() if l.startswith("acme")][0]
+        assert acme.split()[1:5] == ["2", "1", "1", "0"]  # journal counts
+        assert "125.0" in acme and "62.5" in acme  # span latencies
+
+    def test_spans_deduped_across_ranks_by_job_id(self, tmp_path, capsys):
+        """Review finding: every rank of an SPMD serve world emits an
+        identical sched.job span per job — a 2-rank dir must count each
+        job ONCE, not once per rank."""
+        trep = self._trep()
+        d = str(tmp_path)
+        span = {"type": "span", "name": "sched.job", "ts": 100.0,
+                "dur_s": 0.2, "self_s": 0.2, "depth": 0,
+                "attrs": {"id": "a", "tenant": "acme", "kind": "matmul",
+                          "outcome": "done", "queue_wait_s": 0.05,
+                          "attempts": 1}}
+        for rank in (0, 1):
+            with open(os.path.join(d, f"rank{rank}.jsonl"), "w") as fh:
+                fh.write(json.dumps(dict(span, rank=rank)) + "\n")
+        assert trep.main([d, "--timeline", "0"]) == 0
+        out = capsys.readouterr().out
+        acme = [l for l in out.splitlines() if l.startswith("acme")][0]
+        assert acme.split()[1:5] == ["1", "1", "0", "0"]  # one job, not two
+
+    def test_no_serving_artifacts_is_silent(self, tmp_path):
+        trep = self._trep()
+        d = str(tmp_path)
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            fh.write(json.dumps({"type": "span", "rank": 0,
+                                 "name": "dispatch.local", "ts": 1.0,
+                                 "dur_s": 0.1, "self_s": 0.1,
+                                 "depth": 0}) + "\n")
+        assert trep.slo_section([d]) == ""
+
+    def test_corrupt_journal_degrades_to_note(self, tmp_path):
+        trep = self._trep()
+        d = str(tmp_path)
+        with open(os.path.join(d, "sched_journal.jsonl"), "w") as fh:
+            fh.write(json.dumps({"type": "meta", "schema": 99}) + "\n")
+        section = trep.slo_section([d])
+        assert "unreadable" in section  # named, not crashed
