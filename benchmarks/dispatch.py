@@ -181,6 +181,15 @@ def main(argv=None) -> int:
                          "floor (the ISSUE 7 crash-durable-ring overhead "
                          "contract; same pairwise methodology as the "
                          "telemetry gate)")
+    ap.add_argument("--monitor-gate", type=float, default=None, metavar="PCT",
+                    help="exit 7 if dispatch under an ARMED + actively "
+                         "scraped /metrics monitor costs more than PCT%% "
+                         "above the unscraped dispatch cost over the "
+                         "compiled floor (the ISSUE 11 live-endpoint "
+                         "contract; same pairwise methodology as the "
+                         "telemetry gate — the monitor adds NO hot-path "
+                         "hook, so this measures pure scrape-thread "
+                         "interference)")
     ap.add_argument("--resplit-gate", action="store_true",
                     help="run the budgeted-resplit peak-RSS gate: exit 5 when "
                          "the chunked pipeline's peak RSS exceeds "
@@ -364,6 +373,73 @@ def main(argv=None) -> int:
     d_on = sorted(a - b for a, b in zip(s_fr_on, s_fr_off))
     fr_consistent = d_on[len(d_on) // 4] > 0.0
     fr_added_pct = fr_added_us / fr_off_oh * 100.0
+
+    # --- monitor-armed dispatch overhead (ISSUE 11 contract) ----------- #
+    # the /metrics endpoint adds NO hot-path hook (there is nothing to
+    # poke: scrapes snapshot the registries from a server thread), so the
+    # only possible cost is scrape-thread GIL/cache interference with the
+    # dispatching main thread.  Measured per round, quiet vs actively
+    # scraped at 10 Hz — an order of magnitude HOTTER than any sane
+    # production cadence (Prometheus defaults to 15 s), but not a busy
+    # loop: a busy-loop scraper measures GIL starvation of the scraper's
+    # own making, not the endpoint's dispatch-path cost (measured: 5 ms
+    # cadence reads ~60% on a throttled host, 100 ms reads ~0).  Each
+    # state pairs against the compiled floor IN THE SAME STATE, and —
+    # like the flightrec gate — a failure requires the paired deltas to
+    # shift WHOLESALE (q25 > 0): a real regression taxes every round,
+    # while a scrape landing inside a few timed windows cannot.
+    mon_added_pct = mon_added_us = mon_off_oh = float("nan")
+    mon_consistent = False
+    if args.monitor_gate is not None:
+        import threading as _threading
+        import urllib.request as _url
+
+        from heat_tpu.utils import monitor as _monitor
+
+        mhost, mport = _monitor.enable()
+        murl = f"http://{mhost}:{mport}/metrics"
+        scraping = _threading.Event()
+        stop_scraper = _threading.Event()
+
+        def _scrape_loop():
+            while not stop_scraper.wait(0.1):
+                if scraping.is_set():
+                    try:
+                        with _url.urlopen(murl, timeout=5) as resp:
+                            resp.read()
+                    except Exception:
+                        pass
+
+        scr_thread = _threading.Thread(target=_scrape_loop, daemon=True)
+        scr_thread.start()
+        s_floor_q, s_mon_q, s_floor_s, s_mon_s = [], [], [], []
+        for _ in range(args.reps):
+            for active, fl, ca in (
+                (False, s_floor_q, s_mon_q),
+                (True, s_floor_s, s_mon_s),
+            ):
+                scraping.set() if active else scraping.clear()
+                for fn, out_samples in (
+                    (lambda: floor_prog(j1, j2), fl),
+                    (lambda: x + y, ca),
+                ):
+                    t0 = time.perf_counter()
+                    out = None
+                    for _ in range(20):
+                        out = fn()
+                    sync(out)
+                    out_samples.append((time.perf_counter() - t0) / 20 * 1e6)
+        scraping.clear()
+        stop_scraper.set()
+        scr_thread.join(timeout=2.0)
+        _monitor.disable()
+        mon_off_oh = max(_paired_delta(s_mon_q, s_floor_q), 1.0)
+        oh_scraped = [c - f for c, f in zip(s_mon_s, s_floor_s)]
+        oh_quiet = [c - f for c, f in zip(s_mon_q, s_floor_q)]
+        d_mon = sorted(a - b for a, b in zip(oh_scraped, oh_quiet))
+        mon_added_us = max(d_mon[len(d_mon) // 2], 0.0)
+        mon_consistent = d_mon[len(d_mon) // 4] > 0.0
+        mon_added_pct = mon_added_us / mon_off_oh * 100.0
 
     # --- zero-recompilation across >=100 repeated same-signature ops --- #
     for _ in range(2):  # warm every signature used below
@@ -557,6 +633,14 @@ def main(argv=None) -> int:
             "flightrec_on_added_us_snapshot": round(fr_added_us, 2),
             "flightrec_on_added_dispatch_pct": round(fr_added_pct, 1),
             "flightrec_noise_floor_us_snapshot": round(fr_noise_us, 2),
+            # NaN-guarded (x == x): a run without --monitor-gate must not
+            # write the invalid-strict-JSON `NaN` token into the payload
+            "monitor_quiet_above_floor_us_snapshot": round(mon_off_oh, 2)
+            if mon_off_oh == mon_off_oh else None,
+            "monitor_scraped_added_us_snapshot": round(mon_added_us, 2)
+            if mon_added_us == mon_added_us else None,
+            "monitor_scraped_added_dispatch_pct": round(mon_added_pct, 1)
+            if mon_added_pct == mon_added_pct else None,
             "provenance": "benchmarks/dispatch.py on the host mesh "
                           "(seed row = the pre-cache dispatch path, forced "
                           "via _FORCE_SLOW and measured in-run, interleaved)",
@@ -592,6 +676,20 @@ def main(argv=None) -> int:
             f"off-vs-off noise floor {fr_noise_us:.2f} us)",
             file=sys.stderr,
         )
+    monitor_gate_ok = True
+    if (
+        args.monitor_gate is not None
+        and mon_added_pct > args.monitor_gate
+        and mon_consistent
+    ):
+        monitor_gate_ok = False
+        print(
+            f"MONITOR GATE: an actively scraped /metrics endpoint adds "
+            f"{mon_added_pct:.1f}% ({mon_added_us:.2f} us) to the dispatch "
+            f"cost above floor ({mon_off_oh:.1f} us; limit "
+            f"{args.monitor_gate:.1f}%, wholesale shift confirmed)",
+            file=sys.stderr,
+        )
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(payload, fh, indent=1)
@@ -609,6 +707,8 @@ def main(argv=None) -> int:
         return 5
     if not flightrec_gate_ok:
         return 6
+    if not monitor_gate_ok:
+        return 7
     return 0
 
 

@@ -12,6 +12,7 @@ contracts" for the full table):
 - HT106 — no DNDarray metadata mutation outside sanctioned modules
 - HT107 — no naked blocking collective waits bypassing comm.deadline
 - HT108 — no collective staging bypassing the seq-stamp choke point
+- HT109 — no manual trace-identity fiddling outside the tracing helpers
 
 The HT1xx analyses are intentionally *lexical and intra-procedural*: false
 negatives across call boundaries are accepted; false positives are kept
@@ -747,6 +748,102 @@ class SeqStampBypassRule(Rule):
                         "invisible to the flight recorder's seq stream and the "
                         "comm.<name> byte accounting; use Communication.resplit",
                         detail="device_put",
+                    )
+                    if f is not None:
+                        out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT109 — trace identity owned by one choke point
+# -------------------------------------------------------------------- #
+
+
+@register
+class TraceIdentityRule(Rule):
+    """Trace identity — the ``trace_id``/``span_id``/``parent_id`` triple
+    that joins one job's records across ranks, processes and restarts —
+    is owned by TWO choke points: ``utils/telemetry.py`` (the
+    ``tracing()`` contextvar + span machinery) and
+    ``parallel/scheduler.py`` (minting at job submission,
+    ``job_trace_id``).  Library code manually fiddling trace identity —
+    writing ``trace_id`` keys into span attrs or records, or setting the
+    trace contextvar directly — forks the causal chain: its records carry
+    an id no other layer (flight recorder, journal, SLO tables) agrees
+    on, which is precisely the cross-artifact join the plane exists to
+    guarantee.  The sanctioned idiom is ``with telemetry.tracing(...)``
+    (adopt or mint) — the same one-choke-point discipline HT104/HT108
+    enforce for byte accounting and seq-stamps.
+
+    Flagged shapes in library code:
+
+    - a subscript store of a trace-identity key
+      (``attrs["trace_id"] = ...``, ``rec["parent_id"] = ...``);
+    - a trace-identity keyword smuggled into the recording calls
+      (``span(..., trace_id=...)``, ``record_event(..., trace_id=...)``)
+      — these write it as a plain attr, bypassing the contextvar;
+    - a direct ``.set(...)`` on the trace contextvar (``_TRACE.set``).
+
+    Reading (``attrs.get("trace_id")``, ``current_trace_id()``) is free —
+    the contract is about who MINTS and PROPAGATES, not who looks."""
+
+    code = "HT109"
+    name = "manual-trace-identity"
+    description = "trace identity minted/written outside the tracing choke points"
+
+    SANCTIONED_MODULES = (
+        "utils/telemetry.py",   # the contextvar + span machinery itself
+        "parallel/scheduler.py",  # mints per-job ids at submission
+    )
+    TRACE_KEYS = {"trace_id", "span_id", "parent_id"}
+    RECORDING_CALLS = {"span", "record_event", "record_dispatch", "traced"}
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        out = []
+        for node in ctx.walk(ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and tgt.slice.value in self.TRACE_KEYS
+                ):
+                    f = ctx.finding(
+                        self, node,
+                        f"manual write of {tgt.slice.value!r} — trace identity "
+                        "must flow through telemetry.tracing() (one choke "
+                        "point owns it, like HT104/HT108 own accounting and "
+                        "seq-stamps); records written around it fork the "
+                        "causal chain",
+                        detail=str(tgt.slice.value),
+                    )
+                    if f is not None:
+                        out.append(f)
+        for node in ctx.walk(ast.Call):
+            la = last_attr(node)
+            if la in self.RECORDING_CALLS:
+                for kw in node.keywords:
+                    if kw.arg in self.TRACE_KEYS:
+                        f = ctx.finding(
+                            self, node,
+                            f"`{la}({kw.arg}=...)` smuggles trace identity in "
+                            "as a plain attribute, bypassing the tracing "
+                            "contextvar — open the block with "
+                            "`telemetry.tracing(trace_id=...)` instead",
+                            detail=f"{la}:{kw.arg}",
+                        )
+                        if f is not None:
+                            out.append(f)
+            elif la == "set":
+                dn = call_name(node)
+                if dn and "_TRACE" in dn.split("."):
+                    f = ctx.finding(
+                        self, node,
+                        "direct .set() on the trace contextvar bypasses "
+                        "telemetry.tracing()'s reset discipline — a leaked "
+                        "token leaves every later record mis-attributed",
+                        detail=dn,
                     )
                     if f is not None:
                         out.append(f)

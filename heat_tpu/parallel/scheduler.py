@@ -63,10 +63,13 @@ absent otherwise.
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import json
 import os
 import sys
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -83,6 +86,8 @@ __all__ = [
     "SCHEMA_VERSION",
     "counters",
     "reset_counters",
+    "job_trace_id",
+    "trace_continuity",
 ]
 
 SCHEMA_VERSION = 1
@@ -164,6 +169,48 @@ def _fire(site: str, path: Optional[str] = None) -> None:
         flt.fire(site, path=path)
 
 
+def _flightrec():
+    """``utils.flightrec`` iff loaded AND armed; None standalone."""
+    fr = sys.modules.get("heat_tpu.utils.flightrec")
+    if fr is None or not getattr(fr, "enabled", lambda: False)():
+        return None
+    return fr
+
+
+# ---------------------------------------------------------------------- #
+# trace identity — minted HERE, at job submission: the one choke point
+# that owns a job's trace id (heatlint HT109's contract).  Everything
+# downstream — dispatch spans, collective seq-stamps, retry attempts,
+# journal records across generations — carries it, so postmortem and the
+# SLO tables can reconstruct one job's full causal path.
+# ---------------------------------------------------------------------- #
+def job_trace_id(job_id: str, kind: str = "", tenant: str = "") -> str:
+    """Deterministic 16-hex trace id for a job: derived from the job's
+    IDENTITY, not from process entropy — every rank of an SPMD world (and
+    every restarted generation replaying the journal) derives the
+    IDENTICAL id, which is what makes it a cross-rank, cross-generation
+    join key.  The journal carries it verbatim anyway; this derivation
+    only matters for the first mint."""
+    return hashlib.sha1(f"job|{job_id}|{kind}|{tenant}".encode()).hexdigest()[:16]
+
+
+def _tracing(trace_id: Optional[str]):
+    """``telemetry.tracing(trace_id)`` when the runtime is loaded (spans,
+    dispatch records and flight-recorder collective stamps inside the
+    block then carry the job's id); a null context standalone.  Via
+    ``sys.modules`` — this file must never import the package.  Note the
+    telemetry module need not be ENABLED: trace identity is a contextvar,
+    and the crash-durable flight ring stamps it independently of the span
+    ring."""
+    tel = sys.modules.get("heat_tpu.utils.telemetry")
+    if tel is None or trace_id is None:
+        return contextlib.nullcontext()
+    try:
+        return tel.tracing(trace_id=trace_id)
+    except Exception:
+        return contextlib.nullcontext()
+
+
 # ---------------------------------------------------------------------- #
 # job model
 # ---------------------------------------------------------------------- #
@@ -224,6 +271,10 @@ class Job:
     retry_budget: int = 2
     payload: dict = field(default_factory=dict)
     batch_key: Optional[str] = None
+    # causal join key: minted at submit (deterministically from the job
+    # identity — see job_trace_id) unless the client supplied one;
+    # journaled with every record, preserved by replay across restarts
+    trace_id: Optional[str] = None
 
     # runtime state (owned by the scheduler)
     state: str = SUBMITTED
@@ -266,6 +317,7 @@ class Job:
             "deadline_s": self.deadline_s,
             "retry_budget": self.retry_budget,
             "payload": self.payload,
+            "tid": self.trace_id,
         }
 
     @classmethod
@@ -278,6 +330,7 @@ class Job:
             deadline_s=rec.get("deadline_s"),
             retry_budget=int(rec.get("retry_budget", 0)),
             payload=dict(rec.get("payload") or {}),
+            trace_id=rec.get("tid"),
         )
 
 
@@ -506,6 +559,39 @@ def attestation_line(summary: dict) -> str:
     )
 
 
+def trace_continuity(replay: dict) -> dict:
+    """Trace-id continuity audit over a :func:`replay_journal` view: every
+    journaled record of one job — submit, dispatch attempts, requeues
+    across however many generations, the terminal record — must carry the
+    SAME trace id (replay preserves it; a requeue that re-minted would
+    sever the causal chain exactly where it matters most, across the
+    crash).  Returns ``{"jobs": n_with_tids, "ok": bool, "violations":
+    [job ids whose records disagree]}`` — the launcher prints this as the
+    ``SCHED-TRACE-CONTINUITY`` attestation and the chaos lane asserts it
+    across a SIGKILL restart.  A record that DROPS the tid on a job whose
+    other records carry one is a violation too — the likeliest severed
+    chain is a write path that forgot the field, not one that re-minted
+    (a wholly tid-less journal — pre-trace schema — is simply untraced:
+    ``jobs`` = 0, ok)."""
+    tids: Dict[str, set] = {}
+    missing: Dict[str, int] = {}
+    for rec in replay.get("records", []):
+        rid = rec.get("id")
+        if rid is None:
+            continue
+        rid = str(rid)
+        tid = rec.get("tid")
+        if tid:
+            tids.setdefault(rid, set()).add(str(tid))
+        else:
+            missing[rid] = missing.get(rid, 0) + 1
+    violations = sorted(
+        rid for rid, ts in tids.items()
+        if len(ts) > 1 or missing.get(rid, 0)
+    )
+    return {"jobs": len(tids), "ok": not violations, "violations": violations}
+
+
 # ---------------------------------------------------------------------- #
 # scheduler
 # ---------------------------------------------------------------------- #
@@ -575,6 +661,32 @@ class Scheduler:
         self._order = 0
         self._dispatch_seq = 0
         self._done_ids: set = set()  # executed-to-DONE in THIS process or replay
+        self._register_monitor_gauges()
+
+    def _register_monitor_gauges(self) -> None:
+        """Expose live queue state to ``utils.monitor`` (iff loaded — via
+        ``sys.modules``, this file must stay standalone-loadable): queue
+        depth and per-tenant in-flight counts as scrape-time gauges.  The
+        reference is weak, so a discarded scheduler is pruned at the next
+        scrape instead of being pinned alive by the monitor."""
+        mon = sys.modules.get("heat_tpu.utils.monitor")
+        if mon is None:
+            return
+        ref = weakref.ref(self)
+
+        def gauges():
+            s = ref()
+            if s is None:
+                return None  # owner collected: monitor prunes this source
+            out = {"sched.queue_depth": len(s._queue)}
+            for tenant, n in sorted(s._tenant_inflight.items()):
+                out[f"sched.inflight.{tenant}"] = int(n)
+            return out
+
+        try:
+            mon.register_gauge_source("sched_live", gauges)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ #
     # admission
@@ -586,11 +698,16 @@ class Scheduler:
         if self.journal is not None:
             self.journal.append({
                 "type": SHED, "id": job.job_id, "kind": job.kind,
-                "tenant": job.tenant, "reason": reason,
+                "tenant": job.tenant, "reason": reason, "tid": job.trace_id,
             })
         job.state = SHED
         job.reason = reason
         self._jobs[job.job_id] = job
+        # offered counts at the SAME point as its outcome (after the journal
+        # append): a sched.journal.write failure leaves offered, accepted
+        # and shed all untouched, so the /metrics reconciliation
+        # offered = accepted + shed survives journal faults
+        counter_inc("sched.offered")
         counter_inc("sched.shed")
         counter_inc(f"sched.shed.{reason}")
         return JobRejected(reason, job.job_id, job.tenant, detail)
@@ -604,6 +721,11 @@ class Scheduler:
         reject it now, while the client can still retry elsewhere)."""
         if job.job_id in self._jobs and self._jobs[job.job_id].state not in (SHED,):
             raise ValueError(f"duplicate job id {job.job_id!r}")
+        # trace identity is minted HERE (or adopted from the client), before
+        # any admission outcome: a shed job's rejection record carries the
+        # same id the client can correlate on
+        if job.trace_id is None:
+            job.trace_id = job_trace_id(job.job_id, job.kind, job.tenant)
         now = self.clock()
         if len(self._queue) >= self.max_queue:
             raise self._shed(
@@ -639,6 +761,7 @@ class Scheduler:
         self._jobs[job.job_id] = job
         self._queue.append(job)
         self._tenant_inflight[job.tenant] = self._tenant_inflight.get(job.tenant, 0) + 1
+        counter_inc("sched.offered")  # paired with accepted — see _shed
         counter_inc("sched.accepted")
         return job.job_id
 
@@ -733,8 +856,11 @@ class Scheduler:
             self._order += 1
             job._order = self._order
             if self.journal is not None:
-                # journal first — same no-phantom-state ordering as submit
-                self.journal.append({"type": "requeue", "id": job.job_id})
+                # journal first — same no-phantom-state ordering as submit;
+                # the tid restored from the submit record rides along, so
+                # the requeue is journal-visibly the SAME causal chain
+                self.journal.append({"type": "requeue", "id": job.job_id,
+                                     "tid": job.trace_id})
             self._jobs[job.job_id] = job
             self._queue.append(job)
             self._tenant_inflight[job.tenant] = (
@@ -798,12 +924,21 @@ class Scheduler:
                     "type": DONE, "id": job.job_id,
                     "exec_s": round(job.finish_t - job.dispatch_t, 6)
                     if job.dispatch_t else None,
+                    "tid": job.trace_id,
                 })
         else:
             counter_inc("sched.failed")
             counter_inc(f"sched.failed.{reason}" if reason else "sched.failed.error")
             if self.journal is not None:
-                self.journal.append({"type": FAILED, "id": job.job_id, "reason": reason})
+                self.journal.append({"type": FAILED, "id": job.job_id,
+                                     "reason": reason, "tid": job.trace_id})
+        fr = _flightrec()
+        if fr is not None:
+            # the crash-durable side of the causal path: the terminal state
+            # lands in THIS rank's ring next to the collective stamps that
+            # share the job's tid
+            fr.record_event("job", id=job.job_id, state=state,
+                            tid=job.trace_id)
         tel = _telemetry()
         if tel is not None:
             exec_s = (job.finish_t - job.dispatch_t) if job.dispatch_t else 0.0
@@ -819,6 +954,7 @@ class Scheduler:
                     "outcome": state if state == DONE else (reason or state),
                     "queue_wait_s": round(max(wait_s, 0.0), 9),
                     "attempts": job.attempts,
+                    "trace_id": job.trace_id,
                 },
             )
 
@@ -863,6 +999,7 @@ class Scheduler:
             return
         self._dispatch_seq += 1
         seq = self._dispatch_seq
+        fr = _flightrec()
         for job in live:
             job.attempts += 1
             job.dispatch_t = self.clock()
@@ -870,8 +1007,14 @@ class Scheduler:
             if self.journal is not None:
                 self.journal.append({
                     "type": DISPATCHED, "id": job.job_id,
-                    "seq": seq, "attempt": job.attempts,
+                    "seq": seq, "attempt": job.attempts, "tid": job.trace_id,
                 })
+            if fr is not None:
+                # dispatch marker in the crash-durable ring: a SIGKILL
+                # mid-dispatch leaves the job's tid as evidence even when
+                # the cached program staged no fresh collectives
+                fr.record_event("job", id=job.job_id, state=DISPATCHED,
+                                tid=job.trace_id, attempt=job.attempts)
         if len(live) > 1:
             counter_inc("sched.batched", len(live) - 1)
         counter_inc("sched.dispatches")
@@ -901,6 +1044,7 @@ class Scheduler:
                         self.journal.append({
                             "type": DISPATCHED, "id": job.job_id,
                             "seq": seq, "attempt": job.attempts,
+                            "tid": job.trace_id,
                         })
             return self._attempt(live)
 
@@ -913,10 +1057,17 @@ class Scheduler:
             else (max(budgets) if budgets else None)
         )
         try:
-            results = self._call_with_retries(
-                one_attempt, site=f"sched.{kind}", retries=retries,
-                deadline=total_budget,
-            )
+            # the whole dispatch — executor, retries, blocking waits — runs
+            # under the batch head's trace context: every span, dispatch
+            # record and flight-recorder collective stamp inside carries
+            # its trace id (contextvars flow into call_with_retries and the
+            # guard_blocking worker thread); batch-mates' own ids ride
+            # their journal records and sched.job events
+            with _tracing(live[0].trace_id):
+                results = self._call_with_retries(
+                    one_attempt, site=f"sched.{kind}", retries=retries,
+                    deadline=total_budget,
+                )
         except _DeadlineExpired:
             for job in live:
                 self._finish(job, FAILED, DEADLINE_EXPIRED)

@@ -184,6 +184,7 @@ class Supervisor:
         flightrec_dir: Optional[str] = None,
         telemetry_dir: Optional[str] = None,
         job_journal: Optional[str] = None,
+        monitor_port: Optional[int] = None,
     ):
         self.spawn = spawn
         self.n_ranks = int(n_ranks)
@@ -211,6 +212,37 @@ class Supervisor:
             "watchdog.kills": 0,
             "health.restarts": 0,
         }
+        # live observability plane (ISSUE 11): when monitor_port is given
+        # (0 = OS-assigned), the SUPERVISOR hosts the /metrics + /healthz
+        # endpoint over the whole world's heartbeat dir — the one process
+        # guaranteed to outlive any generation, serving the worst-rank
+        # staleness verdict + supervision counters without importing jax
+        # (utils/monitor.py is stdlib-only and loaded standalone).
+        self.monitor = None
+        if monitor_port is not None:
+            mon = self._load_tool("heat_monitor", self._MONITOR_PATH)
+            if mon is not None:
+                try:
+                    self.monitor = mon.Monitor(
+                        port=int(monitor_port),
+                        heartbeat_dir=self.heartbeat_dir,
+                        stale_after=self.heartbeat_timeout,
+                    )
+                except OSError:
+                    self.monitor = None  # a busy port must not kill supervision
+                else:
+                    # weakly held, registered only once the server actually
+                    # bound: a dead Supervisor is pruned at the next scrape
+                    # instead of pinned alive by the module-global registry
+                    import weakref
+
+                    ref = weakref.ref(self)
+
+                    def _sup_counters():
+                        s = ref()
+                        return dict(s.counters) if s is not None else None
+
+                    mon.register_gauge_source("supervisor", _sup_counters)
 
     # ------------------------------------------------------------------ #
     def _heartbeat_path(self, rank: int) -> str:
@@ -292,6 +324,9 @@ class Supervisor:
     )
     _SCHEDULER_PATH = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "scheduler.py"
+    )
+    _MONITOR_PATH = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "utils", "monitor.py"
     )
     _tool_mods: Dict[str, object] = {}
 

@@ -3,5 +3,6 @@
 from . import data
 from . import faults
 from . import health
+from . import monitor
 from . import profiler
 from . import telemetry

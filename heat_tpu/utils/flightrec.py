@@ -252,7 +252,8 @@ class FlightRecorder:
                 # very stream the post-mortem diagnoses from
                 small = {
                     f: rec[f]
-                    for f in ("seq", "op", "name", "wire", "dtype", "src", "dst")
+                    for f in ("seq", "op", "name", "wire", "dtype", "src",
+                              "dst", "tid")
                     if f in rec
                 }
                 rec = {"e": ev, "t": t, "k": kind, **small, "trunc": 1}
@@ -287,6 +288,7 @@ class FlightRecorder:
             except Exception:
                 pass
         dl = _deadline_remaining()
+        tid = _trace_id()
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -301,6 +303,13 @@ class FlightRecorder:
             fields["dst"] = dst_split
         if dl is not None:
             fields["dl"] = round(dl, 3)
+        if tid is not None:
+            # the causal join key: this staged collective belongs to the
+            # ambient trace (a scheduler job's dispatch, a traced train
+            # step) — deliberately NOT part of the post-mortem fingerprint
+            # (postmortem._FP_FIELDS), so trace identity can never convict
+            # a rank of desync
+            fields["tid"] = tid
         self.record("coll", **fields)
         return seq
 
@@ -361,6 +370,21 @@ def _deadline_remaining() -> Optional[float]:
     try:
         dl = hlth.active_deadline()
         return dl.remaining() if dl is not None else None
+    except Exception:
+        return None
+
+
+def _trace_id() -> Optional[str]:
+    """The ambient trace id (``telemetry.tracing``) — via ``sys.modules``
+    for the same standalone-load reason.  Works with telemetry DISABLED:
+    trace identity is a contextvar, not span-ring state, so the
+    crash-durable ring carries a job's causal path even when nothing
+    exports spans."""
+    tel = sys.modules.get("heat_tpu.utils.telemetry")
+    if tel is None:
+        return None
+    try:
+        return tel.current_trace_id()
     except Exception:
         return None
 

@@ -320,17 +320,22 @@ def _observe_wait(what: str, seconds: float) -> None:
     """Record an observed blocking-wait duration into the per-collective
     histogram ``<what>.wait`` (e.g. ``comm.Wait.wait``,
     ``comm.host_fetch.wait``, ``comm.resplit.tile.wait``) — the straggler
-    evidence ``scripts/postmortem.py`` reads from the telemetry export.
-    Gated on telemetry being ARMED: disarmed, the observation could never
-    reach an export anyway, and doing per-call histogram work between
-    back-to-back collectives is exactly the hot-path cost the telemetry-off
-    contract forbids (measured: it can perturb rapid small-collective
-    streams on slow hosts)."""
+    evidence ``scripts/postmortem.py`` reads from the telemetry export —
+    AND as a ``<what>.wait`` leaf record in the span ring, which is what
+    positions the wait INSIDE its enclosing step span: the step-time
+    breakdown (``scripts/stepprof.py``) attributes per-step comm-wait from
+    these leaf records, the cumulative histogram alone cannot say which
+    step paid.  Gated on telemetry being ARMED: disarmed, the observation
+    could never reach an export anyway, and doing per-call histogram work
+    between back-to-back collectives is exactly the hot-path cost the
+    telemetry-off contract forbids (measured: it can perturb rapid
+    small-collective streams on slow hosts)."""
     tel = _wait_observer()
     if tel is None:
         return
     try:
         tel.observe(f"{what}.wait", seconds)
+        tel.record_event(f"{what}.wait", seconds)
     except Exception:
         pass
 

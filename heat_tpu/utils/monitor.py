@@ -1,0 +1,426 @@
+"""Live observability endpoint: a scrapeable ``/metrics`` + ``/healthz``.
+
+Everything observable so far is post-hoc: telemetry exports on flush,
+flight rings on death, journals on replay.  Nothing answers "what is this
+world doing RIGHT NOW?" — the serving direction needs live queue/SLO/
+health visibility, and a pod operator needs one URL to point Prometheus
+at.  This module is that surface: an **opt-in**, rank-0/supervisor-hosted
+HTTP server (stdlib ``http.server``, daemon thread) exposing
+
+- ``GET /metrics`` — Prometheus text format (v0.0.4).  One snapshot per
+  scrape of the registries that already exist: the ``utils.profiler``
+  counter store (``comm.*`` byte accounting, ``cache.*`` hit/miss,
+  ``sched.*`` admission/outcome counters, ``health.*``, ``retry.*`` —
+  dots become underscores, so the serving reconciliation reads
+  ``sched_offered = sched_accepted + sched_shed`` straight off the
+  scrape), the telemetry histograms (as ``<name>_seconds`` summaries with
+  p50/p90/p99/p99.9 quantile samples), the telemetry ring-eviction count,
+  registered **gauge sources** (the scheduler registers queue depth and
+  per-tenant in-flight), and — when a heartbeat directory is configured —
+  per-rank beacon age and flight-recorder ``seq`` lag.
+
+- ``GET /healthz`` — the worst-rank staleness verdict as JSON: 200 when
+  every expected rank's beacon is fresher than ``stale_after`` seconds,
+  503 naming the worst rank otherwise (the supervisor's staleness rule,
+  readable by a load balancer).
+
+**Hot-path contract.**  Arming starts ONE daemon thread that blocks in
+``accept()``; nothing is added to any dispatch/staging path — there is no
+hook to poke, so the off-cost AND the armed-idle cost are both zero
+Python on the hot path.  A scrape reads the registries at that moment
+(the same reporting-boundary semantics as ``telemetry.report()``: counter
+providers may sync device-resident counters, so point scrapers at a
+sane interval, not a busy loop).  The bench lane's ``--monitor-gate``
+measures a concurrently-scraped dispatch loop against the unarmed one
+and holds the same ≤5% contract as the telemetry gate.
+
+**Security posture.**  Binds ``127.0.0.1`` by default — the endpoint
+exposes operational metadata (op names, tenant names, queue depths) and
+has no auth, so exposure beyond the host is an explicit operator decision
+(``addr=`` / ``HEAT_TPU_MONITOR_ADDR``), expected to sit behind the
+cluster's scrape fabric.  Port 0 (the default) asks the OS for an
+ephemeral port; :func:`address` returns what was bound.
+
+Stdlib-only and standalone-loadable on purpose: the supervisor process
+(which never imports jax) can host the endpoint for a whole world from
+the heartbeat directory alone.  All runtime registries are reached via
+``sys.modules`` — whatever is loaded is served, whatever is not is
+silently absent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "enable",
+    "disable",
+    "enabled",
+    "address",
+    "register_gauge_source",
+    "unregister_gauge_source",
+    "metrics_text",
+    "healthz",
+    "Monitor",
+]
+
+_METRIC_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+# scrape-time gauge callbacks: name -> fn() -> {metric: value} | None
+# (None = owner gone, source is pruned — the profiler provider contract)
+_gauge_sources: Dict[str, Callable[[], Optional[Dict[str, float]]]] = {}
+
+_MONITOR: Optional["Monitor"] = None
+_T0 = time.time()
+
+
+def metric_name(name: str) -> str:
+    """Sanitize a dotted counter name into a legal Prometheus metric name
+    (``comm.resplit.bytes`` → ``comm_resplit_bytes``)."""
+    name = _METRIC_BAD.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def register_gauge_source(
+    name: str, fn: Callable[[], Optional[Dict[str, float]]]
+) -> str:
+    """Register a scrape-time gauge callback.  ``fn()`` returns a dict of
+    dotted-name → value, or None when its owner is gone (the source is
+    then pruned at that scrape).  Re-registering a name replaces it — a
+    restarted scheduler's fresh gauges win over its predecessor's."""
+    _gauge_sources[str(name)] = fn
+    return str(name)
+
+
+def unregister_gauge_source(name: str) -> None:
+    _gauge_sources.pop(str(name), None)
+
+
+# ---------------------------------------------------------------------- #
+# snapshot assembly (pure functions — unit-testable without a socket)
+# ---------------------------------------------------------------------- #
+def _runtime_counters() -> Dict[str, float]:
+    """Everything the loaded runtime counts, via ``sys.modules`` only.
+    ``utils.profiler`` (when loaded) already merges the health/sched/
+    cache providers; the module-local stores are read directly as well so
+    a supervisor-side monitor (profiler never loaded — it imports jax)
+    still serves health/sched counters."""
+    out: Dict[str, float] = {}
+    for modname, reader in (
+        ("heat_tpu.utils.health", "counters"),
+        ("heat_tpu.parallel.scheduler", "counters"),
+        ("heat_tpu.utils.faults", "counters"),
+        ("heat_tpu.utils.profiler", "counters"),  # last: the merged superset
+    ):
+        mod = sys.modules.get(modname)
+        if mod is None:
+            continue
+        try:
+            vals = getattr(mod, reader)()
+        except Exception:
+            continue
+        for k, v in (vals or {}).items():
+            try:
+                out[str(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
+    tel = sys.modules.get("heat_tpu.utils.telemetry")
+    if tel is not None:
+        try:
+            dropped = tel.ring_dropped()
+            if dropped:
+                out["telemetry.ring.dropped"] = float(dropped)
+        except Exception:
+            pass
+    return out
+
+
+def _histogram_lines() -> List[str]:
+    """The telemetry histograms as ``<name>_seconds`` summary families."""
+    tel = sys.modules.get("heat_tpu.utils.telemetry")
+    if tel is None:
+        return []
+    try:
+        hists = dict(tel._histograms)
+    except Exception:
+        return []
+    lines: List[str] = []
+    for name, h in sorted(hists.items()):
+        try:
+            s = h.summary()
+        except Exception:
+            continue
+        if not s.get("count"):
+            continue
+        base = metric_name(name) + "_seconds"
+        lines.append(f"# TYPE {base} summary")
+        for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                       ("0.99", "p99_s"), ("0.999", "p999_s")):
+            lines.append(f'{base}{{quantile="{q}"}} {s.get(key, 0.0)}')
+        lines.append(f"{base}_count {s['count']}")
+        lines.append(f"{base}_sum {s.get('total_s', 0.0)}")
+    return lines
+
+
+def _heartbeat_view(
+    heartbeat_dir: Optional[str], stale_after: float
+) -> Tuple[List[dict], Optional[dict]]:
+    """Per-rank beacon view + the worst (stalest) rank, from file mtimes
+    and payloads — the supervisor's exact staleness rule, read-only."""
+    if not heartbeat_dir or not os.path.isdir(heartbeat_dir):
+        return [], None
+    rows: List[dict] = []
+    now = time.time()
+    for fname in sorted(os.listdir(heartbeat_dir)):
+        if not (fname.startswith("rank") and fname.endswith(".json")):
+            continue
+        try:
+            rank = int(fname[len("rank"):-len(".json")])
+        except ValueError:
+            continue
+        path = os.path.join(heartbeat_dir, fname)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue
+        row = {"rank": rank, "age_s": round(age, 3), "stale": age > stale_after}
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+            if isinstance(payload, dict):
+                for k in ("step", "seq", "status", "restart_epoch"):
+                    if payload.get(k) is not None:
+                        row[k] = payload[k]
+        except (OSError, ValueError):
+            pass  # a torn beacon still has an mtime — age is the verdict
+        rows.append(row)
+    worst = max(rows, key=lambda r: r["age_s"]) if rows else None
+    return rows, worst
+
+
+def metrics_text(
+    heartbeat_dir: Optional[str] = None, stale_after: float = 120.0
+) -> str:
+    """The full ``/metrics`` payload (Prometheus text format v0.0.4).
+    Pure snapshot — callable without a server for tests and one-shot
+    dumps."""
+    lines: List[str] = []
+    for name, value in sorted(_runtime_counters().items()):
+        mname = metric_name(name)
+        lines.append(f"# TYPE {mname} counter")
+        lines.append(f"{mname} {int(value) if float(value).is_integer() else value}")
+    # gauge sources (scheduler queue depth / per-tenant in-flight, ...)
+    for src in list(_gauge_sources):
+        fn = _gauge_sources.get(src)
+        if fn is None:
+            continue
+        try:
+            vals = fn()
+        except Exception:
+            continue
+        if vals is None:  # owner collected
+            _gauge_sources.pop(src, None)
+            continue
+        for name, value in sorted(vals.items()):
+            mname = metric_name(name)
+            lines.append(f"# TYPE {mname} gauge")
+            lines.append(f"{mname} {value}")
+    lines.extend(_histogram_lines())
+    # heartbeat staleness + flight-recorder seq lag per rank
+    rows, _worst = _heartbeat_view(heartbeat_dir, stale_after)
+    if rows:
+        lines.append("# TYPE heartbeat_age_seconds gauge")
+        for r in rows:
+            lines.append(
+                f'heartbeat_age_seconds{{rank="{r["rank"]}"}} {r["age_s"]}'
+            )
+        seqs = {r["rank"]: r["seq"] for r in rows if isinstance(r.get("seq"), int)}
+        if seqs:
+            top = max(seqs.values())
+            lines.append("# TYPE heartbeat_seq_lag gauge")
+            for rank, seq in sorted(seqs.items()):
+                lines.append(f'heartbeat_seq_lag{{rank="{rank}"}} {top - seq}')
+    lines.append("# TYPE restart_epoch gauge")
+    try:
+        epoch = int(os.environ.get("HEAT_TPU_RESTART_EPOCH", "0") or 0)
+    except ValueError:
+        epoch = 0
+    lines.append(f"restart_epoch {epoch}")
+    lines.append("# TYPE monitor_uptime_seconds gauge")
+    lines.append(f"monitor_uptime_seconds {round(time.time() - _T0, 3)}")
+    return "\n".join(lines) + "\n"
+
+
+def healthz(
+    heartbeat_dir: Optional[str] = None, stale_after: float = 120.0
+) -> Tuple[bool, dict]:
+    """The ``/healthz`` verdict: ``(ok, body)``.  With a heartbeat dir,
+    ok ⇔ every rank's beacon is fresher than ``stale_after`` (the body
+    names the worst rank either way); without one, ok attests only this
+    process's liveness."""
+    rows, worst = _heartbeat_view(heartbeat_dir, stale_after)
+    body: dict = {"pid": os.getpid(), "uptime_s": round(time.time() - _T0, 3)}
+    if not rows:
+        body["ok"] = True
+        body["detail"] = "no heartbeat dir configured; process is up"
+        return True, body
+    stale = [r for r in rows if r["stale"]]
+    ok = not stale
+    body["ok"] = ok
+    body["ranks"] = rows
+    body["worst_rank"] = {k: worst[k] for k in ("rank", "age_s", "stale")
+                          if k in worst}
+    body["stale_after_s"] = stale_after
+    body["detail"] = (
+        f"all {len(rows)} rank(s) fresh (worst: rank {worst['rank']} at "
+        f"{worst['age_s']}s)"
+        if ok
+        else f"rank(s) {[r['rank'] for r in stale]} stale "
+             f"(> {stale_after}s); worst: rank {worst['rank']} at "
+             f"{worst['age_s']}s"
+    )
+    return ok, body
+
+
+# ---------------------------------------------------------------------- #
+# the server
+# ---------------------------------------------------------------------- #
+class Monitor:
+    """One endpoint instance: a ``ThreadingHTTPServer`` on a daemon
+    thread.  Construct via :func:`enable` in normal use."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        addr: str = "127.0.0.1",
+        heartbeat_dir: Optional[str] = None,
+        stale_after: float = 120.0,
+    ):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.heartbeat_dir = heartbeat_dir
+        self.stale_after = float(stale_after)
+        self.scrapes = 0
+        mon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no stderr spam per scrape
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        mon.scrapes += 1
+                        text = metrics_text(mon.heartbeat_dir, mon.stale_after)
+                        text += f"# TYPE monitor_scrapes_total counter\nmonitor_scrapes_total {mon.scrapes}\n"
+                        self._send(
+                            200, text.encode(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        ok, body = healthz(mon.heartbeat_dir, mon.stale_after)
+                        self._send(
+                            200 if ok else 503,
+                            (json.dumps(body, indent=1) + "\n").encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b"try /metrics or /healthz\n",
+                                   "text/plain")
+                except BrokenPipeError:  # scraper hung up mid-write
+                    pass
+
+        self._server = ThreadingHTTPServer((addr, int(port)), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name="heat-monitor",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        host, port = self._server.server_address[:2]
+        return str(host), int(port)
+
+    @property
+    def url(self) -> str:
+        host, port = self.addr
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def enabled() -> bool:
+    return _MONITOR is not None
+
+
+def address() -> Optional[Tuple[str, int]]:
+    """(host, port) of the armed endpoint, or None."""
+    return _MONITOR.addr if _MONITOR is not None else None
+
+
+def enable(
+    port: Optional[int] = None,
+    addr: Optional[str] = None,
+    heartbeat_dir: Optional[str] = None,
+    stale_after: float = 120.0,
+) -> Tuple[str, int]:
+    """Arm the endpoint (idempotent: re-enabling replaces the server).
+    Defaults: ``HEAT_TPU_MONITOR_PORT`` (else 0 = OS-assigned) on
+    ``HEAT_TPU_MONITOR_ADDR`` (else localhost); ``heartbeat_dir`` enables
+    the staleness verdict + per-rank gauges.  Returns the bound
+    (host, port)."""
+    global _MONITOR
+    if port is None:
+        try:
+            port = int(os.environ.get("HEAT_TPU_MONITOR_PORT", "0") or 0)
+        except ValueError:
+            port = 0
+    addr = addr or os.environ.get("HEAT_TPU_MONITOR_ADDR") or "127.0.0.1"
+    old, _MONITOR = _MONITOR, None
+    if old is not None:
+        old.close()
+    _MONITOR = Monitor(port=port, addr=addr, heartbeat_dir=heartbeat_dir,
+                       stale_after=stale_after)
+    return _MONITOR.addr
+
+
+def disable() -> None:
+    global _MONITOR
+    old, _MONITOR = _MONITOR, None
+    if old is not None:
+        old.close()
+
+
+# env arming: HEAT_TPU_MONITOR=1 (with HEAT_TPU_MONITOR_PORT/_ADDR as the
+# knobs) arms at import — gated on __package__ like telemetry/flightrec:
+# a STANDALONE load of this file is tooling and must not open sockets.
+if __package__ and os.environ.get("HEAT_TPU_MONITOR", "").strip().lower() in (
+    "1", "true", "on", "yes"
+):
+    enable(heartbeat_dir=os.environ.get("HEAT_TPU_MONITOR_HB") or None)

@@ -50,7 +50,10 @@ innermost dispatch/comm/IO paths, where a heavy import would be a cycle.
 from __future__ import annotations
 
 import atexit
+import contextlib
+import contextvars
 import functools
+import hashlib
 import json
 import math
 import os
@@ -66,6 +69,10 @@ __all__ = [
     "enabled",
     "span",
     "traced",
+    "tracing",
+    "mint_trace_id",
+    "current_trace_id",
+    "current_span_id",
     "record_event",
     "observe",
     "histogram",
@@ -79,12 +86,18 @@ __all__ = [
     "write_counters_line",
     "install_signal_flush",
     "reset",
+    "ring_dropped",
 ]
 
 RING_SIZE = 4096
 
 _ENABLED = False
 _ring: deque = deque(maxlen=RING_SIZE)
+# evicted-by-overwrite span records since the last reset(): the bounded
+# ring silently drops the OLDEST record on overflow, and a truncated trace
+# must never be mistaken for a complete one — surfaced as the counter
+# ``telemetry.ring.dropped`` in report()/flush() and the merged CLI report
+_ring_dropped = 0
 _histograms: Dict[str, "Histogram"] = {}
 _hist_lock = threading.Lock()
 _tls = threading.local()
@@ -120,6 +133,123 @@ def _stack() -> list:
     if s is None:
         s = _tls.stack = []
     return s
+
+
+def _ring_push(rec: tuple) -> None:
+    """Append into the bounded span ring, counting an eviction under
+    ``telemetry.ring.dropped`` first — ring truncation is always visible
+    in the export.  (``record_dispatch`` inlines this with identical
+    semantics: the hottest recorder cannot afford the call frame.)"""
+    global _ring_dropped
+    if len(_ring) == _ring.maxlen:
+        _ring_dropped += 1
+    _ring.append(rec)
+
+
+def ring_dropped() -> int:
+    """Span records evicted from the bounded ring since the last reset."""
+    return _ring_dropped
+
+
+# ---------------------------------------------------------------------- #
+# trace identity — the causal join key across ranks, spans and restarts
+# ---------------------------------------------------------------------- #
+# The contextvar carries ``(trace_id, parent_span_id)``.  It is set by
+# :func:`tracing` (the ONE sanctioned way to adopt or mint trace identity —
+# heatlint HT109 flags manual trace_id fiddling in library code) and read
+# by every recording site below: spans, leaf events and dispatch records
+# stamp the ambient trace into their attrs, and the flight recorder reads
+# :func:`current_trace_id` at the ``_account_bytes`` choke point so staged
+# collectives carry the same id into the crash-durable ring.  Contextvars
+# flow into ``health.guard_blocking`` worker threads and ``faults``
+# retries automatically, so one job's whole causal path — dispatch spans,
+# collective stamps, retry attempts — shares one id without any plumbing.
+_TRACE: contextvars.ContextVar = contextvars.ContextVar(
+    "heat_tpu_trace", default=None
+)
+_trace_seq = 0
+_span_seq = 0
+
+
+def mint_trace_id(name: str = "trace") -> str:
+    """A new 16-hex-digit trace id, minted DETERMINISTICALLY from a
+    per-process counter + ``name`` + the restart epoch — NOT from process
+    entropy: under multi-process SPMD every rank executes the identical
+    trace-opening sites in lockstep, so every rank derives the IDENTICAL
+    id for the same logical trace (the whole point of a cross-rank join
+    key; per-rank entropy would shatter it — the HT105 divergence class).
+    Callers whose traces are NOT lockstep-opened (a per-tenant job) should
+    pass a name that is itself rank-invariant (the scheduler derives ids
+    from the job id)."""
+    global _trace_seq
+    _trace_seq += 1
+    epoch = os.environ.get("HEAT_TPU_RESTART_EPOCH", "0")
+    return hashlib.sha1(
+        f"{name}|{_trace_seq}|{epoch}".encode()
+    ).hexdigest()[:16]
+
+
+def _mint_span_id() -> str:
+    global _span_seq
+    _span_seq += 1
+    return f"s{_span_seq:x}"
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient trace id, or None outside any :func:`tracing` block.
+    Read by the flight recorder at collective staging — safe to call with
+    telemetry disabled (one contextvar load)."""
+    t = _TRACE.get()
+    return t[0] if t is not None else None
+
+
+def current_span_id() -> Optional[str]:
+    """The innermost open span's id (None outside a traced span)."""
+    stack = _stack()
+    for s in reversed(stack):
+        sid = getattr(s, "span_id", None)
+        if sid is not None:
+            return sid
+    t = _TRACE.get()
+    return t[1] if t is not None else None
+
+
+@contextlib.contextmanager
+def tracing(trace_id: Optional[str] = None, name: str = "trace",
+            parent_id: Optional[str] = None):
+    """Arm a trace context for the block: every span/event/dispatch record
+    (and every flight-recorder collective stamp) inside it carries
+    ``trace_id``.  Minted via :func:`mint_trace_id` when not given;
+    ``parent_id`` links into an enclosing trace from another process (a
+    job's submit-side span).  Works with telemetry DISABLED too — the
+    flight recorder stamps trace ids independently of the span ring, so a
+    crash-durable causal path exists even when nothing else is armed.
+    Yields the trace id."""
+    tid = trace_id or mint_trace_id(name)
+    token = _TRACE.set((tid, parent_id))
+    try:
+        yield tid
+    finally:
+        _TRACE.reset(token)
+
+
+def _trace_attrs(attrs: Optional[dict], span_id: Optional[str] = None,
+                 parent_id: Optional[str] = None) -> Optional[dict]:
+    """Fold the ambient trace identity into a record's attrs (shared by
+    spans, leaf events and dispatch records).  No active trace: attrs pass
+    through untouched — zero cost added to untraced recording."""
+    t = _TRACE.get()
+    if t is None:
+        return attrs
+    out = dict(attrs) if attrs else {}
+    out["trace_id"] = t[0]
+    if span_id is not None:
+        out["span_id"] = span_id
+    if parent_id is None:
+        parent_id = t[1]
+    if parent_id is not None:
+        out["parent_id"] = parent_id
+    return out
 
 
 # ---------------------------------------------------------------------- #
@@ -174,8 +304,10 @@ def disable() -> None:
 
 def reset() -> None:
     """Drop recorded spans and histograms (counters have their own reset in
-    ``utils.profiler``)."""
+    ``utils.profiler``), and zero the ring-eviction counter."""
+    global _ring_dropped
     _ring.clear()
+    _ring_dropped = 0
     with _hist_lock:
         _histograms.clear()
 
@@ -295,12 +427,15 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "t0", "child", "_ta", "_depth")
+    __slots__ = ("name", "attrs", "t0", "child", "_ta", "_depth", "span_id",
+                 "_parent_id")
 
     def __init__(self, name: str, attrs: dict, xprof: bool):
         self.name = name
         self.attrs = attrs
         self.child = 0.0
+        self.span_id = None
+        self._parent_id = None
         self._ta = (
             _trace_annotation(name)
             if (xprof and _trace_annotation is not None)
@@ -310,6 +445,11 @@ class _Span:
     def __enter__(self):
         stack = _stack()
         self._depth = len(stack)
+        if _TRACE.get() is not None:
+            # inside a trace: this span gets its own id, parented on the
+            # innermost traced span (or the context's cross-process parent)
+            self._parent_id = current_span_id()
+            self.span_id = _mint_span_id()
         stack.append(self)
         if self._ta is not None:
             self._ta.__enter__()
@@ -335,14 +475,15 @@ class _Span:
             stack[-1].child += dur
         if et is not None:
             self.attrs = dict(self.attrs, error=et.__name__)
-        _ring.append(
+        _ring_push(
             (
                 self.name,
                 _T0_WALL + (self.t0 - _T0_PERF),
                 dur,
                 max(dur - self.child, 0.0),
                 self._depth,
-                self.attrs or None,
+                _trace_attrs(self.attrs, self.span_id, self._parent_id)
+                or None,
             )
         )
         return False
@@ -386,10 +527,12 @@ def record_event(name: str, dur_s: float, attrs: Optional[dict] = None) -> None:
     enter/exit machinery, no TraceAnnotation."""
     if not _ENABLED:
         return
+    if _TRACE.get() is not None:  # one contextvar load when untraced
+        attrs = _trace_attrs(attrs, None, current_span_id())
     stack = _stack()
     if stack:
         stack[-1].child += dur_s
-    _ring.append(
+    _ring_push(
         (
             name,
             _T0_WALL + (time.perf_counter() - dur_s - _T0_PERF),
@@ -408,10 +551,19 @@ def record_dispatch(name: str, t0: float, t1: float, op_name: str, cache_hit: bo
     else happens on the hot path."""
     if not _ENABLED:
         return
+    global _ring_dropped
     dur = t1 - t0
+    attrs = {"op": op_name, "cache": "hit" if cache_hit else "miss"}
+    if _TRACE.get() is not None:  # the leanest-path tax when untraced is
+        attrs = _trace_attrs(attrs, None, current_span_id())  # this ONE load
     stack = _stack()
     if stack:
         stack[-1].child += dur
+    # _ring_push inlined (same eviction-count semantics): this is the
+    # hottest recorder and an extra call frame is measurable against the
+    # telemetry-gate budget
+    if len(_ring) == _ring.maxlen:
+        _ring_dropped += 1
     _ring.append(
         (
             name,
@@ -419,7 +571,7 @@ def record_dispatch(name: str, t0: float, t1: float, op_name: str, cache_hit: bo
             dur,
             dur,
             len(stack),
-            {"op": op_name, "cache": "hit" if cache_hit else "miss"},
+            attrs,
         )
     )
 
@@ -489,7 +641,17 @@ _H_NBINS = _H_DECADES * _H_PER_DECADE
 class Histogram:
     """Latency histogram over fixed log-spaced bins (1 µs – 1000 s at 5
     bins/decade, plus under/overflow): memory is a constant 47 ints however
-    many observations arrive — no unbounded sample lists."""
+    many observations arrive — no unbounded sample lists.
+
+    **Percentile resolution caveat.**  Quantiles are upper-edge estimates
+    from the bin counts: at 5 bins/decade each bin spans ~58% of its lower
+    edge, so a reported percentile can overstate the true value by up to
+    one bin width.  This matters most for the deep tail — **p99.9** (the
+    serving-SLO tail beyond the p99 the tables historically stopped at) is
+    exact about WHICH bin the 99.9th observation landed in, but within
+    that bin only the upper edge (clamped to the observed max) is known.
+    At pod scale that is the right trade: the alternative, an exact
+    reservoir, is unbounded memory on the hot path."""
 
     __slots__ = ("name", "counts", "count", "total", "vmin", "vmax")
 
@@ -545,6 +707,7 @@ class Histogram:
             "p50_s": round(self.quantile(0.50), 9),
             "p90_s": round(self.quantile(0.90), 9),
             "p99_s": round(self.quantile(0.99), 9),
+            "p999_s": round(self.quantile(0.999), 9),
         }
 
 
@@ -569,10 +732,16 @@ def report(top: int = 15) -> dict:
     """In-process merged view: counters ∪ histograms ∪ top spans by
     self-time.  May sync device-resident counters — reporting boundary
     only, never the hot loop."""
+    counters = _prof().counters()
+    if _ring_dropped:
+        # eviction is telemetry-internal state, not a profiler counter —
+        # injected at the reporting boundary so a truncated span ring is
+        # never mistaken for a complete trace
+        counters["telemetry.ring.dropped"] = _ring_dropped
     return {
         "enabled": _ENABLED,
         "rank": _rank(),
-        "counters": _prof().counters(),
+        "counters": counters,
         "histograms": {n: h.summary() for n, h in sorted(_histograms.items())},
         "top_spans": span_summary(top),
     }
@@ -639,10 +808,11 @@ def flush(directory: Optional[str] = None) -> Optional[str]:
             if attrs:
                 rec["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
             fh.write(json.dumps(rec) + "\n")
+        values = _prof().counters()
+        if _ring_dropped:
+            values["telemetry.ring.dropped"] = _ring_dropped
         fh.write(
-            json.dumps(
-                {"type": "counters", "rank": rank, "values": _prof().counters()}
-            )
+            json.dumps({"type": "counters", "rank": rank, "values": values})
             + "\n"
         )
         for name, h in sorted(_histograms.items()):

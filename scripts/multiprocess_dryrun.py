@@ -488,6 +488,37 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
     hb.beat()
     print(f"[{pid}] telemetry: rank file exported", flush=True)
 
+    # ---- live observability endpoint (ISSUE 11) ----------------------- #
+    # rank 0 arms the /metrics + /healthz monitor and scrapes its OWN
+    # endpoint over a real localhost socket MID-RUN (the world is still
+    # live): the payload must be non-empty Prometheus text carrying the
+    # comm.* byte accounting, and /healthz must read every beacon fresh
+    if pid == 0:
+        import json as _json
+        import urllib.request
+
+        from heat_tpu.utils import monitor
+
+        mhost, mport = monitor.enable(
+            heartbeat_dir=os.environ.get("MPDRYRUN_HB") or None
+        )
+        with urllib.request.urlopen(
+            f"http://{mhost}:{mport}/metrics", timeout=15
+        ) as resp:
+            payload = resp.read().decode()
+        assert "comm_resplit_calls" in payload, payload[:500]
+        n_metrics = sum(
+            1 for ln in payload.splitlines() if ln and not ln.startswith("#")
+        )
+        with urllib.request.urlopen(
+            f"http://{mhost}:{mport}/healthz", timeout=15
+        ) as resp:
+            hz = _json.loads(resp.read().decode())
+        assert hz.get("ok") is True, hz
+        monitor.disable()
+        print(f"[{pid}] MONITOR-SCRAPED metrics={n_metrics} healthz=ok", flush=True)
+    hb.beat()
+
     # ---- flight recorder (ISSUE 7) ----------------------------------- #
     # env-armed (HEAT_TPU_FLIGHTREC_DIR, exported by the launcher) at
     # heat_tpu import: every staged collective above was seq-stamped into
@@ -697,6 +728,13 @@ def serve_worker(pid: int, port: int, tmpdir: str) -> None:
     deadline_s = float(os.environ.get("MPDRYRUN_JOB_DEADLINE", "300"))
     journal_path = os.path.join(tmpdir, "telemetry", "sched_journal.jsonl")
     epoch = ht.core.bootstrap.restart_epoch()
+    # live observability endpoint (ISSUE 11): armed on rank 0 BEFORE the
+    # scheduler is built, so the scheduler's queue-depth/tenant-inflight
+    # gauge source registers with it; scraped after the drain below
+    if pid == 0:
+        from heat_tpu.utils import monitor
+
+        monitor.enable(heartbeat_dir=os.environ.get("MPDRYRUN_HB") or None)
     sch = sched_mod.Scheduler(
         serving.make_executor(comm),
         max_queue=max_queue,
@@ -735,6 +773,45 @@ def serve_worker(pid: int, port: int, tmpdir: str) -> None:
                 print(f"[{pid}] SCHED-SHED id={e.job_id} reason={e.reason}", flush=True)
     hb.beat(status="serving")
     rep = sch.run(beat=hb.beat)
+    # scrape the live endpoint while the world is still up: the Prometheus
+    # payload must be non-empty and its sched_* counters must reconcile
+    # (offered = accepted + shed) — the serving plane's accounting
+    # invariant, read straight off the wire format a Prometheus scraper
+    # would see
+    if pid == 0:
+        import urllib.request
+
+        from heat_tpu.utils import monitor
+
+        mhost, mport = monitor.address()
+        with urllib.request.urlopen(
+            f"http://{mhost}:{mport}/metrics", timeout=15
+        ) as resp:
+            payload = resp.read().decode()
+        vals = {}
+        for ln in payload.splitlines():
+            if ln.startswith("#") or "{" in ln or " " not in ln:
+                continue
+            k, _, v = ln.partition(" ")
+            try:
+                vals[k] = float(v)
+            except ValueError:
+                pass
+        offered = int(vals.get("sched_offered", 0))
+        accepted = int(vals.get("sched_accepted", 0))
+        shed = int(vals.get("sched_shed", 0))
+        assert offered == accepted + shed, (offered, accepted, shed, payload[:500])
+        assert "sched_queue_depth" in vals, payload[:500]
+        n_metrics = sum(
+            1 for ln in payload.splitlines() if ln and not ln.startswith("#")
+        )
+        monitor.disable()
+        print(
+            f"[{pid}] MONITOR-SCRAPED metrics={n_metrics} "
+            f"offered={offered} accepted={accepted} shed={shed} "
+            "reconciled=True",
+            flush=True,
+        )
     _lockstep_stamp()
     done = rep["by_state"].get(sched_mod.DONE, 0)
     failed = rep["by_state"].get(sched_mod.FAILED, 0)
@@ -980,6 +1057,13 @@ def main() -> int:
         ok = False
     elif ok:
         print(f"TELEMETRY-MERGED ranks={len(worker_ranks)}", flush=True)
+    # step-time breakdown (ISSUE 11): compute / comm-wait / host-sync /
+    # idle + the overlap fraction per step kind, from the merged spans —
+    # prints STEP-OVERLAP marker lines whenever the run recorded step
+    # spans (daso.step in train mode, sched.job in serve mode)
+    overlap = trep.overlap_section(merged["timeline"])
+    if overlap:
+        print(overlap, flush=True)
     print(
         f"SUPERVISOR restarts={res.restarts} generations={res.generations} "
         f"watchdog.dumps={launcher_counters['watchdog.dumps']} "
@@ -1023,6 +1107,48 @@ def main() -> int:
         slo = trep.slo_section([tdir], spans=merged["timeline"])
         if slo:
             print(slo, flush=True)
+        # trace propagation attestations (ISSUE 11): every journaled record
+        # of one job — across however many generations — must carry the
+        # SAME trace id (journal replay preserves it), and one trace id
+        # must assemble into a causal timeline across journal + telemetry
+        # + flight-ring sources.  Preference: a REQUEUED job, because its
+        # chain crosses the SIGKILL restart — the continuity that matters.
+        if os.path.exists(job_journal):
+            try:
+                replay = sched_mod.replay_journal(job_journal)
+            except Exception as e:
+                print(f"launcher: trace-continuity replay failed: {e!r}")
+                replay = None
+                ok = False
+            if replay is not None:
+                cont = sched_mod.trace_continuity(replay)
+                print(
+                    f"SCHED-TRACE-CONTINUITY jobs={cont['jobs']} "
+                    f"ok={cont['ok']}"
+                    + (f" violations={cont['violations']}"
+                       if cont["violations"] else ""),
+                    flush=True,
+                )
+                if not cont["ok"]:
+                    print(
+                        "launcher: requeued job(s) changed trace id across "
+                        "the restart — the causal chain is severed"
+                    )
+                    ok = False
+                requeued_tids = [
+                    rec.get("tid") for rec in replay["records"]
+                    if rec.get("type") == "requeue" and rec.get("tid")
+                ]
+                any_tids = [
+                    v.get("tid") for v in replay["jobs"].values() if v.get("tid")
+                ]
+                pick = (requeued_tids or any_tids or [None])[0]
+                if pick:
+                    print(
+                        trep.trace_section([tdir, fr_dir], pick,
+                                           spans=merged["timeline"]),
+                        flush=True,
+                    )
     # flight-recorder post-mortem (ISSUE 7): failed generations were
     # analyzed + harvested by the supervisor at teardown (one verdict per
     # generation in res.postmortems); on success the final generation's

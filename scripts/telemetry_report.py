@@ -174,6 +174,9 @@ def merge_files(paths: List[str]) -> dict:
             "p50_s": round(_hist_quantile(agg, 0.50), 9),
             "p90_s": round(_hist_quantile(agg, 0.90), 9),
             "p99_s": round(_hist_quantile(agg, 0.99), 9),
+            # bin-resolution caveat applies (telemetry.Histogram docstring):
+            # the deep tail is exact about the BIN, upper-edge within it
+            "p999_s": round(_hist_quantile(agg, 0.999), 9),
             "max_s": round(agg["max_s"], 9),
         }
 
@@ -226,11 +229,14 @@ def render(merged: dict, top: int = 20, timeline: int = 25) -> str:
             rows.append([
                 name, h["count"], f"{h['mean_s'] * 1e3:.3f}",
                 f"{h['p50_s'] * 1e3:.3f}", f"{h['p90_s'] * 1e3:.3f}",
-                f"{h['p99_s'] * 1e3:.3f}", f"{h['max_s'] * 1e3:.3f}",
+                f"{h['p99_s'] * 1e3:.3f}",
+                f"{h.get('p999_s', h['p99_s']) * 1e3:.3f}",
+                f"{h['max_s'] * 1e3:.3f}",
             ])
         if rows:
             out.append(_fmt_table(
-                rows, ["histogram", "n", "mean_ms", "p50_ms", "p90_ms", "p99_ms", "max_ms"]
+                rows, ["histogram", "n", "mean_ms", "p50_ms", "p90_ms",
+                       "p99_ms", "p99.9_ms", "max_ms"]
             ))
     if merged["timeline"] and timeline > 0:
         out.append(f"\n-- timeline (first {min(timeline, len(merged['timeline']))} spans, all ranks) --")
@@ -316,6 +322,135 @@ def flightrec_section(dirs: List[str], context: int = 5) -> str:
                 + ", ".join(str(r) for r in verdict["missing_ranks"])
             )
         out.append(pm.render_grid(rings, around=around, context=context))
+    return "\n".join(out)
+
+
+_stepprof = None
+
+
+def _stepprof_mod():
+    """``scripts/stepprof.py`` loaded standalone (next to this file,
+    stdlib-only) — the ONE implementation of the step-time breakdown.
+    None when missing (a stripped install): no overlap section."""
+    global _stepprof
+    if _stepprof is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "stepprof.py")
+        if not os.path.exists(path):
+            return None
+        spec = importlib.util.spec_from_file_location("telemetry_report_stepprof", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _stepprof = mod
+    return _stepprof
+
+
+def overlap_section(spans: List[dict]) -> str:
+    """The step-time compute/comm-overlap breakdown (``scripts/
+    stepprof.py``) over the already-merged spans; '' when no step spans
+    exist or stepprof is missing."""
+    sp = _stepprof_mod()
+    if sp is None or not spans:
+        return ""
+    return sp.overlap_section(spans)
+
+
+def trace_section(targets: List[str], trace_id: str,
+                  spans: Optional[List[dict]] = None) -> str:
+    """The assembled causal timeline of ONE trace id across every artifact
+    the targets hold: telemetry spans whose attrs carry the id, scheduler
+    journal records (``tid``), and flight-recorder ring records (``tid``
+    on collective stamps and job events).  This is the end-to-end payoff
+    of trace propagation: one command renders a single job's path —
+    submit, dispatches, per-collective seq stamps, retries, terminal state
+    — across ranks, processes and supervisor restarts, merged on the
+    epoch-seconds axis the exports share."""
+    events: List[dict] = []  # {t, rank, source, what}
+    if spans is None:
+        spans = []
+        for t in targets:
+            for p in find_rank_files(t):
+                spans.extend(
+                    r for r in _read_records(p) if r.get("type") == "span"
+                )
+    for s in spans:
+        at = s.get("attrs") or {}
+        if at.get("trace_id") != trace_id:
+            continue
+        what = f"span {s.get('name')} ({float(s.get('dur_s', 0.0)) * 1e3:.3f}ms"
+        extra = ", ".join(
+            f"{k}={at[k]}" for k in ("kind", "outcome", "op", "attempts")
+            if k in at
+        )
+        what += f"; {extra})" if extra else ")"
+        events.append({
+            "t": float(s.get("ts", 0.0)),
+            "rank": s.get("rank", "?"),
+            "source": "telemetry",
+            "what": what,
+        })
+    sched = _scheduler_mod()
+    for t in targets:
+        for jp in find_journals(t):
+            if sched is None:
+                break
+            try:
+                replay = sched.replay_journal(jp)
+            except Exception:
+                continue
+            for rec in replay["records"]:
+                if rec.get("tid") != trace_id:
+                    continue
+                bits = [str(rec.get("type"))]
+                for k in ("id", "seq", "attempt", "reason", "epoch"):
+                    if rec.get(k) is not None:
+                        bits.append(f"{k}={rec[k]}")
+                events.append({
+                    "t": float(rec.get("t", 0.0)),
+                    "rank": "journal",
+                    "source": "journal",
+                    "what": " ".join(bits),
+                })
+    pm = _postmortem_mod()
+    if pm is not None:
+        for t in targets:
+            if not os.path.isdir(t):
+                continue
+            for rank, ring in sorted(pm.load_rings(t).items()):
+                for rec in ring.get("records", []):
+                    if rec.get("tid") != trace_id:
+                        continue
+                    kind = rec.get("k")
+                    if kind == "coll":
+                        what = (
+                            f"collective seq={rec.get('seq')} "
+                            f"op={rec.get('op')} wire={rec.get('wire')}B"
+                        )
+                    else:
+                        bits = [str(kind)]
+                        for k in ("id", "state", "attempt"):
+                            if rec.get(k) is not None:
+                                bits.append(f"{k}={rec[k]}")
+                        what = " ".join(bits)
+                    events.append({
+                        "t": float(rec.get("t", 0.0)),
+                        "rank": rank,
+                        "source": "flightrec",
+                        "what": what,
+                    })
+    if not events:
+        return f"trace {trace_id}: no records found under {targets}"
+    events.sort(key=lambda e: e["t"])
+    t0 = events[0]["t"]
+    out = [f"-- causal timeline for trace {trace_id} "
+           f"({len(events)} records, all sources) --"]
+    rows = [
+        [f"+{(e['t'] - t0) * 1e3:.3f}ms", str(e["rank"]), e["source"], e["what"]]
+        for e in events
+    ]
+    out.append(_fmt_table(rows, ["t", "rank", "source", "event"]))
     return "\n".join(out)
 
 
@@ -487,12 +622,23 @@ def main(argv=None) -> int:
                     help="timeline rows to print (0 disables)")
     ap.add_argument("--context", type=int, default=5,
                     help="collective-grid rows either side of the divergence")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="render the assembled causal timeline of ONE trace "
+                         "id across spans, scheduler journals and flight "
+                         "rings, instead of the full report")
     args = ap.parse_args(argv)
 
     paths = []
     for t in args.targets:
         paths.extend(find_rank_files(t))
     paths = sorted(dict.fromkeys(paths))  # de-dup, stable order
+    if args.trace:
+        merged = merge_files(paths) if paths else None
+        print(trace_section(
+            list(args.targets), args.trace,
+            spans=merged["timeline"] if merged is not None else None,
+        ))
+        return 0
     section = flightrec_section(
         [t for t in args.targets if os.path.isdir(t)], context=args.context
     )
@@ -528,6 +674,9 @@ def main(argv=None) -> int:
         print(section)
     if slo:
         print(slo)
+    overlap = overlap_section(merged["timeline"])
+    if overlap:
+        print(overlap)
     if args.json:
         # the timeline can be huge; the JSON artifact keeps it whole (the
         # text rendering is the bounded view)

@@ -33,6 +33,7 @@ not in the quick verify lane.
 
 import importlib.util
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -227,6 +228,13 @@ class TestKillAndResume:
         # merged telemetry report (the once-dropped return value)
         assert "watchdog.kills" in out
         assert "TELEMETRY-MERGED ranks=2" in out, out[-3000:]
+        # step-time breakdown (ISSUE 11): the DASO train mode's merged spans
+        # yield an overlap-fraction number for daso.step — the measured
+        # compute/comm-overlap baseline the hierarchical-collectives work
+        # will be judged against
+        assert re.search(
+            r"STEP-OVERLAP kind=daso\.step steps=\d+ overlap=\d\.\d+", out
+        ), out[-3000:]
 
     def test_supervised_dryrun_restart_budget_give_up(self):
         """A rank that dies on EVERY generation exhausts the restart budget

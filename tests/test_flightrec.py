@@ -1004,3 +1004,57 @@ class TestTelemetryReportCLI:
         out = capsys.readouterr().out
         assert rc == 0
         assert "collective timeline" not in out
+
+
+# ---------------------------------------------------------------------- #
+# trace propagation (ISSUE 11): collective stamps carry the ambient tid
+# ---------------------------------------------------------------------- #
+class TestTraceStamping:
+    def test_collective_stamp_carries_ambient_trace_id(self, tmp_path):
+        flightrec.enable(str(tmp_path), rank=0)
+        with telemetry.tracing(trace_id="feedface00000001"):
+            flightrec.record_collective("resplit", 4096)
+        flightrec.record_collective("resplit", 4096)  # untraced
+        flightrec.disable()
+        ring = flightrec.read_ring(str(tmp_path / "flight_rank0.ring"))
+        colls = [r for r in ring["records"] if r["k"] == "coll"]
+        assert colls[0]["tid"] == "feedface00000001"
+        assert "tid" not in colls[1]
+
+    def test_staged_collective_through_account_bytes_carries_tid(self, tmp_path):
+        """The real choke point: a resplit staged inside telemetry.tracing
+        lands in the ring with the trace id — no telemetry arming needed
+        (trace identity is a contextvar, not span-ring state)."""
+        flightrec.enable(str(tmp_path), rank=0)
+        comm = ht.communication.get_comm()
+        x = ht.reshape(ht.arange(comm.size * comm.size, dtype=ht.float32,
+                                 split=0), (comm.size, comm.size))
+        with telemetry.tracing(name="resplit-test") as tid:
+            x = x.resplit(1)
+        flightrec.disable()
+        ring = flightrec.read_ring(str(tmp_path / "flight_rank0.ring"))
+        stamped = [r for r in ring["records"]
+                   if r["k"] == "coll" and r.get("tid") == tid]
+        assert stamped and stamped[-1]["op"] == "resplit"
+
+    def test_tid_not_part_of_the_desync_fingerprint(self, tmp_path):
+        """Two ranks staging the identical stream, only one under a trace:
+        the analyzer must NOT read the tid difference as a desync — trace
+        identity is attribution, never evidence of divergence."""
+        d = str(tmp_path)
+        _mkring(d, 0, [{"op": "resplit", "wire": 64, "tid": "aaaa"}],
+                shutdown=True)
+        _mkring(d, 1, [{"op": "resplit", "wire": 64}], shutdown=True)
+        verdict = pm.analyze(pm.load_rings(d))
+        assert verdict["verdict"] == "clean", verdict
+
+    def test_oversize_record_keeps_tid(self, tmp_path):
+        p = str(tmp_path / "flight_rank0.ring")
+        r = flightrec.FlightRecorder(p, rank=0)
+        r.record("coll", seq=1, op="resplit", wire=64,
+                 tid="feedface00000003", gshape=list(range(200)))
+        r.close()
+        ring = flightrec.read_ring(p)
+        (rec,) = [x for x in ring["records"] if x["k"] == "coll"]
+        assert rec.get("trunc") == 1 and rec["tid"] == "feedface00000003"
+        assert rec["seq"] == 1 and rec["op"] == "resplit"

@@ -30,6 +30,7 @@ from heat_tpu.analysis.rules import (
     RankConditionalCollectiveRule,
     RawEntropyRule,
     SeqStampBypassRule,
+    TraceIdentityRule,
     UseAfterDonateRule,
 )
 
@@ -634,6 +635,92 @@ class TestHT108:
 
 
 # ---------------------------------------------------------------------- #
+# HT109 — trace identity owned by the tracing choke points
+# ---------------------------------------------------------------------- #
+class TestHT109:
+    def test_manual_trace_id_subscript_write_flagged(self):
+        fs = run_rule(TraceIdentityRule(), """
+            def f(attrs, job):
+                attrs["trace_id"] = job.job_id + "-trace"
+                return attrs
+        """)
+        assert [f.detail for f in fs] == ["trace_id"]
+        assert fs[0].rule == "HT109"
+
+    def test_parent_and_span_id_writes_flagged(self):
+        fs = run_rule(TraceIdentityRule(), """
+            def f(rec):
+                rec["span_id"] = "s1"
+                rec["parent_id"] = "s0"
+        """)
+        assert sorted(f.detail for f in fs) == ["parent_id", "span_id"]
+
+    def test_trace_kwarg_smuggled_into_span_flagged(self):
+        fs = run_rule(TraceIdentityRule(), """
+            from heat_tpu.utils import telemetry
+            def f(tid):
+                with telemetry.span("work", trace_id=tid):
+                    pass
+        """)
+        assert [f.detail for f in fs] == ["span:trace_id"]
+
+    def test_record_event_trace_kwarg_flagged(self):
+        fs = run_rule(TraceIdentityRule(), """
+            from heat_tpu.utils import telemetry
+            def f(tid):
+                telemetry.record_event("e", 0.1, trace_id=tid)
+        """)
+        assert [f.detail for f in fs] == ["record_event:trace_id"]
+
+    def test_direct_contextvar_set_flagged(self):
+        fs = run_rule(TraceIdentityRule(), """
+            from heat_tpu.utils.telemetry import _TRACE
+            def f(tid):
+                _TRACE.set((tid, None))
+        """)
+        assert len(fs) == 1 and "_TRACE" in fs[0].detail
+
+    def test_tracing_helper_is_the_sanctioned_idiom(self):
+        fs = run_rule(TraceIdentityRule(), """
+            from heat_tpu.utils import telemetry
+            def f(tid):
+                with telemetry.tracing(trace_id=tid):
+                    with telemetry.span("work"):
+                        pass
+        """)
+        assert fs == []
+
+    def test_reading_trace_identity_not_flagged(self):
+        fs = run_rule(TraceIdentityRule(), """
+            def f(attrs):
+                tid = attrs.get("trace_id")
+                other = {"unrelated": 1}
+                other["tid"] = tid  # a foreign key name is not the triple
+                return tid
+        """)
+        assert fs == []
+
+    def test_owner_modules_sanctioned(self):
+        src = """
+            def submit(job, attrs):
+                attrs["trace_id"] = "abc123"
+        """
+        assert run_rule(
+            TraceIdentityRule(), src, path="heat_tpu/utils/telemetry.py"
+        ) == []
+        assert run_rule(
+            TraceIdentityRule(), src, path="heat_tpu/parallel/scheduler.py"
+        ) == []
+
+    def test_suppression_works(self):
+        fs = run_rule(TraceIdentityRule(), """
+            def f(attrs):
+                attrs["trace_id"] = "x"  # heatlint: disable=HT109 migration shim
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------- #
 # framework: suppressions, baseline, discovery, CLI
 # ---------------------------------------------------------------------- #
 class TestFramework:
@@ -671,7 +758,7 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
-            "HT108", "HT201", "HT202", "HT203", "HT204",
+            "HT108", "HT109", "HT201", "HT202", "HT203", "HT204",
         ]
 
     def test_select_unknown_rule_raises(self):

@@ -1,0 +1,262 @@
+"""Live observability endpoint (ISSUE 11 tentpole): /metrics + /healthz.
+
+The monitor is stdlib-only and adds NO hot-path hook — these tests cover
+the snapshot assembly (Prometheus text shape, counter/gauge/histogram
+sources, metric-name sanitization), the HTTP surface over a real
+localhost socket (ephemeral port, 200/503 health verdicts, 404s), the
+heartbeat-staleness rule, and the standalone-load contract (the
+supervisor hosts this file without importing jax).
+
+NOT mp-marked: the tests toggle the process-global monitor/telemetry
+state; the multi-process story (rank-0 arming + a mid-run scrape over a
+real 2-process world) is covered by the dryrun markers asserted in
+tests/test_multiprocess.py.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heat_tpu.utils import health, monitor, profiler, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor():
+    monitor.disable()
+    telemetry.reset()
+    yield
+    monitor.disable()
+    telemetry.reset()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestMetricNames:
+    def test_dots_become_underscores(self):
+        assert monitor.metric_name("comm.resplit.bytes") == "comm_resplit_bytes"
+        assert monitor.metric_name("sched.shed.queue_full") == "sched_shed_queue_full"
+
+    def test_illegal_chars_and_leading_digit(self):
+        assert monitor.metric_name("a b-c/d") == "a_b_c_d"
+        assert monitor.metric_name("9lives") == "_9lives"
+
+
+class TestSnapshot:
+    def test_profiler_counters_in_payload(self):
+        profiler.counter_inc("comm.Allreduce.calls", 3)
+        try:
+            text = monitor.metrics_text()
+        finally:
+            profiler.reset_counters()
+        assert "# TYPE comm_Allreduce_calls counter" in text
+        assert "comm_Allreduce_calls 3" in text
+
+    def test_histogram_summary_with_p999(self):
+        telemetry.enable()
+        for _ in range(50):
+            telemetry.observe("comm.Wait.wait", 1e-4)
+        telemetry.disable()
+        text = monitor.metrics_text()
+        assert "# TYPE comm_Wait_wait_seconds summary" in text
+        for q in ("0.5", "0.9", "0.99", "0.999"):
+            assert f'comm_Wait_wait_seconds{{quantile="{q}"}}' in text
+        assert "comm_Wait_wait_seconds_count 50" in text
+
+    def test_ring_dropped_surfaces(self):
+        telemetry.enable()
+        for _ in range(telemetry._ring.maxlen + 5):
+            telemetry.record_event("e", 1e-6)
+        telemetry.disable()
+        text = monitor.metrics_text()
+        assert "telemetry_ring_dropped 5" in text
+
+    def test_gauge_source_lifecycle(self):
+        monitor.register_gauge_source("t", lambda: {"my.gauge": 7})
+        try:
+            assert "my_gauge 7" in monitor.metrics_text()
+        finally:
+            monitor.unregister_gauge_source("t")
+        assert "my_gauge" not in monitor.metrics_text()
+        # a None-returning source (owner collected) is pruned, not fatal
+        monitor.register_gauge_source("gone_owner", lambda: None)
+        monitor.metrics_text()
+        assert "gone_owner" not in monitor._gauge_sources
+
+    def test_heartbeat_gauges_and_seq_lag(self, tmp_path):
+        hb = str(tmp_path)
+        health.write_heartbeat(os.path.join(hb, "rank0.json"), 5,
+                               extra={"seq": 10})
+        health.write_heartbeat(os.path.join(hb, "rank1.json"), 5,
+                               extra={"seq": 7})
+        text = monitor.metrics_text(heartbeat_dir=hb)
+        assert 'heartbeat_age_seconds{rank="0"}' in text
+        assert 'heartbeat_seq_lag{rank="1"} 3' in text
+        assert 'heartbeat_seq_lag{rank="0"} 0' in text
+
+
+class TestHealthz:
+    def test_no_heartbeat_dir_is_process_liveness(self):
+        ok, body = monitor.healthz()
+        assert ok and body["ok"] and body["pid"] == os.getpid()
+
+    def test_fresh_beacons_ok_worst_rank_named(self, tmp_path):
+        for r in range(2):
+            health.write_heartbeat(
+                os.path.join(str(tmp_path), f"rank{r}.json"), 1
+            )
+        ok, body = monitor.healthz(heartbeat_dir=str(tmp_path))
+        assert ok and body["worst_rank"]["rank"] in (0, 1)
+        assert len(body["ranks"]) == 2
+
+    def test_stale_beacon_fails_and_names_the_rank(self, tmp_path):
+        p0 = os.path.join(str(tmp_path), "rank0.json")
+        p1 = os.path.join(str(tmp_path), "rank1.json")
+        health.write_heartbeat(p0, 1)
+        health.write_heartbeat(p1, 1)
+        old = time.time() - 300
+        os.utime(p1, (old, old))
+        ok, body = monitor.healthz(heartbeat_dir=str(tmp_path),
+                                   stale_after=120.0)
+        assert not ok
+        assert body["worst_rank"]["rank"] == 1 and body["worst_rank"]["stale"]
+        assert "rank 1" in body["detail"]
+
+    def test_torn_beacon_still_has_an_age(self, tmp_path):
+        with open(os.path.join(str(tmp_path), "rank0.json"), "w") as fh:
+            fh.write('{"torn')
+        ok, body = monitor.healthz(heartbeat_dir=str(tmp_path))
+        assert ok and body["ranks"][0]["rank"] == 0
+
+
+class TestHTTPServer:
+    def test_scrape_over_a_real_socket(self):
+        host, port = monitor.enable()
+        assert host == "127.0.0.1"  # localhost bind by default
+        assert monitor.enabled() and monitor.address() == (host, port)
+        profiler.counter_inc("io.bytes_written", 42)
+        try:
+            status, text = _get(f"http://{host}:{port}/metrics")
+        finally:
+            profiler.reset_counters()
+        assert status == 200
+        assert "io_bytes_written 42" in text
+        assert "monitor_uptime_seconds" in text
+        assert "monitor_scrapes_total 1" in text
+        # second scrape bumps the self-counter: the server is live state
+        _, text2 = _get(f"http://{host}:{port}/metrics")
+        assert "monitor_scrapes_total 2" in text2
+
+    def test_healthz_verdict_codes(self, tmp_path):
+        health.write_heartbeat(os.path.join(str(tmp_path), "rank0.json"), 1)
+        host, port = monitor.enable(heartbeat_dir=str(tmp_path),
+                                    stale_after=120.0)
+        status, body = _get(f"http://{host}:{port}/healthz")
+        assert status == 200 and json.loads(body)["ok"] is True
+        old = time.time() - 999
+        os.utime(os.path.join(str(tmp_path), "rank0.json"), (old, old))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://{host}:{port}/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["ok"] is False
+
+    def test_unknown_path_404(self):
+        host, port = monitor.enable()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://{host}:{port}/secrets")
+        assert ei.value.code == 404
+
+    def test_disable_stops_serving(self):
+        host, port = monitor.enable()
+        _get(f"http://{host}:{port}/metrics")
+        monitor.disable()
+        assert not monitor.enabled() and monitor.address() is None
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _get(f"http://{host}:{port}/metrics", timeout=2)
+
+    def test_reenable_replaces_server(self):
+        _, p1 = monitor.enable()
+        _, p2 = monitor.enable()
+        status, _ = _get(f"http://127.0.0.1:{p2}/metrics")
+        assert status == 200
+
+
+class TestStandaloneLoad:
+    def test_loads_and_serves_with_jax_import_blocked(self, tmp_path):
+        """The supervisor-hosted contract: monitor.py must load via
+        spec_from_file_location and serve a scrape in a process where
+        importing jax (or numpy, or heat_tpu) raises."""
+        code = f"""
+import importlib.util, json, sys, urllib.request
+
+class _Block:
+    def find_module(self, name, path=None):
+        if name in ("jax", "jaxlib", "numpy", "heat_tpu"):
+            raise ImportError(f"import of {{name}} is blocked in this test")
+sys.meta_path.insert(0, _Block())
+
+spec = importlib.util.spec_from_file_location(
+    "heat_monitor", {os.path.join(REPO, "heat_tpu", "utils", "monitor.py")!r}
+)
+mon = importlib.util.module_from_spec(spec)
+sys.modules[spec.name] = mon
+spec.loader.exec_module(mon)
+host, port = mon.enable(heartbeat_dir={str(tmp_path)!r})
+with urllib.request.urlopen(f"http://{{host}}:{{port}}/metrics", timeout=10) as r:
+    text = r.read().decode()
+assert "restart_epoch" in text, text[:200]
+with urllib.request.urlopen(f"http://{{host}}:{{port}}/healthz", timeout=10) as r:
+    assert json.loads(r.read().decode())["ok"] is True
+mon.disable()
+print("STANDALONE-MONITOR-OK")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "STANDALONE-MONITOR-OK" in proc.stdout
+
+    def test_supervisor_hosts_the_endpoint(self):
+        """Supervisor(monitor_port=0) serves /healthz + its counters gauge
+        without importing jax (supervisor.py loaded standalone)."""
+        spec = importlib.util.spec_from_file_location(
+            "heat_supervisor_montest",
+            os.path.join(REPO, "heat_tpu", "parallel", "supervisor.py"),
+        )
+        sup_mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = sup_mod
+        spec.loader.exec_module(sup_mod)
+
+        def spawn(rank, epoch, port):
+            return subprocess.Popen([sys.executable, "-c", "pass"])
+
+        sup = sup_mod.Supervisor(spawn, 1, poll_interval=0.05, monitor_port=0)
+        assert sup.monitor is not None
+        host, port = sup.monitor.addr
+        try:
+            status, text = _get(f"http://{host}:{port}/metrics")
+            assert status == 200 and "watchdog_dumps" in text
+            res = sup.run()
+            assert res.ok
+            # the endpoint outlives the run: post-run scrapes still work
+            status, body = _get(f"http://{host}:{port}/healthz")
+            assert status == 200
+        finally:
+            sup.monitor.close()
+            mon = sup_mod.Supervisor._load_tool(
+                "heat_monitor", sup_mod.Supervisor._MONITOR_PATH
+            )
+            if mon is not None:
+                mon.unregister_gauge_source("supervisor")

@@ -70,6 +70,13 @@ def test_n_process_spmd_tier(n_proc, devs):
         assert re.search(rf"\[{pid}\] FLIGHTREC seq=\d+ op=", out), out[-2000:]
     seqs = set(re.findall(r"\] FLIGHTREC seq=(\d+) op=", out))
     assert len(seqs) == 1, f"ranks disagree on the collective seq: {seqs}"
+    # ...and rank 0 armed the live /metrics + /healthz endpoint and scraped
+    # its own server over a real localhost socket MID-RUN (ISSUE 11): a
+    # non-empty Prometheus payload carrying the comm.* accounting, and a
+    # fresh worst-rank /healthz verdict
+    m = re.search(r"\[0\] MONITOR-SCRAPED metrics=(\d+) healthz=ok", out)
+    assert m, out[-2000:]
+    assert int(m.group(1)) > 10  # a real registry snapshot, not a stub
     # ...and the launcher merged them into ONE multi-rank report (ISSUE 3
     # acceptance: scripts/telemetry_report.py folds the mp lane's rank files)
     assert f"TELEMETRY-MERGED ranks={n_proc}" in out, out[-2000:]
@@ -180,6 +187,20 @@ def test_serve_mode_green_all_jobs_accounted():
     assert "per-tenant serving SLO" in out, out[-3000:]
     for tenant in ("acme", "globex", "initech"):
         assert tenant in out
+    # live endpoint (ISSUE 11): the mid-run /metrics scrape returned
+    # reconciled sched_* counters straight off the Prometheus payload —
+    # offered = accepted + shed (20 = 18 + 2)
+    assert (
+        "[0] MONITOR-SCRAPED" in out
+        and "offered=20 accepted=18 shed=2 reconciled=True" in out
+    ), out[-3000:]
+    # trace propagation: every journaled record of a job carries its
+    # submit-minted trace id, and the launcher assembled one job's causal
+    # timeline across journal + telemetry + flight-ring sources
+    assert "SCHED-TRACE-CONTINUITY jobs=20 ok=True" in out, out[-3000:]
+    assert "causal timeline for trace" in out, out[-3000:]
+    # step-time breakdown over the sched.job spans reports an overlap number
+    assert re.search(r"STEP-OVERLAP kind=sched\.job steps=\d+", out), out[-3000:]
     assert "POSTMORTEM verdict=clean" in out, out[-3000:]
 
 
@@ -233,6 +254,15 @@ def test_serve_sigkill_mid_queue_loses_zero_jobs():
     # the supervisor report's jobs section carries the same accounting per
     # generation (printed in the SUPERVISOR summary path)
     assert "TELEMETRY-MERGED ranks=2" in out, out[-3000:]
+    # trace-id continuity across the SIGKILL restart (ISSUE 11 satellite):
+    # every requeued job's post-restart journal records carry the SAME
+    # trace id its pre-crash submit minted (replay preserves it) — the
+    # launcher audits the whole journal and attests it; a severed chain
+    # fails the run
+    assert "SCHED-TRACE-CONTINUITY jobs=20 ok=True" in out, out[-3000:]
+    # ...and the launcher rendered a requeued job's assembled causal
+    # timeline: one trace id spanning BOTH generations' records
+    assert "causal timeline for trace" in out, out[-3000:]
 
 
 @pytest.mark.heavy

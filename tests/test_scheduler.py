@@ -975,3 +975,170 @@ class TestSupervisorJobsSection:
                              job_journal=path).run()
         assert res.ok
         assert "error" in res.jobs and "replay failed" in res.jobs["error"]
+
+
+# ---------------------------------------------------------------------- #
+# trace propagation (ISSUE 11): trace identity minted at submission,
+# journaled with every record, preserved by replay across restarts
+# ---------------------------------------------------------------------- #
+class TestTracePropagation:
+    def test_trace_id_minted_deterministically_at_submit(self):
+        """Every rank of an SPMD world must derive the IDENTICAL id for the
+        same job — so the mint is a pure function of the job identity, not
+        process entropy (two independent schedulers agree)."""
+        s1, s2 = S.Scheduler(_stub_executor()), S.Scheduler(_stub_executor())
+        s1.submit(S.Job("j1", "matmul", tenant="acme"))
+        s2.submit(S.Job("j1", "matmul", tenant="acme"))
+        tid = s1._jobs["j1"].trace_id
+        assert tid and tid == s2._jobs["j1"].trace_id
+        assert tid == S.job_trace_id("j1", "matmul", "acme")
+
+    def test_client_supplied_trace_id_adopted(self):
+        s = S.Scheduler(_stub_executor())
+        s.submit(S.Job("j1", "matmul", trace_id="feedface00000001"))
+        assert s._jobs["j1"].trace_id == "feedface00000001"
+
+    def test_every_journal_record_carries_the_tid(self, tmp_path):
+        path = str(tmp_path / "sched_journal.jsonl")
+        s = S.Scheduler(_stub_executor(), journal=path, max_queue=1)
+        s.submit(S.Job("j1", "solve"))
+        with pytest.raises(S.JobRejected):
+            s.submit(S.Job("over", "solve"))  # shed: its record has a tid too
+        s.run()
+        replay = S.replay_journal(path)
+        tid = S.job_trace_id("j1", "solve", "default")
+        by_type = {}
+        for rec in replay["records"]:
+            if rec.get("id") == "j1":
+                by_type[rec["type"]] = rec.get("tid")
+        assert by_type == {
+            "submitted": tid, "dispatched": tid, "done": tid,
+        }
+        shed = [r for r in replay["records"] if r.get("id") == "over"]
+        assert shed and shed[0]["tid"] == S.job_trace_id(
+            "over", "solve", "default"
+        )
+
+    def test_recover_preserves_trace_id_across_generations(self, tmp_path):
+        """Satellite acceptance: a requeued job carries the SAME trace_id
+        pre- and post-restart — journal replay preserves it, and the
+        requeue record itself is journaled with it."""
+        path = str(tmp_path / "sched_journal.jsonl")
+        s = S.Scheduler(None, journal=path)
+        s.submit(S.Job("j1", "kmeans", tenant="globex"))
+        tid = s._jobs["j1"].trace_id
+        # "restart": a fresh scheduler (fresh process in real life) replays
+        s2 = S.Scheduler(_stub_executor(), journal=S.JobJournal(path))
+        assert s2.recover(path) == 1
+        assert s2._jobs["j1"].trace_id == tid
+        s2.run()
+        cont = S.trace_continuity(S.replay_journal(path))
+        assert cont["ok"] and cont["jobs"] >= 1, cont
+
+    def test_trace_continuity_flags_a_severed_chain(self, tmp_path):
+        path = str(tmp_path / "sched_journal.jsonl")
+        j = S.JobJournal(path)
+        j.append({"type": S.SUBMITTED, "id": "j1", "kind": "matmul",
+                  "tid": "aaaa000000000000"})
+        j.append({"type": S.DISPATCHED, "id": "j1", "seq": 1, "attempt": 1,
+                  "tid": "bbbb000000000000"})  # re-minted: the violation
+        cont = S.trace_continuity(S.replay_journal(path))
+        assert not cont["ok"] and cont["violations"] == ["j1"]
+
+    def test_trace_continuity_flags_a_dropped_tid(self, tmp_path):
+        """A record that LOSES the tid on a traced job is a severed chain
+        too (the likeliest regression: a write path forgetting the field);
+        a wholly tid-less journal — the pre-trace schema — is simply
+        untraced, not a violation."""
+        path = str(tmp_path / "sched_journal.jsonl")
+        j = S.JobJournal(path)
+        j.append({"type": S.SUBMITTED, "id": "j1", "kind": "matmul",
+                  "tid": "aaaa000000000000"})
+        j.append({"type": S.DISPATCHED, "id": "j1", "seq": 1, "attempt": 1})
+        j.append({"type": S.SUBMITTED, "id": "old1", "kind": "matmul"})
+        j.append({"type": S.DONE, "id": "old1"})  # pre-trace records: fine
+        cont = S.trace_continuity(S.replay_journal(path))
+        assert not cont["ok"] and cont["violations"] == ["j1"]
+
+    def test_offered_untouched_when_the_journal_append_fails(self, tmp_path):
+        """offered counts at the same point as accepted/shed — a
+        sched.journal.write failure leaves the whole ledger untouched, so
+        offered = accepted + shed survives journal faults (the /metrics
+        reconciliation)."""
+        s = S.Scheduler(_stub_executor(), journal=str(tmp_path / "j.jsonl"),
+                        max_queue=1)
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(faults.TransientFault):
+                s.submit(S.Job("a", "matmul"))
+        c = S.counters()
+        assert c.get("sched.offered", 0) == 0
+        s.submit(S.Job("a", "matmul"))  # the retry succeeds and counts once
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(faults.TransientFault):
+                s.submit(S.Job("b", "matmul"))  # _shed's append fails
+        c = S.counters()
+        assert c["sched.offered"] == 1
+        assert c["sched.offered"] == c["sched.accepted"] + c.get("sched.shed", 0)
+
+    def test_dispatch_arms_the_tracing_context(self):
+        """The executor runs under telemetry.tracing(head.trace_id): spans
+        recorded inside the dispatch carry the job's id, and the sched.job
+        completion event carries each job's own id."""
+        from heat_tpu.utils import telemetry
+
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            seen = {}
+
+            def execute(jobs):
+                seen["ambient"] = telemetry.current_trace_id()
+                with telemetry.span("exec.work"):
+                    pass
+                return [None] * len(jobs)
+
+            s = S.Scheduler(execute)
+            s.submit(S.Job("j1", "matmul"))
+            s.run()
+            tid = S.job_trace_id("j1", "matmul", "default")
+            assert seen["ambient"] == tid
+            recs = {r[0]: r[5] for r in telemetry._ring}
+            assert recs["exec.work"]["trace_id"] == tid
+            assert recs["sched.job"]["trace_id"] == tid
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_offered_reconciles_with_accepted_plus_shed(self):
+        s = S.Scheduler(_stub_executor(), max_queue=2)
+        s.submit(S.Job("a", "matmul"))
+        s.submit(S.Job("b", "matmul"))
+        with pytest.raises(S.JobRejected):
+            s.submit(S.Job("c", "matmul"))
+        c = S.counters()
+        assert c["sched.offered"] == 3
+        assert c["sched.offered"] == c["sched.accepted"] + c["sched.shed"]
+        # duplicates raise BEFORE being offered: neither side of the ledger
+        with pytest.raises(ValueError):
+            s.submit(S.Job("a", "matmul"))
+        assert S.counters()["sched.offered"] == 3
+
+    def test_monitor_gauge_source_reports_queue_state(self):
+        """The scheduler registers a weakly-held gauge source with
+        utils.monitor: queue depth + per-tenant in-flight, pruned once the
+        scheduler is collected."""
+        import gc
+
+        from heat_tpu.utils import monitor
+
+        s = S.Scheduler(_stub_executor(), max_queue=8)
+        s.submit(S.Job("a", "matmul", tenant="acme"))
+        s.submit(S.Job("b", "matmul", tenant="globex"))
+        text = monitor.metrics_text()
+        assert "sched_queue_depth 2" in text, text
+        assert "sched_inflight_acme 1" in text
+        assert "sched_inflight_globex 1" in text
+        del s
+        gc.collect()
+        text = monitor.metrics_text()  # dead source pruned, no crash
+        assert "sched_queue_depth" not in text

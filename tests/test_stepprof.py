@@ -1,0 +1,190 @@
+"""Step-time breakdown + trace timeline (ISSUE 11 tentpole part 3).
+
+``scripts/stepprof.py`` decomposes step spans into compute / comm-wait /
+host-sync / idle and reports the overlap fraction; ``scripts/
+telemetry_report.py --trace`` assembles one trace id's causal timeline
+across spans, scheduler journals and flight-recorder rings.  Both are
+stdlib-only CLIs — tested here against synthetic and real artifacts.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, relpath):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sp = _load("stepprof_under_test", "scripts/stepprof.py")
+trep = _load("telemetry_report_under_test", "scripts/telemetry_report.py")
+
+
+def _span(name, ts, dur, rank=0, depth=0, attrs=None):
+    rec = {"type": "span", "rank": rank, "name": name, "ts": ts,
+           "dur_s": dur, "depth": depth}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+class TestClassification:
+    def test_host_beats_comm_beats_compute(self):
+        assert sp.classify("comm.host_fetch.wait") == sp._HOST
+        assert sp.classify("io.save_checkpoint") == sp._HOST
+        assert sp.classify("comm.resplit") == sp._COMM
+        assert sp.classify("comm.Wait.wait") == sp._COMM
+        assert sp.classify("sched.dispatch.matmul.wait") == sp._COMM
+        assert sp.classify("dispatch.binary") == sp._COMPUTE
+        assert sp.classify("daso.blend") == sp._COMPUTE
+
+
+class TestBreakdown:
+    SPANS = [
+        _span("daso.step", 0.00, 0.10),
+        _span("dispatch.binary", 0.01, 0.03, depth=1),
+        _span("comm.Wait.wait", 0.05, 0.02, depth=1),
+        _span("comm.host_fetch.wait", 0.08, 0.01, depth=1),
+        _span("comm.resplit", 0.12, 0.04),   # between the two steps
+        _span("daso.step", 0.20, 0.05),
+    ]
+
+    def test_window_sweep_and_classes(self):
+        rows = sp.step_breakdown(self.SPANS, ("daso.step",))
+        assert len(rows) == 2
+        r = rows[0]
+        # window [0, 0.2): step span is compute minus the overlapped
+        # comm/host leaves; the inter-step resplit charges to this step
+        assert abs(r["comm_wait_s"] - 0.06) < 1e-9
+        assert abs(r["host_sync_s"] - 0.01) < 1e-9
+        assert abs(r["compute_s"] - 0.07) < 1e-9
+        assert abs(r["idle_s"] - 0.06) < 1e-9
+        assert abs(r["total_s"] - 0.20) < 1e-9
+        assert abs(r["overlap_fraction"] - 0.7) < 1e-3
+        # the final step has no trailing records: window = its own span
+        assert rows[1]["overlap_fraction"] == 1.0
+
+    def test_nested_records_never_double_count(self):
+        spans = [
+            _span("optim.step", 0.0, 0.1),
+            _span("comm.resplit", 0.02, 0.04, depth=1),
+            # a wait INSIDE the resplit span: the sweep must charge the
+            # overlap region once (comm), not twice
+            _span("comm.resplit.tile.wait", 0.03, 0.02, depth=2),
+        ]
+        (r,) = sp.step_breakdown(spans, ("optim.step",))
+        assert abs(r["comm_wait_s"] - 0.04) < 1e-9
+
+    def test_ranks_decompose_independently(self):
+        spans = [
+            _span("sched.job", 0.0, 0.1, rank=0),
+            _span("sched.job", 0.0, 0.2, rank=1),
+            _span("comm.Wait.wait", 0.05, 0.1, rank=1),
+        ]
+        rows = sp.step_breakdown(spans, ("sched.job",))
+        by_rank = {r["rank"]: r for r in rows}
+        assert by_rank[0]["comm_wait_s"] == 0.0
+        assert abs(by_rank[1]["comm_wait_s"] - 0.1) < 1e-9
+
+    def test_aggregate_totals_and_marker(self):
+        rows = sp.step_breakdown(self.SPANS, ("daso.step",))
+        (agg,) = sp.aggregate(rows)
+        assert agg["steps"] == 2
+        assert abs(agg["total_s"] - 0.25) < 1e-9
+        assert abs(agg["comm_wait_s"] - 0.06) < 1e-9
+        text = sp.render(rows)
+        assert "STEP-OVERLAP kind=daso.step steps=2 overlap=" in text
+        assert "comm_wait_ms=60.0" in text
+
+    def test_no_steps_empty_section(self):
+        assert sp.overlap_section([_span("dispatch.binary", 0, 0.1)]) == ""
+        assert sp.step_breakdown([], ()) == []
+
+
+class TestCLI:
+    def test_main_end_to_end(self, tmp_path, capsys):
+        d = str(tmp_path)
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            for rec in TestBreakdown.SPANS:
+                fh.write(json.dumps(rec) + "\n")
+        out_json = str(tmp_path / "steps.json")
+        assert sp.main([d, "--per-step", "5", "--json", out_json]) == 0
+        out = capsys.readouterr().out
+        assert "STEP-OVERLAP kind=daso.step" in out
+        payload = json.load(open(out_json))
+        assert len(payload["steps"]) == 2 and payload["aggregate"]
+
+    def test_main_no_files_exits_1(self, tmp_path, capsys):
+        assert sp.main([str(tmp_path / "void")]) == 1
+
+    def test_main_no_step_spans_exits_0(self, tmp_path, capsys):
+        with open(os.path.join(str(tmp_path), "rank0.jsonl"), "w") as fh:
+            fh.write(json.dumps(_span("dispatch.binary", 0, 0.1)) + "\n")
+        assert sp.main([str(tmp_path)]) == 0
+        assert "no step spans" in capsys.readouterr().out
+
+
+class TestReportIntegration:
+    def test_overlap_section_rides_the_merged_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            for rec in TestBreakdown.SPANS:
+                fh.write(json.dumps(rec) + "\n")
+        assert trep.main([d, "--timeline", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "step-time breakdown" in out
+        assert "STEP-OVERLAP kind=daso.step" in out
+
+    def test_trace_timeline_across_spans_and_journal(self, tmp_path, capsys):
+        """--trace assembles one id's records from BOTH the telemetry
+        spans and a scheduler journal into one time-ordered table."""
+        d = str(tmp_path)
+        tid = "feedface00000001"
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            fh.write(json.dumps(_span(
+                "sched.job", 100.0, 0.05,
+                attrs={"trace_id": tid, "kind": "matmul", "outcome": "done"},
+            )) + "\n")
+            fh.write(json.dumps(_span("unrelated", 100.1, 0.01)) + "\n")
+        sched = _load("sched_for_trace", "heat_tpu/parallel/scheduler.py")
+        j = sched.JobJournal(os.path.join(d, "sched_journal.jsonl"))
+        j.append({"type": "submitted", "id": "j1", "tid": tid, "t": 99.9})
+        j.append({"type": "done", "id": "j1", "tid": tid, "t": 100.1})
+        j.append({"type": "submitted", "id": "other",
+                  "tid": "0000000000000000", "t": 99.95})
+        assert trep.main([d, "--trace", tid]) == 0
+        out = capsys.readouterr().out
+        assert f"causal timeline for trace {tid}" in out
+        assert "submitted id=j1" in out and "done id=j1" in out
+        assert "span sched.job" in out
+        assert "other" not in out and "unrelated" not in out
+        # ordered: the journal submit precedes the span
+        assert out.index("submitted id=j1") < out.index("span sched.job")
+
+    def test_trace_timeline_reads_flight_rings(self, tmp_path, capsys):
+        d = str(tmp_path)
+        tid = "feedface00000002"
+        fr = _load("flightrec_for_trace", "heat_tpu/utils/flightrec.py")
+        rec = fr.FlightRecorder(os.path.join(d, "flight_rank0.ring"), rank=0)
+        rec.record("coll", seq=1, op="resplit", wire=1024, tid=tid)
+        rec.record("coll", seq=2, op="resplit", wire=1024)  # untraced
+        rec.record("job", id="j1", state="done", tid=tid)
+        rec.close()
+        assert trep.main([d, "--trace", tid]) == 0
+        out = capsys.readouterr().out
+        assert "collective seq=1 op=resplit wire=1024B" in out
+        assert "seq=2" not in out
+        assert "job id=j1 state=done" in out
+
+    def test_trace_unknown_id_says_so(self, tmp_path, capsys):
+        assert trep.main([str(tmp_path), "--trace", "deadbeef00000000"]) == 0
+        assert "no records found" in capsys.readouterr().out

@@ -5,6 +5,7 @@ timer, weakref pruning)."""
 import gc
 import json
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -606,3 +607,167 @@ class TestServingSLOReport:
             fh.write(json.dumps({"type": "meta", "schema": 99}) + "\n")
         section = trep.slo_section([d])
         assert "unreadable" in section  # named, not crashed
+
+
+# ---------------------------------------------------------------------- #
+# trace propagation (ISSUE 11 tentpole): contextvar-carried trace identity
+# ---------------------------------------------------------------------- #
+class TestTracing:
+    def test_spans_carry_trace_and_parent_ids(self):
+        telemetry.enable()
+        with telemetry.tracing(name="t") as tid:
+            with telemetry.span("outer"):
+                with telemetry.span("inner"):
+                    pass
+        recs = {r[0]: r[5] for r in telemetry._ring}
+        assert recs["outer"]["trace_id"] == tid
+        assert recs["inner"]["trace_id"] == tid
+        assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+        assert "parent_id" not in recs["outer"]  # the trace root
+
+    def test_untraced_spans_carry_no_trace_keys(self):
+        telemetry.enable()
+        with telemetry.span("plain", kind="x"):
+            pass
+        (rec,) = list(telemetry._ring)
+        assert rec[5] == {"kind": "x"}
+
+    def test_tracing_scopes_and_restores(self):
+        assert telemetry.current_trace_id() is None
+        with telemetry.tracing(trace_id="aaaa000000000000"):
+            assert telemetry.current_trace_id() == "aaaa000000000000"
+            with telemetry.tracing(trace_id="bbbb000000000000"):
+                assert telemetry.current_trace_id() == "bbbb000000000000"
+            assert telemetry.current_trace_id() == "aaaa000000000000"
+        assert telemetry.current_trace_id() is None
+
+    def test_mint_is_deterministic_per_process_sequence(self):
+        """SPMD contract: ranks executing the identical mint sequence
+        derive identical ids — the id depends only on (name, counter,
+        restart epoch), never on pid/time/entropy."""
+        seq0 = telemetry._trace_seq
+        a = telemetry.mint_trace_id("x")
+        telemetry._trace_seq = seq0
+        b = telemetry.mint_trace_id("x")
+        assert a == b and len(a) == 16
+        assert telemetry.mint_trace_id("x") != a  # counter advanced
+
+    def test_dispatch_records_inherit_the_ambient_trace(self):
+        x = ht.random.randn(16, 16, split=0)
+        _ = x + x  # compile outside
+        telemetry.enable()
+        telemetry.reset()
+        with telemetry.tracing(name="d") as tid:
+            _ = x + x
+        (rec,) = [r for r in telemetry._ring if r[0] == "dispatch.binary"]
+        assert rec[5]["trace_id"] == tid
+        assert rec[5]["op"] == "add"  # the op attrs still ride along
+
+    def test_record_event_inherits_and_parents_on_open_span(self):
+        telemetry.enable()
+        with telemetry.tracing(name="e") as tid:
+            with telemetry.span("outer"):
+                telemetry.record_event("leaf", 0.001)
+        recs = {r[0]: r[5] for r in telemetry._ring}
+        assert recs["leaf"]["trace_id"] == tid
+        assert recs["leaf"]["parent_id"] == recs["outer"]["span_id"]
+
+    def test_tracing_works_with_telemetry_disabled(self):
+        """The contextvar is independent of the span ring: the flight
+        recorder reads it even when nothing exports spans."""
+        with telemetry.tracing(trace_id="cccc000000000000"):
+            assert telemetry.current_trace_id() == "cccc000000000000"
+        assert len(telemetry._ring) == 0
+
+    def test_flush_exports_trace_attrs(self, tmp_path):
+        telemetry.enable()
+        with telemetry.tracing(name="f") as tid:
+            with telemetry.span("unit.traced"):
+                pass
+        path = telemetry.flush(str(tmp_path))
+        spans = [json.loads(line) for line in open(path)
+                 if json.loads(line).get("type") == "span"]
+        (rec,) = [s for s in spans if s["name"] == "unit.traced"]
+        assert rec["attrs"]["trace_id"] == tid
+        assert "span_id" in rec["attrs"]
+
+    def test_guarded_wait_leaf_event_lands_in_ring(self):
+        """health.guard_blocking's observed wait is BOTH a histogram
+        observation and a <what>.wait leaf record — the per-step position
+        the stepprof breakdown attributes from."""
+        from heat_tpu.utils import health
+
+        telemetry.enable()
+        telemetry.reset()
+        with telemetry.span("unit.step"):
+            health.guard_blocking(lambda: time.sleep(0.002), "unit.block")
+        names = _ring_names()
+        assert "unit.block.wait" in names
+        rep = telemetry.report()
+        assert rep["histograms"]["unit.block.wait"]["count"] == 1
+        # the wait counted as the step's CHILD time (not self-time)
+        (step,) = [r for r in telemetry._ring if r[0] == "unit.step"]
+        (wait,) = [r for r in telemetry._ring if r[0] == "unit.block.wait"]
+        assert step[3] <= step[2] - wait[2] + 1e-4
+
+
+class TestRingDropped:
+    def test_eviction_counted_and_surfaced(self):
+        telemetry.enable()
+        for _ in range(telemetry._ring.maxlen + 9):
+            telemetry.record_event("e", 1e-6)
+        assert telemetry.ring_dropped() == 9
+        rep = telemetry.report()
+        assert rep["counters"]["telemetry.ring.dropped"] == 9
+
+    def test_no_eviction_no_counter(self):
+        telemetry.enable()
+        telemetry.record_event("e", 1e-6)
+        assert telemetry.ring_dropped() == 0
+        assert "telemetry.ring.dropped" not in telemetry.report()["counters"]
+
+    def test_reset_zeroes_the_counter(self):
+        telemetry.enable()
+        for _ in range(telemetry._ring.maxlen + 1):
+            telemetry.record_event("e", 1e-6)
+        assert telemetry.ring_dropped() == 1
+        telemetry.reset()
+        assert telemetry.ring_dropped() == 0
+
+    def test_flush_exports_the_counter_and_cli_surfaces_it(self, tmp_path):
+        telemetry.enable()
+        for _ in range(telemetry._ring.maxlen + 3):
+            telemetry.record_event("e", 1e-6)
+        path = telemetry.flush(str(tmp_path))
+        counters = [json.loads(line) for line in open(path)
+                    if json.loads(line).get("type") == "counters"]
+        assert counters[-1]["values"]["telemetry.ring.dropped"] == 3
+
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report_drop",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "scripts", "telemetry_report.py"),
+        )
+        trep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(trep)
+        merged = trep.merge_files(trep.find_rank_files(str(tmp_path)))
+        assert merged["counters"]["telemetry.ring.dropped"] == 3
+        assert "telemetry.ring.dropped" in trep.render(merged)
+
+
+class TestHistogramP999:
+    def test_p999_present_and_monotone(self):
+        h = telemetry.Histogram("t")
+        for _ in range(2000):
+            h.observe(1e-4)
+        for _ in range(3):
+            h.observe(0.5)  # the deep tail
+        s = h.summary()
+        assert s["p999_s"] >= s["p99_s"] >= s["p90_s"]
+        # 3/2003 > 0.1% of mass: p99.9 must land in the tail bin
+        assert s["p999_s"] > 0.1
+
+    def test_empty_histogram_summary_unchanged(self):
+        assert telemetry.Histogram("t").summary() == {"count": 0}
