@@ -2,16 +2,23 @@
 
 Two halves, one contract set:
 
-- **heatlint** (:mod:`.framework`, :mod:`.rules`, and the interprocedural
-  engine :mod:`.callgraph` + :mod:`.summaries`): a plugin-based AST linter
-  (CLI: ``scripts/heatlint.py``) with lexical rules HT101–HT108 (host
+- **heatlint** (:mod:`.framework`, :mod:`.rules`, the interprocedural
+  engine :mod:`.callgraph` + :mod:`.summaries`, and the abstract-
+  interpretation layer :mod:`.absint`): a plugin-based AST linter
+  (CLI: ``scripts/heatlint.py``) with lexical rules HT101–HT109 (host
   syncs, SPMD-consistency, donation, byte-accounting, broadcast seeding,
-  metadata immutability, deadline scopes, seq-stamp choke point) and the
-  HT2xx family that propagates effect summaries through a package-wide
-  call graph (static desync, transitive host sync, interprocedural
-  use-after-donate, transitively undeadlined blocking) — each the static
-  twin of a runtime failure mode.  Gates CI against a committed baseline;
-  unresolved-call conclusions are downgraded to non-gating ``info``.
+  metadata immutability, deadline scopes, seq-stamp choke point, trace
+  identity), the HT2xx family that propagates effect summaries through a
+  package-wide call graph (static desync, transitive host sync,
+  interprocedural use-after-donate, transitively undeadlined blocking),
+  and the HT3xx family that reasons about *values* via a rank-taint
+  lattice + symbolic ``(gshape, split, dtype)`` metadata domain
+  (rank-tainted collective flow, split mismatch, payload asymmetry,
+  donation-size mismatch) — each the static twin of a runtime failure
+  mode.  The same pass emits the ``--split-inventory`` catalog of every
+  single-split-axis assumption (the mesh-refactor work list).  Gates CI
+  against a committed baseline; unresolved-call conclusions are
+  downgraded to non-gating ``info``.
 - **runtime sanitizer** (:mod:`heat_tpu.core.sanitation`, armed by
   ``HEAT_TPU_CHECKS=1``): a metadata-only validator at the dispatch tails
   and factory/resplit boundaries — the dynamic complement for what the
@@ -38,12 +45,14 @@ from .framework import (
 )
 from . import callgraph  # noqa: F401
 from . import summaries  # noqa: F401
+from . import absint  # noqa: F401
 from . import rules  # noqa: F401  — registers the built-in rules on import
 
 __all__ = [
     "Finding",
     "LintContext",
     "Rule",
+    "absint",
     "all_rules",
     "callgraph",
     "disabled_rules_for",

@@ -605,9 +605,13 @@ class CallGraph:
 
     # ----------------- the resolver ----------------- #
 
-    def resolve(self, caller: FuncKey, desc: CallDesc) -> Resolution:
+    def resolve(self, caller: FuncKey, desc: CallDesc, record: bool = True) -> Resolution:
+        """Resolve one call site.  ``record=False`` skips the unresolved-
+        bucket append — for passes (absint) that re-resolve call sites the
+        effect-summary pass already audited, so the honesty bucket counts
+        each source-level call site once."""
         res = self._resolve(caller, desc)
-        if res.kind == "unresolved":
+        if res.kind == "unresolved" and record:
             self.unresolved.append(
                 {
                     "caller_path": caller[0],

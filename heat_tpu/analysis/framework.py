@@ -23,9 +23,11 @@ Vocabulary:
   suppressed; ``--write-baseline`` regenerates the file.
 
 Rules register themselves with :func:`register`; :mod:`.rules` holds the
-built-in set: the lexical rules HT101–HT108 and the interprocedural HT2xx
+built-in set: the lexical rules HT101–HT109, the interprocedural HT2xx
 family (which runs over a package-wide :class:`~.summaries.Program` built
-from :mod:`.callgraph` + :mod:`.summaries`).
+from :mod:`.callgraph` + :mod:`.summaries`), and the abstract-
+interpretation HT3xx family (rank-taint + array-metadata domains from
+:mod:`.absint`, linked through the same Program).
 
 Findings carry a ``severity``: ``"error"`` gates CI (and is what the
 baseline matches); ``"info"`` is the honesty downgrade for interprocedural
@@ -254,12 +256,15 @@ class Rule:
     implement :meth:`check` (per-file rules) or set ``program_level = True``
     and implement :meth:`check_program` (interprocedural rules, which
     receive the package-wide :class:`~.summaries.Program`), and decorate
-    with :func:`register`."""
+    with :func:`register`.  ``severity`` is the rule's DEFAULT finding
+    severity (individual findings may downgrade to ``info`` per the
+    unresolved-call honesty policy) — surfaced by ``--list-rules``."""
 
     code: str = "HT000"
     name: str = "unnamed"
     description: str = ""
     program_level: bool = False
+    severity: str = "error"
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -279,15 +284,32 @@ def register(cls: Type[Rule]) -> Type[Rule]:
 
 
 def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
-    """Instantiate registered rules (ensures built-ins are imported)."""
+    """Instantiate registered rules (ensures built-ins are imported).
+
+    ``select`` entries may end in ``*`` to match a code prefix
+    (``HT3*`` → HT301–HT304); a wildcard matching nothing is an error,
+    like an unknown literal code — a typo must not silently select
+    zero rules."""
     from . import rules as _builtin  # noqa: F401  (import side effect: registration)
 
     codes = sorted(_REGISTRY)
     if select:
-        wanted = {c.strip().upper() for c in select}
-        unknown = wanted - set(codes)
-        if unknown:
-            raise ValueError(f"unknown rule code(s): {sorted(unknown)} (have {codes})")
+        wanted: set = set()
+        for raw in select:
+            pat = raw.strip().upper()
+            if pat.endswith("*"):
+                hits = {c for c in codes if c.startswith(pat[:-1])}
+                if not hits:
+                    raise ValueError(
+                        f"rule pattern {raw!r} matches no registered rule (have {codes})"
+                    )
+                wanted |= hits
+            else:
+                if pat not in codes:
+                    raise ValueError(
+                        f"unknown rule code(s): {[pat]} (have {codes})"
+                    )
+                wanted.add(pat)
         codes = [c for c in codes if c in wanted]
     return [_REGISTRY[c]() for c in codes]
 
@@ -390,13 +412,16 @@ def lint_paths(
     select: Optional[Sequence[str]] = None,
     cache_path: Optional[str] = None,
     unresolved_out: Optional[List[dict]] = None,
+    split_inventory_out: Optional[List[dict]] = None,
 ) -> List[Finding]:
     """Lint ``paths`` with every selected rule — ONE parse + ONE walk index
     per file shared by all lexical rules AND the interprocedural passes,
     which additionally share the summary cache at ``cache_path`` (keyed by
     file content hash; None disables caching).  When ``unresolved_out`` is
     given, the call graph's unresolved bucket (every unresolvable call with
-    its reason — the honesty policy's audit trail) is appended to it."""
+    its reason — the honesty policy's audit trail) is appended to it.
+    When ``split_inventory_out`` is given, the absint layer's catalog of
+    every split-semantics site (the mesh-refactor work list) is appended."""
     rules = all_rules(select)
     file_rules = [r for r in rules if not r.program_level]
     program_rules = [r for r in rules if r.program_level]
@@ -413,7 +438,8 @@ def lint_paths(
             if rule.code in disabled:
                 continue
             findings.extend(f for f in rule.check(ctx) if f is not None)
-    if program_rules and contexts:
+    need_program = bool(program_rules) or split_inventory_out is not None
+    if need_program and contexts:
         from . import summaries as _summaries  # lazy: only when HT2xx selected
 
         program = _summaries.build_program(contexts, cache_path=cache_path)
@@ -424,6 +450,8 @@ def lint_paths(
                 findings.append(f)
         if unresolved_out is not None:
             unresolved_out.extend(program.graph.unresolved)
+        if split_inventory_out is not None:
+            split_inventory_out.extend(program.absint.inventory)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

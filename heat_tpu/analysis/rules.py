@@ -39,6 +39,33 @@ HT2xx findings carry the full call-chain trace (``entry → helper →
 sink``); conclusions that depend on an *unresolved* call (getattr
 dispatch, lambdas, callables passed as values) are downgraded to ``info``
 severity — reported, never gating, never a false positive.
+
+The HT3xx family reasons about *values* with the abstract-interpretation
+layer (:mod:`.absint`): a rank-taint lattice plus a symbolic
+``(gshape, split, dtype)`` array-metadata domain, each the static twin of
+a runtime conviction the observability PRs made nameable:
+
+- HT301 — rank-tainted dataflow reaching collective control or arguments:
+  a value *provably derived from process identity* guards a branch/loop
+  that stages collectives, bounds a loop enclosing one, or is passed as a
+  collective argument (the dataflow generalization of lexical HT102 and
+  call-borne HT201 — ``n = comm.rank; if n == 0: _stage()`` is invisible
+  to both) — front-runs postmortem's ``desync`` verdict
+- HT302 — split mismatch at a binary-op/matmul site provable from the
+  propagated metadata: the dispatch tail will raise or silently stage a
+  communication-heavy implicit resplit — front-runs the dispatch
+  ValueError / resplit warning
+- HT303 — collective payload asymmetry: the staged payload's abstract
+  ``gshape``/``dtype`` depends on rank-tainted data, so per-rank
+  fingerprints (seq, op, gshape, dtype) cannot agree — front-runs the
+  flight recorder's fingerprint-mismatch conviction
+- HT304 — donation-size mismatch: a donated buffer's abstract
+  shape/dtype differs from the consumer it must alias with — front-runs
+  the donated-buffer RuntimeError
+
+HT3xx findings fire only on *provable* rank derivation (``unknown`` — a
+value of unanalyzable origin — never gates), and carry codeFlow traces
+like the HT2xx family.
 """
 
 from __future__ import annotations
@@ -1225,4 +1252,375 @@ class TransitiveUndeadlinedBlockingRule(Rule):
                     trace=_trace_dicts(rep.chain),
                 )
             )
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT3xx — the abstract-interpretation family (absint rank-taint + metadata)
+# -------------------------------------------------------------------- #
+
+
+@register
+class RankTaintedCollectiveFlowRule(Rule):
+    """Rank-tainted dataflow reaching collective control or arguments.
+
+    HT102 matches ``comm.rank`` lexically in a branch test; HT201 compares
+    footprints across such branches through calls.  Both are blind to the
+    *value* flowing: ``n = comm.rank; if n == 0: _stage()`` — or a helper
+    whose loop bound is a rank-derived argument — stages a different
+    collective count per rank and desynchronizes the world exactly like
+    the lexical shapes.  The absint taint lattice proves the derivation
+    and this rule fires on three sink classes:
+
+    - a branch/while whose test is rank-tainted and whose arms stage
+      different collective traffic (lexically or via resolved calls);
+    - a for-loop whose bound is rank-tainted and whose body stages
+      collectives — a per-rank *count* divergence;
+    - a rank-tainted value passed directly as a collective argument
+      (``Bcast(..., root=comm.rank)``: every rank nominates itself).
+
+    Interprocedural: a function whose *parameter* reaches such a sink
+    becomes a summary; call sites passing a provably rank-derived argument
+    fire here with the full chain.  Only provable rank derivation gates —
+    a value of unknown origin never fires (the honesty policy, value
+    edition)."""
+
+    code = "HT301"
+    name = "rank-tainted-collective-flow"
+    description = "rank-derived value controls or feeds a collective (dataflow SPMD divergence)"
+    program_level = True
+
+    _KIND_TEXT = {
+        "if": "a branch",
+        "while": "a while-loop",
+        "for": "a for-loop bound",
+    }
+
+    def check_program(self, program) -> Iterable[Finding]:
+        view = program.absint
+        out: List[Finding] = []
+        seen: Set[Tuple] = set()
+
+        def emit(path, qual, line, message, detail, trace):
+            if program.is_suppressed(self.code, path, line):
+                return
+            dk = (path, line, detail)
+            if dk in seen:
+                return
+            seen.add(dk)
+            out.append(
+                Finding(
+                    rule=self.code,
+                    path=path,
+                    line=line,
+                    col=0,
+                    message=message,
+                    qualname=qual,
+                    detail=detail,
+                    severity="error",
+                    trace=trace,
+                )
+            )
+
+        for key in sorted(view.functions):
+            rec = view.functions[key]
+            path, qual = key
+            # direct sinks: the shared enumeration (absint.sink_candidates)
+            # also feeds the param-sink summaries, so the two stay in step
+            for cand in view.sink_candidates(key):
+                v = view.resolve_tokens(key, cand["tokens"])
+                if not v.rank:
+                    continue
+                witness = cand["colls"][0]
+                if cand["kind"] == "coll-arg":
+                    message = (
+                        f"collective `{witness}` receives a rank-derived value "
+                        f"({cand['role']}): each rank passes a DIFFERENT value "
+                        "where the collective contract requires agreement "
+                        "(root/count/shape arguments must be rank-uniform)"
+                    )
+                    detail = f"{witness}:{cand['role']}"
+                else:
+                    message = (
+                        f"{self._KIND_TEXT[cand['kind']]} controlled by a "
+                        f"rank-derived value stages collective `{witness}`: "
+                        "ranks compute different values from process identity, "
+                        "take different paths, and post different collective "
+                        "sequences — the dataflow shape lexical HT102/HT201 "
+                        "cannot see"
+                    )
+                    detail = f"{witness}@{cand['kind']}"
+                emit(
+                    path, qual, cand["line"], message, detail,
+                    trace=[{"path": path, "qualname": qual, "line": cand["line"]}],
+                )
+            # interprocedural: rank-derived argument into a param sink
+            for cid, call in enumerate(rec["calls"]):
+                r = view.resolved[key][cid]
+                if r.kind != "resolved" or r.target == key:
+                    continue
+                callee_sinks = view.param_sinks.get(r.target)
+                if not callee_sinks:
+                    continue
+                for p in sorted(callee_sinks):
+                    tokens = view._call_arg_tokens(call, r.target, p)
+                    if not tokens:
+                        continue
+                    v = view.resolve_tokens(key, tokens)
+                    if not v.rank:
+                        continue
+                    for s in callee_sinks[p]:
+                        witness = s["colls"][0] if s["colls"] else "collective"
+                        chain = [[path, qual, call["line"]]] + list(s["chain"])
+                        sink_path, sink_qual, sink_line = chain[-1]
+                        emit(
+                            path, qual, call["line"],
+                            f"rank-derived argument flows into `{r.target[1]}` "
+                            f"where it {'bounds' if s['kind'] == 'for' else 'controls'} "
+                            f"collective `{witness}` ({sink_path}:{sink_line}) — "
+                            f"{len(chain) - 1} call(s) deep: ranks passing different "
+                            "values stage different collective sequences",
+                            detail=f"{witness}@{r.target[1]}",
+                            trace=[
+                                {"path": hp, "qualname": hq, "line": hl}
+                                for hp, hq, hl in chain
+                            ],
+                        )
+        out.sort(key=lambda f: (f.path, f.line, f.detail))
+        return out
+
+
+@register
+class SplitMismatchRule(Rule):
+    """Split mismatch at a binary-op/matmul site, provable from propagated
+    metadata.  The dispatch tail reconciles mismatched splits with an
+    implicit ``resplit`` — a full redistribution of one operand, warned
+    about at runtime, communication-heavy, and invisible at the call site.
+    When the abstract metadata (tracked through factories, ``resplit``,
+    wrapper returns and binary-op promotion) proves both operands carry
+    *different concrete* split axes after broadcast alignment, the
+    redistribution (or, for paths that validate instead, the dispatch
+    ValueError) is a static certainty, not a possibility.  Operands whose
+    split is unknown or replicated never fire."""
+
+    code = "HT302"
+    name = "split-mismatch-binop"
+    description = "binary op on operands with provably different split axes"
+    program_level = True
+
+    def check_program(self, program) -> Iterable[Finding]:
+        view = program.absint
+        out: List[Finding] = []
+        for key in sorted(view.functions):
+            rec = view.functions[key]
+            path, qual = key
+            for site in rec["binop_sites"]:
+                if site["op"] in ("MatMult", "matmul", "dot"):
+                    # matmul supports every split pairing by design (the
+                    # reference's eight-case table in linalg/basics.py) —
+                    # mixed splits are a routing decision there, not the
+                    # elementwise implicit-resplit hazard
+                    continue
+                lm = view.concrete_meta(key, site["left"])
+                rm = view.concrete_meta(key, site["right"])
+                if lm is None or rm is None:
+                    continue
+                s1, s2 = lm["split"], rm["split"]
+                if not (isinstance(s1, int) and not isinstance(s1, bool)):
+                    continue
+                if not (isinstance(s2, int) and not isinstance(s2, bool)):
+                    continue
+                if lm["dims"] is None or rm["dims"] is None:
+                    # unknown RANK: broadcast alignment is undefined, and a
+                    # guessed ndim manufactures false mismatches — the
+                    # honesty policy applies to shapes too
+                    continue
+                d1, d2 = len(lm["dims"]), len(rm["dims"])
+                out_ndim = max(d1, d2)
+                al1, al2 = s1 + (out_ndim - d1), s2 + (out_ndim - d2)
+                if al1 == al2:
+                    continue
+                if program.is_suppressed(self.code, path, site["line"]):
+                    continue
+                out.append(
+                    Finding(
+                        rule=self.code,
+                        path=path,
+                        line=site["line"],
+                        col=0,
+                        message=(
+                            f"`{site['op']}` on operands with provably different "
+                            f"split axes ({s1} vs {s2}): the dispatch tail stages "
+                            "an implicit full redistribution of one operand "
+                            "(communication-heavy, warned only at runtime) — "
+                            "resplit explicitly at a chosen boundary instead"
+                        ),
+                        qualname=qual,
+                        detail=f"{site['op']}:split{s1}x{s2}",
+                        severity="error",
+                        trace=[{"path": path, "qualname": qual, "line": site["line"]}],
+                    )
+                )
+        out.sort(key=lambda f: (f.path, f.line, f.detail))
+        return out
+
+
+@register
+class CollectivePayloadAsymmetryRule(Rule):
+    """Collective payload asymmetry: the staged payload's abstract
+    ``gshape`` or ``dtype`` depends on rank-tainted data.  Lockstep SPMD
+    requires every rank's staged fingerprint ``(seq, op, gshape, dtype)``
+    to agree — the exact stream the flight recorder stamps at
+    ``_account_bytes`` and postmortem compares across ranks.  A payload
+    built as ``ht.zeros((comm.rank + 1, 4))`` (or with a rank-selected
+    dtype) makes the mismatch a static certainty: byte counts differ on
+    the wire and the collective corrupts or deadlocks.  Shapes of unknown
+    provenance never fire — only provable rank derivation gates."""
+
+    code = "HT303"
+    name = "collective-payload-asymmetry"
+    description = "collective payload whose gshape/dtype provably depends on rank"
+    program_level = True
+
+    def check_program(self, program) -> Iterable[Finding]:
+        view = program.absint
+        out: List[Finding] = []
+        for key in sorted(view.functions):
+            rec = view.functions[key]
+            path, qual = key
+            for site in rec["coll_sites"]:
+                roles = [(f"arg{i}", m) for i, m in enumerate(site["arg_metas"])] + [
+                    (f"kw:{k}", site["kw_metas"][k]) for k in sorted(site["kw_metas"])
+                ]
+                for role, meta in roles:
+                    cm = view.concrete_meta(key, meta)
+                    if cm is None:
+                        continue
+                    aspects = []
+                    if cm["shape_rank"]:
+                        aspects.append("gshape")
+                    if cm["dtype_rank"]:
+                        aspects.append("dtype")
+                    if not aspects:
+                        continue
+                    if program.is_suppressed(self.code, path, site["line"]):
+                        continue
+                    what = "/".join(aspects)
+                    out.append(
+                        Finding(
+                            rule=self.code,
+                            path=path,
+                            line=site["line"],
+                            col=0,
+                            message=(
+                                f"payload of collective `{site['name']}` has a "
+                                f"rank-derived {what}: ranks stage different "
+                                "fingerprints (seq, op, gshape, dtype) for the "
+                                "same sequence number — the exact mismatch the "
+                                "flight recorder convicts post-hoc; make the "
+                                "payload metadata rank-uniform"
+                            ),
+                            qualname=qual,
+                            detail=f"{site['name']}:{what}",
+                            severity="error",
+                            trace=[
+                                {"path": path, "qualname": qual, "line": site["line"]}
+                            ],
+                        )
+                    )
+        out.sort(key=lambda f: (f.path, f.line, f.detail))
+        return out
+
+
+@register
+class DonationSizeMismatchRule(Rule):
+    """Donation-size mismatch: a donated buffer's abstract metadata differs
+    from the consumer it must alias with.  XLA donation is an aliasing
+    contract — same shape, same dtype, or the alias silently fails (extra
+    copy) and the donated source is deleted anyway, so a later read raises
+    the donated-buffer RuntimeError while the intended in-place reuse never
+    happened.  Flagged when a call donates a buffer (lexical
+    ``donate=True``, a jit alias's ``donate_argnums``, or a callee that
+    donates the position — the HT103/HT203 vocabulary) AND an ``out=``
+    destination is present at the same site whose abstract
+    ``(gshape, dtype)`` provably differs from the donated buffer's."""
+
+    code = "HT304"
+    name = "donation-size-mismatch"
+    description = "donated buffer's abstract shape/dtype differs from its consumer's"
+    program_level = True
+
+    def check_program(self, program) -> Iterable[Finding]:
+        view = program.absint
+        out: List[Finding] = []
+        for key in sorted(view.functions):
+            rec = view.functions[key]
+            path, qual = key
+            for cid, call in enumerate(rec["calls"]):
+                donated = set()
+                if call["desc"].get("donate_kwarg"):
+                    donated.add(0)
+                r = view.resolved[key][cid]
+                if r.kind == "resolved":
+                    donated |= set(r.donates_override or ())
+                    donated |= set(program.donates.get(r.target, {}))
+                if not donated:
+                    continue
+                om = view.concrete_meta(key, call["kw_metas"].get("out"))
+                if om is None:
+                    continue
+                for p in sorted(donated):
+                    if p >= len(call["arg_metas"]):
+                        continue
+                    dm = view.concrete_meta(key, call["arg_metas"][p])
+                    if dm is None:
+                        continue
+                    mismatches = []
+                    dd, od = dm["dims"], om["dims"]
+                    if (
+                        dd is not None
+                        and od is not None
+                        and all(isinstance(x, int) and not isinstance(x, bool) for x in dd)
+                        and all(isinstance(x, int) and not isinstance(x, bool) for x in od)
+                        and dd != od
+                    ):
+                        mismatches.append(f"shape {tuple(dd)} vs {tuple(od)}")
+                    if (
+                        dm["dtype"] not in (None, "?")
+                        and om["dtype"] not in (None, "?")
+                        and dm["dtype"] != om["dtype"]
+                    ):
+                        mismatches.append(f"dtype {dm['dtype']} vs {om['dtype']}")
+                    if not mismatches:
+                        continue
+                    if program.is_suppressed(self.code, path, call["line"]):
+                        continue
+                    callee = (
+                        call["desc"].get("dotted")
+                        or call["desc"].get("attr")
+                        or "<call>"
+                    )
+                    out.append(
+                        Finding(
+                            rule=self.code,
+                            path=path,
+                            line=call["line"],
+                            col=0,
+                            message=(
+                                f"buffer donated to `{callee}` cannot alias its "
+                                f"consumer: {'; '.join(mismatches)} — XLA falls "
+                                "back to a copy AND deletes the donated source, "
+                                "so the in-place reuse never happens and any "
+                                "later read raises the donated-buffer "
+                                "RuntimeError"
+                            ),
+                            qualname=qual,
+                            detail=f"{callee}:arg{p}",
+                            severity="error",
+                            trace=[
+                                {"path": path, "qualname": qual, "line": call["line"]}
+                            ],
+                        )
+                    )
+        out.sort(key=lambda f: (f.path, f.line, f.detail))
         return out

@@ -28,7 +28,14 @@ never cascaded to that boundary's callers.
 Summaries are cached per file in ``.heatlint-summaries.json`` keyed by a
 content hash, so an unchanged file costs one hash, not one AST walk; the
 cross-file linking and fixpoint always re-run (they are cheap and depend on
-the whole file set).
+the whole file set).  The cache carries TWO version axes: ``version`` (the
+JSON layout) and ``schema`` (:data:`ANALYSIS_SCHEMA_REV` — the semantic
+revision of the cached facts).  A content hash alone cannot know that the
+*analysis* changed underneath an unchanged file: when a new pass adds fact
+atoms (the HT3xx absint records, for one), an old cache would silently
+serve summaries that lack them.  Bump ``ANALYSIS_SCHEMA_REV`` whenever the
+extracted fact vocabulary changes; any mismatch — like a corrupt file — is
+a miss, never an error.
 
 Stdlib-only and standalone-loadable, like the rest of ``analysis/``.
 """
@@ -39,7 +46,7 @@ import ast
 import hashlib
 import json
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .callgraph import (
@@ -55,7 +62,13 @@ from .callgraph import (
     last_attr,
 )
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2  # JSON layout of the cache file
+# Semantic revision of the cached per-file facts.  Bump whenever extraction
+# gains/changes fact atoms so pre-existing caches (keyed by file content
+# hash, which cannot see analyzer changes) become misses instead of
+# silently serving summaries that lack the new facts.
+# rev 2: absint records (rank-taint + array-metadata + split inventory)
+ANALYSIS_SCHEMA_REV = 2
 _EXPAND_CAP = 160  # atoms per expanded footprint before truncation
 _CHAIN_CAP = 12  # hops kept in a provenance chain
 
@@ -404,20 +417,28 @@ def file_hash(source: str) -> str:
     return hashlib.sha256(source.encode("utf-8")).hexdigest()
 
 
+def _empty_cache() -> dict:
+    return {"version": CACHE_VERSION, "schema": ANALYSIS_SCHEMA_REV, "files": {}}
+
+
 def load_cache(path: Optional[str]) -> dict:
     if not path or not os.path.exists(path):
-        return {"version": CACHE_VERSION, "files": {}}
+        return _empty_cache()
     try:
         with open(path, "r", encoding="utf-8") as fh:
             data = json.load(fh)
         if data.get("version") != CACHE_VERSION:
-            return {"version": CACHE_VERSION, "files": {}}
+            return _empty_cache()
+        if data.get("schema") != ANALYSIS_SCHEMA_REV:
+            # the analyzer changed underneath the cached facts: every entry
+            # is stale regardless of content hash — full miss
+            return _empty_cache()
         if not isinstance(data.get("files"), dict):
-            return {"version": CACHE_VERSION, "files": {}}
+            return _empty_cache()
         return data
     except (OSError, ValueError):
         # a corrupt cache is a cache miss, never an error
-        return {"version": CACHE_VERSION, "files": {}}
+        return _empty_cache()
 
 
 def save_cache(path: str, data: dict) -> None:
@@ -502,11 +523,20 @@ class Program:
     """Everything the HT2xx rules consume: contexts, facts, effects, the
     resolved call graph, and the fixpoint-propagated summaries."""
 
-    def __init__(self, contexts: dict, facts: dict, effects: dict, graph: CallGraph):
+    def __init__(
+        self,
+        contexts: dict,
+        facts: dict,
+        effects: dict,
+        graph: CallGraph,
+        absint_facts: Optional[dict] = None,
+    ):
         self.contexts = contexts  # path -> LintContext
         self.facts = facts  # path -> FileFacts
         self.effects = effects  # FuncKey -> effect dict
         self.graph = graph
+        self.absint_facts = absint_facts or {}  # path -> absint fact dict
+        self._absint_view = None
         # per function: list aligned with effects["calls"] of Resolution
         self.resolved: Dict[FuncKey, List[Resolution]] = {}
         # fixpoint results
@@ -531,6 +561,16 @@ class Program:
 
     def func(self, key: FuncKey):
         return self.graph.functions.get(key)
+
+    @property
+    def absint(self):
+        """The linked abstract-interpretation view (HT3xx's input), built
+        lazily on first access so HT2xx-only runs never pay for it."""
+        if self._absint_view is None:
+            from . import absint as _absint
+
+            self._absint_view = _absint.link(self)
+        return self._absint_view
 
     def is_public(self, key: FuncKey) -> bool:
         fn = self.func(key)
@@ -912,23 +952,37 @@ def _iter_atoms_outside_dlscope(atoms):
 
 def build_program(contexts: dict, cache_path: Optional[str] = None) -> Program:
     """contexts: path -> LintContext (syntax-clean files only)."""
+    from . import absint as _absint  # lazy: absint imports our vocabulary
+
     cache = load_cache(cache_path)
     files = cache["files"]
     facts: Dict[str, object] = {}
     effects: Dict[FuncKey, dict] = {}
+    absint_facts: Dict[str, dict] = {}
     dirty = False
     for path, ctx in contexts.items():
         h = file_hash(ctx.source)
         ent = files.get(ctx.path)
-        if ent is not None and ent.get("hash") == h:
+        # an entry missing the absint record predates the schema field's
+        # introduction (or was hand-edited): treat as a miss, like any
+        # other stale-schema artifact
+        if ent is not None and ent.get("hash") == h and "absint" in ent:
             ff = FileFacts.from_json(ent["facts"])
             eff = ent["effects"]
+            ai = ent["absint"]
         else:
             ff = extract_structure(ctx)
             eff = extract_effects(ctx)
-            files[ctx.path] = {"hash": h, "facts": ff.to_json(), "effects": eff}
+            ai = _absint.extract_absint(ctx)
+            files[ctx.path] = {
+                "hash": h,
+                "facts": ff.to_json(),
+                "effects": eff,
+                "absint": ai,
+            }
             dirty = True
         facts[ctx.path] = ff
+        absint_facts[ctx.path] = ai
         for qual, e in eff.items():
             effects[(ctx.path, qual)] = e
     # evict only entries whose file is GONE from disk: a narrow run (one
@@ -942,4 +996,4 @@ def build_program(contexts: dict, cache_path: Optional[str] = None) -> Program:
     if cache_path and dirty:
         save_cache(cache_path, cache)
     graph = CallGraph(facts)
-    return Program(contexts, facts, effects, graph)
+    return Program(contexts, facts, effects, graph, absint_facts=absint_facts)

@@ -45,9 +45,16 @@ def count_nonzero(x, axis=None, keepdims=False) -> DNDarray:
 
 
 def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
-    """Scalar closeness check (reference: local allclose + Allreduce)."""
+    """Scalar closeness check (reference: local allclose + Allreduce).
+
+    Returns a Python bool, so materialization is this function's contract:
+    the fetch goes through the sanctioned ``host_fetch`` (retried,
+    deadline-guarded, multi-process-correct) instead of a naked
+    ``.item()`` sync."""
+    from .communication import Communication
+
     res = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
-    return bool(all(res).item())
+    return bool(Communication.host_fetch(all(res)._jarray))
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False) -> DNDarray:
@@ -104,22 +111,26 @@ DNDarray.isclose = isclose
 
 def array_equal(a1, a2) -> bool:
     """True iff shapes match and all elements are equal (numpy semantics)."""
+    from .communication import Communication
+
     j1 = a1._jarray if isinstance(a1, DNDarray) else jnp.asarray(np.asarray(a1))
     j2 = a2._jarray if isinstance(a2, DNDarray) else jnp.asarray(np.asarray(a2))
     if j1.shape != j2.shape:
         return False
-    return bool(jnp.all(j1 == j2))
+    return bool(Communication.host_fetch(jnp.all(j1 == j2)))
 
 
 def array_equiv(a1, a2) -> bool:
     """True iff the inputs are broadcast-compatible and equal everywhere."""
+    from .communication import Communication
+
     j1 = a1._jarray if isinstance(a1, DNDarray) else jnp.asarray(np.asarray(a1))
     j2 = a2._jarray if isinstance(a2, DNDarray) else jnp.asarray(np.asarray(a2))
     try:
         jnp.broadcast_shapes(j1.shape, j2.shape)
     except ValueError:
         return False
-    return bool(jnp.all(j1 == j2))
+    return bool(Communication.host_fetch(jnp.all(j1 == j2)))
 
 
 def isin(element, test_elements, assume_unique: bool = False, invert: bool = False) -> DNDarray:

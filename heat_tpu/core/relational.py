@@ -16,14 +16,19 @@ def eq(t1, t2) -> DNDarray:
 
 
 def equal(t1, t2) -> bool:
-    """Scalar: True iff all elements equal (reference ``ht.equal``)."""
+    """Scalar: True iff all elements equal (reference ``ht.equal``).
+
+    Returns a Python bool — materialization is the contract, so the fetch
+    routes through the sanctioned ``host_fetch`` instead of a naked
+    ``.item()`` sync."""
+    from .communication import Communication
     from .logical import all as ht_all
 
     try:
         res = eq(t1, t2)
     except ValueError:
         return False
-    return bool(ht_all(res).item())
+    return bool(Communication.host_fetch(ht_all(res)._jarray))
 
 
 def ge(t1, t2) -> DNDarray:

@@ -114,13 +114,19 @@ def fix(x, out=None) -> DNDarray:
 
 
 def real_if_close(x, tol: float = 100.0) -> DNDarray:
-    """Drop an all-negligible imaginary part (numpy semantics)."""
+    """Drop an all-negligible imaginary part (numpy semantics).
+
+    The closeness verdict is inherently a host decision (it selects the
+    return TYPE), so the scalar fetch goes through the sanctioned
+    ``host_fetch`` instead of a naked ``bool()`` cast of a device value."""
+    from .communication import Communication
+
     j = x._jarray
     if not jnp.issubdtype(j.dtype, jnp.complexfloating):
         return x
     finf = jnp.finfo(j.real.dtype)
     thresh = tol * finf.eps if tol > 1 else tol  # numpy: absolute eps-scaled bound
-    if bool(jnp.all(jnp.abs(j.imag) < thresh)):
+    if bool(Communication.host_fetch(jnp.all(jnp.abs(j.imag) < thresh))):
         return _local_op(jnp.real, x)
     return x
 

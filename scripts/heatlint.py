@@ -6,7 +6,9 @@ Usage:
     python scripts/heatlint.py heat_tpu/ --json out.json    # machine output
     python scripts/heatlint.py heat_tpu/ --sarif out.sarif  # PR annotations
     python scripts/heatlint.py heat_tpu/ --write-baseline   # regenerate
-    python scripts/heatlint.py --list-rules
+    python scripts/heatlint.py heat_tpu/ --select HT3*      # prefix wildcard
+    python scripts/heatlint.py heat_tpu/ --split-inventory SPLIT_INVENTORY.json
+    python scripts/heatlint.py --list-rules                 # severity + level
 
 Exit codes: 0 = clean (no ERROR findings beyond the committed baseline),
 1 = new error findings, 2 = usage error.  ``info``-severity findings (the
@@ -29,6 +31,7 @@ from __future__ import annotations
 import argparse
 import importlib
 import importlib.util
+import json
 import os
 import sys
 import types
@@ -119,11 +122,25 @@ def main(argv=None) -> int:
         help="disable the interprocedural summary cache",
     )
     ap.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
+    ap.add_argument(
+        "--split-inventory",
+        metavar="FILE",
+        help="write the split-semantics site catalog (the mesh-refactor "
+        "work list: every .split read, split= kwarg, resplit* call, split "
+        "parameter) as JSON to FILE ('-' = stdout)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
+        # severity + program-level flag: a program-level rule consumes the
+        # package-wide Program (call graph + summaries + absint); a file
+        # rule sees one parsed module at a time
         for rule in all_rules():
-            print(f"{rule.code}  {rule.name:32s} {rule.description}")
+            level = "program" if rule.program_level else "file"
+            print(
+                f"{rule.code}  {rule.name:32s} [{level:7s}] [{rule.severity}]  "
+                f"{rule.description}"
+            )
         return 0
 
     if not args.paths:
@@ -132,9 +149,16 @@ def main(argv=None) -> int:
     select = [c for c in (args.select or "").split(",") if c.strip()] or None
     cache_path = None if args.no_cache else args.summaries_cache
     unresolved: list = []
+    split_inventory: list = []
     try:
         findings = lint_paths(
-            args.paths, select=select, cache_path=cache_path, unresolved_out=unresolved
+            args.paths,
+            select=select,
+            cache_path=cache_path,
+            unresolved_out=unresolved,
+            split_inventory_out=(
+                split_inventory if args.split_inventory else None
+            ),
         )
     except ValueError as exc:
         print(f"heatlint: {exc}", file=sys.stderr)
@@ -157,6 +181,34 @@ def main(argv=None) -> int:
             hop["path"] = _norm(hop["path"])
     for u in unresolved:
         u["caller_path"] = _norm(u["caller_path"])
+    for s in split_inventory:
+        s["path"] = _norm(s["path"])
+
+    if args.split_inventory:
+        by_kind: dict = {}
+        for s in split_inventory:
+            by_kind[s["kind"]] = by_kind.get(s["kind"], 0) + 1
+        catalog = json.dumps(
+            {
+                "version": 1,
+                "comment": (
+                    "Every site whose behavior depends on single-split-axis "
+                    "semantics — the named-axis mesh refactor's work list. "
+                    "The committed snapshot covers the full lint scope; "
+                    "regenerate with: python scripts/heatlint.py heat_tpu/ "
+                    "benchmarks/ tutorials/ --split-inventory SPLIT_INVENTORY.json"
+                ),
+                "count": len(split_inventory),
+                "by_kind": {k: by_kind[k] for k in sorted(by_kind)},
+                "sites": split_inventory,
+            },
+            indent=2,
+        )
+        if args.split_inventory == "-":
+            print(catalog)
+        else:
+            with open(args.split_inventory, "w", encoding="utf-8") as fh:
+                fh.write(catalog + "\n")
 
     # info findings (unresolved-call downgrades) are reported, never gated,
     # never baselined: a baseline entry would imply a human signed off on a

@@ -191,6 +191,57 @@ def launch_pytest(timeout: float = 1500.0, n_proc: int = 2,
     return results
 
 
+# ---------------------------------------------------------------------- #
+# known-flake retry harness (gloo `op.preamble.length` SIGABRT)
+# ---------------------------------------------------------------------- #
+# Documented pre-existing flake class (PR 7 notes; stash-verified on the
+# unmodified HEAD in PR 11): gloo's socket preamble read occasionally
+# trips its `op.preamble.length <= ...` assertion and SIGABRTs BOTH ranks
+# of a 2-proc world during rapid small-collective streams — an
+# environmental transport wedge, not a product failure.  The harness
+# below retries EXACTLY ONCE and ONLY when that signature is present:
+# a failure without the signature (or a second signatured failure in a
+# row) is real and propagates, so a red chaos lane means something again.
+
+GLOO_PREAMBLE_MARKERS = ("op.preamble.length",)
+FLAKE_RETRY_MARKER = "KNOWN-FLAKE-RETRY gloo-preamble"
+
+
+def is_known_gloo_preamble_flake(output: str) -> bool:
+    """True iff ``output`` carries the documented gloo preamble-assertion
+    signature.  Deliberately narrow: only the assertion text itself —
+    a generic SIGABRT or timeout does NOT qualify."""
+    return any(m in (output or "") for m in GLOO_PREAMBLE_MARKERS)
+
+
+def launch_retrying_known_flake(**kwargs):
+    """:func:`launch`, retried once iff the run failed WITH the gloo
+    preamble signature.  Returns the final CompletedProcess; the retry is
+    announced on stdout so CI logs show it happened."""
+    proc = launch(**kwargs)
+    failed = proc.returncode != 0 or PASS_MARKER not in (proc.stdout or "")
+    if failed and is_known_gloo_preamble_flake(
+        (proc.stdout or "") + (proc.stderr or "")
+    ):
+        print(f"{FLAKE_RETRY_MARKER} attempt=2", flush=True)
+        proc = launch(**kwargs)
+    return proc
+
+
+def launch_pytest_retrying_known_flake(**kwargs):
+    """:func:`launch_pytest`, retried once iff some rank failed WITH the
+    gloo preamble signature in its log (a rank failing without it is a
+    real failure and propagates immediately)."""
+    results = launch_pytest(**kwargs)
+    failed = [(rc, out) for rc, out in results if rc != 0]
+    # ANY failed rank with the signature qualifies: the preamble SIGABRT
+    # wedges the peer, whose own log then shows only the watchdog kill
+    if failed and any(is_known_gloo_preamble_flake(out) for _rc, out in failed):
+        print(f"{FLAKE_RETRY_MARKER} attempt=2", flush=True)
+        results = launch_pytest(**kwargs)
+    return results
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

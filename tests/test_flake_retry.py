@@ -1,0 +1,127 @@
+"""Unit tests for the gloo `op.preamble.length` known-flake retry harness
+(scripts/multiprocess_dryrun.py) — the chaos lane's red must mean
+something: the harness retries EXACTLY ONCE and ONLY on the documented
+signature; every other failure (and a second signatured failure)
+propagates untouched.  Pure monkeypatch tests — no subprocess worlds.
+"""
+
+import importlib.util
+import os
+from types import SimpleNamespace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "multiprocess_dryrun.py")
+
+_spec = importlib.util.spec_from_file_location("mpd_flake_retry", SCRIPT)
+mpd = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(mpd)
+
+
+def _proc(rc, stdout, stderr=""):
+    return SimpleNamespace(returncode=rc, stdout=stdout, stderr=stderr)
+
+
+GOOD = _proc(0, f"[0] ok\n{mpd.PASS_MARKER}\n")
+FLAKY = _proc(
+    134,
+    "terminate called after throwing an instance of "
+    "'gloo::EnforceNotMet'\nop.preamble.length <= op.nbytes. 292 vs 256\n",
+)
+REAL_FAIL = _proc(1, "AssertionError: resumed step mismatch\n")
+
+
+class TestSignature:
+    def test_preamble_assertion_matches(self):
+        assert mpd.is_known_gloo_preamble_flake(FLAKY.stdout)
+
+    def test_generic_failure_does_not_match(self):
+        assert not mpd.is_known_gloo_preamble_flake(REAL_FAIL.stdout)
+        assert not mpd.is_known_gloo_preamble_flake("")
+        assert not mpd.is_known_gloo_preamble_flake(None)
+        # a bare SIGABRT without the assertion text is NOT the known flake
+        assert not mpd.is_known_gloo_preamble_flake("Aborted (core dumped)")
+
+
+class TestLaunchRetry:
+    def test_green_run_launches_once(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(mpd, "launch", lambda **kw: (calls.append(kw), GOOD)[1])
+        proc = mpd.launch_retrying_known_flake(timeout=5, n_proc=2)
+        assert proc is GOOD and len(calls) == 1
+
+    def test_signatured_failure_retries_once_then_green(self, monkeypatch, capsys):
+        seq = [FLAKY, GOOD]
+        monkeypatch.setattr(mpd, "launch", lambda **kw: seq.pop(0))
+        proc = mpd.launch_retrying_known_flake(timeout=5)
+        assert proc is GOOD and not seq
+        assert mpd.FLAKE_RETRY_MARKER in capsys.readouterr().out
+
+    def test_second_signatured_failure_propagates(self, monkeypatch):
+        seq = [FLAKY, FLAKY, GOOD]
+        monkeypatch.setattr(mpd, "launch", lambda **kw: seq.pop(0))
+        proc = mpd.launch_retrying_known_flake(timeout=5)
+        assert proc is FLAKY  # exactly one retry: the third launch never ran
+        assert len(seq) == 1
+
+    def test_real_failure_never_retries(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            mpd, "launch", lambda **kw: (calls.append(kw), REAL_FAIL)[1]
+        )
+        proc = mpd.launch_retrying_known_flake(timeout=5)
+        assert proc is REAL_FAIL and len(calls) == 1
+
+    def test_missing_pass_marker_with_signature_retries(self, monkeypatch):
+        # rc 0 but no PASS marker AND the signature present (partial wedge)
+        half = _proc(0, "…\nop.preamble.length <= op.nbytes. 292 vs 256\n")
+        seq = [half, GOOD]
+        monkeypatch.setattr(mpd, "launch", lambda **kw: seq.pop(0))
+        assert mpd.launch_retrying_known_flake(timeout=5) is GOOD
+
+    def test_kwargs_passed_through_identically(self, monkeypatch):
+        calls = []
+        seq = [FLAKY, GOOD]
+        monkeypatch.setattr(
+            mpd, "launch", lambda **kw: (calls.append(kw), seq.pop(0))[1]
+        )
+        mpd.launch_retrying_known_flake(
+            timeout=9, n_proc=2, mode="train", extra_env={"A": "1"}
+        )
+        assert calls[0] == calls[1]
+        assert calls[0]["extra_env"] == {"A": "1"}
+
+
+class TestLaunchPytestRetry:
+    def test_green_ranks_launch_once(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            mpd,
+            "launch_pytest",
+            lambda **kw: (calls.append(kw), [(0, "55 passed"), (0, "55 passed")])[1],
+        )
+        results = mpd.launch_pytest_retrying_known_flake(timeout=5)
+        assert [rc for rc, _ in results] == [0, 0] and len(calls) == 1
+
+    def test_one_signatured_rank_retries_even_if_peer_log_lacks_it(
+        self, monkeypatch, capsys
+    ):
+        # the SIGABRT rank carries the signature; the wedged peer's log
+        # shows only the watchdog kill — the harness must still retry
+        bad = [
+            (134, "op.preamble.length <= op.nbytes. 292 vs 256"),
+            (-9, "watchdog: dumping stacks then killing"),
+        ]
+        seq = [bad, [(0, "55 passed"), (0, "55 passed")]]
+        monkeypatch.setattr(mpd, "launch_pytest", lambda **kw: seq.pop(0))
+        results = mpd.launch_pytest_retrying_known_flake(timeout=5)
+        assert [rc for rc, _ in results] == [0, 0]
+        assert mpd.FLAKE_RETRY_MARKER in capsys.readouterr().out
+
+    def test_real_rank_failure_never_retries(self, monkeypatch):
+        calls = []
+        bad = [(1, "FAILED tests/test_x.py::t - AssertionError"), (0, "ok")]
+        monkeypatch.setattr(
+            mpd, "launch_pytest", lambda **kw: (calls.append(kw), bad)[1]
+        )
+        results = mpd.launch_pytest_retrying_known_flake(timeout=5)
+        assert results is bad and len(calls) == 1

@@ -759,6 +759,7 @@ class TestFramework:
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
             "HT108", "HT109", "HT201", "HT202", "HT203", "HT204",
+            "HT301", "HT302", "HT303", "HT304",
         ]
 
     def test_select_unknown_rule_raises(self):

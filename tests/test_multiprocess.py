@@ -271,8 +271,16 @@ def test_serve_sigkill_mid_queue_loses_zero_jobs():
 # (-m 'not slow') lane skipping it loses no coverage
 def test_real_suite_subset_multiprocess():
     """>= 50 ordinary suite tests pass with 2 OS processes underneath
-    (VERDICT r4 weak #6 'no real suite subset runs multi-process')."""
-    results = mpd.launch_pytest(timeout=2800, n_proc=2, devs_per_proc=4)
+    (VERDICT r4 weak #6 'no real suite subset runs multi-process').
+
+    Launched through the known-flake retry harness: the 2-proc gloo world
+    is the other documented victim of the pre-existing
+    ``op.preamble.length`` SIGABRT (bisected flaky at the SEED) — a rank
+    failing WITH that signature retries the subset once; a failure
+    without it, or a second signatured failure, is real."""
+    results = mpd.launch_pytest_retrying_known_flake(
+        timeout=2800, n_proc=2, devs_per_proc=4
+    )
     assert len(results) == 2
     for rank, (rc, out) in enumerate(results):
         assert rc == 0, f"rank {rank}:\n{out[-3000:]}"
