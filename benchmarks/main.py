@@ -35,13 +35,13 @@ def bench_linalg() -> None:
     import heat_tpu as ht
 
     n = 2048
-    a = ht.random.randn(n, n, split=0)
-    b = ht.random.randn(n, n, split=1)
+    a = ht.random.randn(n, n, split=ht.axisspec.named(0))
+    b = ht.random.randn(n, n, split=ht.axisspec.named(1))
     _run("matmul_2048_s0xs1", lambda: a @ b)
-    ts = ht.random.randn(2**16, 64, split=0)
+    ts = ht.random.randn(2**16, 64, split=ht.axisspec.named(0))
     _run("tsqr_65536x64", lambda: ht.linalg.qr(ts).R)
     _run("hsvd_rank10_65536x64", lambda: ht.linalg.svdtools.hsvd_rank(ts, 10))
-    spd = ht.random.randn(512, 512, split=0)
+    spd = ht.random.randn(512, 512, split=ht.axisspec.named(0))
     M = spd @ spd.T + ht.eye(512) * 512.0
     v = ht.random.randn(512)
     _run("cg_512", lambda: ht.linalg.solver.cg(M, v, maxit=50))
@@ -50,7 +50,7 @@ def bench_linalg() -> None:
 def bench_cluster() -> None:
     import heat_tpu as ht
 
-    X = ht.random.randn(2**16, 32, split=0)
+    X = ht.random.randn(2**16, 32, split=ht.axisspec.named(0))
     _run("kmeans_65536x32_k16_10it",
          lambda: ht.cluster.KMeans(n_clusters=16, max_iter=10, tol=0.0, init="random", random_state=0).fit(X).inertia_)
     _run("cdist_4096x4096", lambda: ht.spatial.cdist(X[:4096], X[:4096], quadratic_expansion=True))
@@ -59,9 +59,9 @@ def bench_cluster() -> None:
 def bench_manipulations() -> None:
     import heat_tpu as ht
 
-    x = ht.random.randn(2**20, split=0)
+    x = ht.random.randn(2**20, split=ht.axisspec.named(0))
     _run("sort_1M", lambda: ht.sort(x)[0])
-    m = ht.random.randn(2048, 2048, split=0)
+    m = ht.random.randn(2048, 2048, split=ht.axisspec.named(0))
     _run("resplit_2048sq_0to1", lambda: m.resplit(1))
     _run("reshape_1M", lambda: x.reshape(1024, 1024))
 
@@ -69,7 +69,7 @@ def bench_manipulations() -> None:
 def bench_preprocessing() -> None:
     import heat_tpu as ht
 
-    X = ht.random.randn(2**18, 64, split=0)
+    X = ht.random.randn(2**18, 64, split=ht.axisspec.named(0))
     _run("standard_scaler_262kx64", lambda: ht.preprocessing.StandardScaler().fit(X).transform(X))
     _run("robust_scaler_262kx64", lambda: ht.preprocessing.RobustScaler().fit(X).transform(X))
 

@@ -9,6 +9,7 @@ flat namespace.
 
 from .core import *
 from . import core
+from .core import axisspec
 from .core import random
 from .core.redistribution import set_redistribution_budget, get_redistribution_budget
 from . import linalg

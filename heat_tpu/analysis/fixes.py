@@ -1,0 +1,996 @@
+"""heatfix — the proof-carrying autofix layer over heatlint's findings.
+
+The analyses can *prove* things (call-graph effect summaries, rank-taint +
+metadata abstract interpretation); this module closes the loop from proof
+to patch.  Each :class:`Fixer` is registered against one rule code,
+receives the finding plus the facts that produced it (the parsed
+:class:`~.framework.LintContext` and the package-wide
+:class:`~.summaries.Program`), and emits concrete token/AST-span splices on
+the ORIGINAL source — **only when a safety proof holds**:
+
+- HT101 host syncs (``.item()`` / ``float()``/``int()``/``bool()`` casts of
+  device values) rewrite to the sanctioned deadline-guarded
+  ``Communication.host_fetch`` route only when the expression is *provably
+  0-d* (a full-array reduction with no ``axis=``, or abstract metadata with
+  ``dims == []``) **and** the enclosing function is provably not inside a
+  traced context (no jit/vmap/grad/shard_map decorator, not a nested def a
+  parent might trace, never passed to a tracing transform, no module-level
+  jit alias).
+- HT105 raw-entropy sites reroute through ``core/random``'s sanctioned
+  ``host_rng`` only when the seed is a literal constant — the one case
+  where rank-uniformity is provable rather than hoped.
+- HT107 naked blocking waits wrap in ``with comm.deadline(...)`` only when
+  a Communication handle is lexically in scope **and** the call graph
+  proves no enclosing scope already arms a deadline (wrapping under an
+  armed caller would silently tighten the caller's budget).
+- HT110 stale suppressions delete themselves — the staleness re-lint IS
+  the proof.
+
+Unprovable sites are left byte-identical with a per-site refusal
+``reason`` (the honesty policy, fix edition) that ships in ``--json`` and
+the CLI summary.  The engine's own contract, asserted on every run:
+
+- **post-fix re-lint**: every fixed file re-lints clean for the fixed
+  fingerprints (a fix that does not kill its finding is a bug → raised,
+  never written silently);
+- **idempotence**: planning fixes on the fixed tree yields zero edits
+  (fix ∘ fix = fix), asserted before anything touches disk;
+- SARIF ``fixes`` objects carry every planned patch so code scanning
+  surfaces the concrete edit next to the finding.
+
+Stdlib-only and standalone-loadable, like the rest of ``analysis/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .callgraph import call_name, last_attr
+from .framework import Finding, LintContext, all_rules, disabled_rules_for
+
+__all__ = [
+    "Edit",
+    "Fixer",
+    "FixOutcome",
+    "FixError",
+    "register_fixer",
+    "fixable_rules",
+    "plan_fixes",
+    "apply_edits",
+    "execute_fixes",
+    "node_span",
+    "ensure_import_edit",
+    "sarif_fixes",
+]
+
+
+class FixError(RuntimeError):
+    """A fixer violated its own contract (post-fix re-lint dirty, or the
+    engine is not idempotent).  Raised BEFORE any file is written."""
+
+
+# ------------------------------------------------------------------ #
+# edits: character-offset splices on the original source
+# ------------------------------------------------------------------ #
+
+
+@dataclass(frozen=True)
+class Edit:
+    """One splice: replace ``source[start:end]`` with ``replacement``.
+    Offsets are CHARACTER offsets into the file's source text (an insertion
+    has ``start == end``)."""
+
+    path: str
+    start: int
+    end: int
+    replacement: str
+    note: str = ""
+
+
+def _line_starts(source: str) -> List[int]:
+    starts = [0]
+    for i, ch in enumerate(source):
+        if ch == "\n":
+            starts.append(i + 1)
+    return starts
+
+
+def _pos_to_offset(source: str, lines: Sequence[str], starts: Sequence[int],
+                   line: int, byte_col: int) -> int:
+    """(1-based line, utf-8 byte col — ast's coordinate system) → char offset."""
+    text = lines[line - 1] if line - 1 < len(lines) else ""
+    col = len(text.encode("utf-8")[:byte_col].decode("utf-8", errors="ignore"))
+    return starts[line - 1] + col
+
+
+def node_span(ctx: LintContext, node: ast.AST) -> Tuple[int, int]:
+    """Character span of ``node`` in ``ctx.source`` (ast cols are utf-8
+    byte offsets; files with non-ASCII lines still splice correctly)."""
+    starts = _line_starts(ctx.source)
+    s = _pos_to_offset(ctx.source, ctx.lines, starts, node.lineno, node.col_offset)
+    e = _pos_to_offset(
+        ctx.source, ctx.lines, starts, node.end_lineno or node.lineno,
+        node.end_col_offset or node.col_offset,
+    )
+    return s, e
+
+
+def offset_to_linecol(source: str, offset: int) -> Tuple[int, int]:
+    """char offset → (1-based line, 1-based character column) for SARIF."""
+    line = source.count("\n", 0, offset) + 1
+    last_nl = source.rfind("\n", 0, offset)
+    return line, offset - (last_nl + 1) + 1
+
+
+def apply_edits(source: str, edits: Sequence[Edit]) -> str:
+    """Apply non-overlapping edits (any order given; applied right-to-left
+    so earlier offsets stay valid).  Overlap is the PLANNER's job to
+    prevent; here it is a hard error."""
+    ordered = sorted(edits, key=lambda e: (e.start, e.end), reverse=True)
+    prev_start = None
+    for e in ordered:
+        if prev_start is not None and e.end > prev_start:
+            raise ValueError(f"overlapping edits at offsets {e.start}..{e.end}")
+        prev_start = e.start
+    out = source
+    for e in ordered:
+        out = out[: e.start] + e.replacement + out[e.end :]
+    return out
+
+
+def _last_import_line(tree: ast.AST) -> int:
+    """1-based line AFTER which a new import should land: below the last
+    top-level import, else below the module docstring, else line 0."""
+    last = 0
+    body = getattr(tree, "body", [])
+    for stmt in body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            last = max(last, stmt.end_lineno or stmt.lineno)
+    if last == 0 and body and isinstance(body[0], ast.Expr) and isinstance(
+        body[0].value, ast.Constant
+    ) and isinstance(body[0].value.value, str):
+        last = body[0].end_lineno or body[0].lineno
+    return last
+
+
+def _relative_core_prefix(path: str) -> str:
+    """Relative-import prefix reaching ``heat_tpu.core`` from ``path``
+    (``heat_tpu/cluster/x.py`` → ``..core``); absolute for files outside
+    the package (benchmarks, fixtures)."""
+    parts = path.replace("\\", "/").split("/")
+    if "heat_tpu" in parts[:-1]:
+        depth = len(parts) - parts.index("heat_tpu") - 2  # dirs below heat_tpu/
+        return "." * (depth + 1) + "core"
+    return "heat_tpu.core"
+
+
+def ensure_import_edit(ctx: LintContext, import_line: str, marker: str) -> Optional[Edit]:
+    """Insertion Edit adding ``import_line`` after the module's imports,
+    unless an existing import statement already binds ``marker``."""
+    for node in ctx.walk(ast.Import, ast.ImportFrom):
+        seg = ast.get_source_segment(ctx.source, node) or ""
+        if marker in seg:
+            return None
+    after = _last_import_line(ctx.tree)
+    starts = _line_starts(ctx.source)
+    offset = starts[after] if after < len(starts) else len(ctx.source)
+    return Edit(ctx.path, offset, offset, import_line + "\n", note=f"import {marker}")
+
+
+# ------------------------------------------------------------------ #
+# fixer protocol + registry
+# ------------------------------------------------------------------ #
+
+
+@dataclass
+class FixAttempt:
+    """Outcome of one fixer on one finding: either edits or a refusal."""
+
+    finding: Finding
+    fixer: str
+    edits: List[Edit] = field(default_factory=list)
+    refusal: Optional[str] = None  # the per-site `reason` (honesty policy)
+
+
+class Fixer:
+    """One rule's autofix.  Subclass, set ``code``/``name``, implement
+    :meth:`try_fix` returning ``(edits, None)`` when the safety proof holds
+    or ``([], reason)`` when it does not, and decorate with
+    :func:`register_fixer`."""
+
+    code: str = "HT000"
+    name: str = "unnamed-fix"
+    description: str = ""
+
+    def try_fix(
+        self, finding: Finding, ctx: LintContext, program
+    ) -> Tuple[List[Edit], Optional[str]]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+_FIXERS: Dict[str, Fixer] = {}
+
+
+def register_fixer(cls):
+    _FIXERS[cls.code] = cls()
+    return cls
+
+
+def fixable_rules() -> List[str]:
+    return sorted(_FIXERS)
+
+
+def _find_call(ctx: LintContext, line: int, col: int) -> Optional[ast.Call]:
+    for node in ctx.walk(ast.Call):
+        if node.lineno == line and node.col_offset == col:
+            return node
+    return None
+
+
+# ------------------------------------------------------------------ #
+# shared proofs
+# ------------------------------------------------------------------ #
+
+# transforms that trace their argument: a host sync inside a traced body is
+# a different bug (it fails at trace time or constant-folds), so rewriting
+# there is out of the proof's reach
+TRACING_TRANSFORMS = frozenset(
+    {
+        "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "shard_map",
+        "checkpoint", "remat", "custom_jvp", "custom_vjp", "scan",
+        "fori_loop", "while_loop", "cond", "switch",
+    }
+)
+
+# full-array reductions: with no axis=/keepdims= the result is 0-d whatever
+# the operand's rank — the syntactic arm of the 0-d proof
+SCALAR_REDUCTIONS = frozenset(
+    {
+        "sum", "max", "min", "mean", "prod", "any", "all", "argmax",
+        "argmin", "median", "std", "var", "ptp", "count_nonzero",
+        "nanmax", "nanmin", "nansum", "nanmean", "vdot",
+    }
+)
+
+
+def _decorator_names(fn: ast.AST) -> List[str]:
+    out = []
+    for dec in getattr(fn, "decorator_list", []):
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name:
+            out.append(name)
+    return out
+
+
+def prove_untraced(ctx: LintContext, node: ast.AST, program) -> Optional[str]:
+    """None when the enclosing function is provably NOT inside a traced
+    context; otherwise the refusal reason.  Conservative on purpose: a
+    nested def (closure) refuses because its parent may hand it to a
+    tracing transform this pass cannot see."""
+    fns = [
+        a
+        for a in [node] + ctx.ancestors(node)
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    if len(fns) >= 2:
+        return (
+            f"nested def `{fns[0].name}` may be traced by its enclosing "
+            f"function `{fns[1].name}` (closures are routinely passed to "
+            "jit/fori_loop) — cannot prove untraced"
+        )
+    if not fns:
+        return None  # module level executes eagerly at import
+    fn = fns[0]
+    for dec in _decorator_names(fn):
+        if dec in TRACING_TRANSFORMS:
+            return f"enclosing def `{fn.name}` is decorated with `{dec}` (traced context)"
+    # the function object handed to a tracing transform anywhere in the file
+    for call in ctx.walk(ast.Call):
+        la = last_attr(call)
+        if la not in TRACING_TRANSFORMS:
+            continue
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id == fn.name:
+                return (
+                    f"`{fn.name}` is passed to `{la}` at line {call.lineno} "
+                    "(traced context)"
+                )
+    # module-level jit aliases recorded by the call graph
+    if program is not None:
+        facts = program.facts.get(ctx.path)
+        if facts is not None:
+            for alias, (target, _don) in facts.module_aliases.items():
+                if target == fn.name:
+                    return (
+                        f"`{fn.name}` is jit-aliased at module level as "
+                        f"`{alias}` (traced context)"
+                    )
+    return None
+
+
+def _strip_item(expr: ast.expr) -> ast.expr:
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "item"
+        and not expr.args
+    ):
+        return expr.func.value
+    return expr
+
+
+def _absint_call_meta(ctx: LintContext, program, qualname: str, expr: ast.expr):
+    """Concrete abstract metadata for ``expr`` when it is a call the absint
+    pass recorded in this function — the value-domain arm of the 0-d proof."""
+    if program is None or not isinstance(expr, ast.Call):
+        return None
+    view = getattr(program, "absint", None)
+    if view is None:
+        return None
+    key = (ctx.path, qualname)
+    rec = view.functions.get(key)
+    if rec is None:
+        return None
+    desc = None
+    for cid, call in enumerate(rec["calls"]):
+        d = call["desc"]
+        if d.get("line") == expr.lineno and d.get("col") == expr.col_offset:
+            desc = cid
+            break
+    if desc is None:
+        return None
+    return view.concrete_meta(key, {"call": desc})
+
+
+def prove_zero_d(
+    ctx: LintContext, expr: ast.expr, program, qualname: str
+) -> Optional[str]:
+    """None when ``expr`` is provably a 0-d value; else the refusal reason.
+
+    Two proof arms: (1) a full-array reduction with no ``axis=`` /
+    ``keepdims=`` is 0-d whatever the operand's rank; (2) abstract
+    metadata resolved by the absint layer with ``dims == []``.  Everything
+    else — including a provably non-0-d meta — refuses."""
+    meta = _absint_call_meta(ctx, program, qualname, expr)
+    if meta is not None and meta.get("dims") is not None:
+        if meta["dims"] == []:
+            return None
+        return (
+            f"abstract metadata proves the value is {len(meta['dims'])}-d "
+            f"(dims {meta['dims']}), not 0-d — host-fetching it would move "
+            "the whole array"
+        )
+    if isinstance(expr, ast.Call):
+        la = last_attr(expr)
+        if la in SCALAR_REDUCTIONS:
+            # function form `jnp.sum(x[, axis])` vs method form `x.sum([axis])`:
+            # the operand is args[0] in the first, the receiver in the second
+            dn = call_name(expr) or ""
+            function_form = dn.split(".")[0] in ("jnp", "np", "numpy", "jax", "lax")
+            positional_axis = len(expr.args) >= (2 if function_form else 1)
+            bad_kw = None
+            for kw in expr.keywords:
+                if kw.arg == "axis":
+                    if not (isinstance(kw.value, ast.Constant) and kw.value.value is None):
+                        bad_kw = "axis"
+                elif kw.arg == "keepdims":
+                    if not (
+                        isinstance(kw.value, ast.Constant) and kw.value.value is False
+                    ):
+                        bad_kw = "keepdims"
+                elif kw.arg == "out":
+                    bad_kw = "out"
+            if bad_kw is not None:
+                return (
+                    f"`{la}` reduction carries `{bad_kw}=` — the result is not "
+                    "provably 0-d"
+                )
+            if positional_axis:
+                return (
+                    f"`{la}` reduction has a positional axis argument — the "
+                    "result is not provably 0-d"
+                )
+            return None
+    return (
+        "cannot prove the expression is 0-d (not a full-array reduction and "
+        "no abstract metadata resolves it)"
+    )
+
+
+# ------------------------------------------------------------------ #
+# HT101 — host syncs → Communication.host_fetch
+# ------------------------------------------------------------------ #
+
+
+@register_fixer
+class HostSyncFixer(Fixer):
+    """``float()``/``int()``/``bool()`` casts of device values and
+    ``.item()`` syncs rewrite to the sanctioned ``Communication.host_fetch``
+    route (deadline-guarded, fault-retried, SPMD-collective-correct) when
+    0-d-ness and untraced-ness are proved."""
+
+    code = "HT101"
+    name = "host-sync-to-host-fetch"
+    description = "route the proved-0-d host sync through Communication.host_fetch"
+
+    def try_fix(self, finding, ctx, program):
+        node = _find_call(ctx, finding.line, finding.col)
+        if node is None:
+            return [], "could not locate the offending call node"
+        reason = prove_untraced(ctx, node, program)
+        if reason is not None:
+            return [], reason
+
+        if finding.detail == "item":
+            inner = node.func.value
+            reason = prove_zero_d(ctx, inner, program, finding.qualname)
+            if reason is not None:
+                return [], reason
+            inner_src = ast.get_source_segment(ctx.source, inner)
+            if inner_src is None:
+                return [], "could not extract the receiver's source segment"
+            parent = ctx.parent(node)
+            cast_parent = (
+                isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Name)
+                and parent.func.id in ("float", "int", "bool")
+                and len(parent.args) == 1
+                and parent.args[0] is node
+            )
+            s, e = node_span(ctx, node)
+            if cast_parent:
+                # int(X.item()) -> int(Communication.host_fetch(X)): the
+                # cast stays, the sync is replaced by the sanctioned fetch
+                replacement = f"Communication.host_fetch({inner_src})"
+            else:
+                # bare X.item() -> host_fetch(X).item(): .item() on the
+                # fetched host array preserves the exact scalar semantics
+                replacement = f"Communication.host_fetch({inner_src}).item()"
+            edits = [Edit(ctx.path, s, e, replacement, note="HT101 item")]
+        elif finding.detail in ("float-cast", "int-cast", "bool-cast"):
+            arg = node.args[0]
+            reason = prove_zero_d(ctx, arg, program, finding.qualname)
+            if reason is not None:
+                return [], reason
+            arg_src = ast.get_source_segment(ctx.source, arg)
+            if arg_src is None:
+                return [], "could not extract the argument's source segment"
+            s, e = node_span(ctx, arg)
+            edits = [
+                Edit(
+                    ctx.path, s, e, f"Communication.host_fetch({arg_src})",
+                    note=f"HT101 {finding.detail}",
+                )
+            ]
+        elif finding.detail == "device_get":
+            return [], (
+                "`jax.device_get` accepts pytrees; `host_fetch` takes one "
+                "array — the mechanical rewrite is not semantics-preserving, "
+                "route by hand"
+            )
+        else:
+            return [], (
+                f"no mechanical route for `{finding.detail}` — materialize "
+                "via numpy()/host_fetch by hand"
+            )
+        prefix = _relative_core_prefix(ctx.path)
+        imp = ensure_import_edit(
+            ctx,
+            f"from {prefix}.communication import Communication",
+            "Communication",
+        )
+        if imp is not None:
+            edits.append(imp)
+        return edits, None
+
+
+# ------------------------------------------------------------------ #
+# HT105 — raw entropy → core/random's sanctioned host_rng
+# ------------------------------------------------------------------ #
+
+
+@register_fixer
+class EntropyRouteFixer(Fixer):
+    """``np.random.default_rng(<literal seed>)`` reroutes through
+    ``core/random.host_rng`` — same Generator, same stream, but the draw is
+    owned by the module whose job is broadcast-uniform randomness.  Only a
+    literal seed is provably rank-uniform; everything else refuses."""
+
+    code = "HT105"
+    name = "entropy-to-ht-random"
+    description = "reroute literal-seeded np.random entropy through core/random.host_rng"
+
+    def try_fix(self, finding, ctx, program):
+        # a chained `np.random.default_rng(SEED).permutation(n)` puts the
+        # OUTER call at the same (line, col) as the flagged inner one —
+        # match by the finding's dotted name, not position alone
+        node = None
+        for cand in ctx.walk(ast.Call):
+            if (
+                cand.lineno == finding.line
+                and cand.col_offset == finding.col
+                and call_name(cand) == finding.detail
+            ):
+                node = cand
+                break
+        if node is None:
+            return [], "could not locate the offending call node"
+        if finding.detail not in ("np.random.default_rng", "numpy.random.default_rng"):
+            return [], (
+                f"no mechanical route for `{finding.detail}` — draw from the "
+                "broadcast ht.random state (or derive the seed via "
+                "core.random.derive_seed()) by hand"
+            )
+        if not node.args:
+            return [], (
+                "seedless `default_rng()` is true process entropy — no "
+                "deterministic rank-uniform rewrite exists; seed it from the "
+                "broadcast state (core.random.derive_seed()) by hand"
+            )
+        seed = node.args[0]
+        if not (isinstance(seed, ast.Constant) and isinstance(seed.value, int)):
+            return [], (
+                "cannot prove the seed expression is rank-uniform (only a "
+                "literal constant is provable) — route through "
+                "core.random.host_rng by hand if the seed is broadcast"
+            )
+        s, e = node_span(ctx, node.func)
+        prefix = _relative_core_prefix(ctx.path)
+        edits = [Edit(ctx.path, s, e, "ht_random.host_rng", note="HT105 default_rng")]
+        imp = ensure_import_edit(
+            ctx, f"from {prefix} import random as ht_random", "random as ht_random"
+        )
+        if imp is not None:
+            edits.append(imp)
+        return edits, None
+
+
+# ------------------------------------------------------------------ #
+# HT107 — naked blocking waits → with comm.deadline(...)
+# ------------------------------------------------------------------ #
+
+_DEFAULT_DEADLINE_S = "60.0"
+
+
+def _caller_arms_deadline(program, key) -> Optional[str]:
+    """Qualname of a (transitive) caller that arms a deadline around a call
+    path reaching ``key``; None when no enclosing scope provably arms one.
+
+    A function is "deadlined" when any resolved call to it sits under a
+    lexical ``with ...deadline(...)`` in its caller (the effect pass records
+    ``under_dl`` per call site), or when one of its callers is itself
+    deadlined — the contextvar flows down the whole chain."""
+    if program is None:
+        return None
+    callers: Dict[tuple, List[Tuple[tuple, bool]]] = {}
+    for ck, eff in program.effects.items():
+        for cid, entry in enumerate(eff["calls"]):
+            under_dl = bool(entry[2]) if len(entry) > 2 else False
+            r = program.resolved[ck][cid]
+            if r.kind == "resolved":
+                callers.setdefault(r.target, []).append((ck, under_dl))
+    seen = set()
+    frontier = [key]
+    while frontier:
+        cur = frontier.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        for ck, under_dl in callers.get(cur, ()):
+            if under_dl:
+                return ck[1]
+            frontier.append(ck)
+    return None
+
+
+@register_fixer
+class DeadlineWrapFixer(Fixer):
+    """Wrap the statement holding a naked blocking wait in
+    ``with comm.deadline(...)`` — only when a Communication handle is
+    lexically in scope and the call graph proves no enclosing scope already
+    arms a deadline (an armed caller means wrapping would NEST and silently
+    tighten the caller's budget)."""
+
+    code = "HT107"
+    name = "wrap-wait-in-deadline"
+    description = "arm a comm.deadline scope around the proved-undeadlined blocking wait"
+
+    def _comm_handle(
+        self, ctx: LintContext, fn: ast.AST, before: ast.AST
+    ) -> Optional[str]:
+        args = fn.args
+        names = {p.arg for p in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)}
+        if "comm" in names:
+            return "comm"
+        # a local `comm = ...` counts only when it is bound BEFORE the wait
+        # — wrapping a wait that precedes the assignment would emit an
+        # UnboundLocalError the post-fix re-lint cannot see
+        wait_pos = (before.lineno, before.col_offset)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and tgt.id == "comm"
+                        and (node.lineno, node.col_offset) < wait_pos
+                    ):
+                        return "comm"
+        # `self.comm` counts only when THIS function's own class touches it
+        # — a different class in the same file having a comm attribute
+        # proves nothing about this one
+        cls = next(
+            (a for a in ctx.ancestors(fn) if isinstance(a, ast.ClassDef)), None
+        )
+        if cls is not None:
+            for node in ast.walk(cls):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "comm"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    return "self.comm"
+        return None
+
+    def try_fix(self, finding, ctx, program):
+        node = _find_call(ctx, finding.line, finding.col)
+        if node is None:
+            return [], "could not locate the offending call node"
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return [], "module-level wait: no function scope to arm a deadline in"
+        handle = self._comm_handle(ctx, fn, node)
+        if handle is None:
+            return [], (
+                "no Communication handle (`comm`/`self.comm`) in scope — "
+                "cannot arm a deadline here"
+            )
+        if program is None:
+            return [], (
+                "program facts unavailable (narrow --select run) — cannot "
+                "prove no caller already arms a deadline"
+            )
+        armed_by = _caller_arms_deadline(program, (ctx.path, finding.qualname))
+        if armed_by is not None:
+            return [], (
+                f"caller `{armed_by}` already arms a deadline around a call "
+                "path to this function — wrapping would nest and silently "
+                "tighten that budget"
+            )
+        # wrap the whole enclosing statement
+        stmt: ast.AST = node
+        for anc in [node] + ctx.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        first = ctx.lines[stmt.lineno - 1]
+        indent = first[: len(first) - len(first.lstrip())]
+        starts = _line_starts(ctx.source)
+        s = starts[stmt.lineno - 1]
+        end_line = stmt.end_lineno or stmt.lineno
+        e = (
+            starts[end_line] - 1  # up to but excluding the trailing newline
+            if end_line < len(starts)
+            else len(ctx.source)
+        )
+        body = "\n".join(
+            "    " + ln if ln.strip() else ln
+            for ln in ctx.source[s:e].split("\n")
+        )
+        replacement = f"{indent}with {handle}.deadline({_DEFAULT_DEADLINE_S}):\n{body}"
+        return [Edit(ctx.path, s, e, replacement, note="HT107 deadline wrap")], None
+
+
+# ------------------------------------------------------------------ #
+# HT110 — stale suppressions delete themselves
+# ------------------------------------------------------------------ #
+
+
+@register_fixer
+class StaleSuppressionFixer(Fixer):
+    """Delete the stale code from a ``# heatlint: disable=...`` comment —
+    the whole comment when nothing live remains.  The rule's staleness
+    re-lint IS the safety proof, so this fixer never refuses a located
+    finding."""
+
+    code = "HT110"
+    name = "delete-stale-suppression"
+    description = "remove the suppression code (or whole comment) that suppresses nothing"
+
+    _COMMENT = re.compile(r"#\s*heatlint:\s*disable=((?:[A-Za-z0-9_]+\s*,\s*)*[A-Za-z0-9_]+)")
+
+    def try_fix(self, finding, ctx, program):
+        line_text = ctx.lines[finding.line - 1]
+        m = self._COMMENT.search(line_text)
+        if m is None:
+            return [], "could not locate the suppression comment"
+        codes = [c.strip() for c in m.group(1).split(",") if c.strip()]
+        # drop EVERY stale code of this line in one edit, not just this
+        # finding's: two stale codes on one comment would otherwise plan
+        # two overlapping single-code edits, and the overlap resolution
+        # would refuse one forever.  Identical edits from the sibling
+        # findings dedupe cleanly in the planner.
+        from .rules import StaleSuppressionRule
+
+        stale = {
+            f.detail.upper()
+            for f in StaleSuppressionRule().check(ctx)
+            if f is not None and f.line == finding.line
+        } or {finding.detail.upper()}
+        live = [c for c in codes if c.upper() not in stale]
+        starts = _line_starts(ctx.source)
+        line_off = starts[finding.line - 1]
+        if live:
+            s = line_off + m.start(1)
+            e = line_off + m.end(1)
+            return [
+                Edit(ctx.path, s, e, ",".join(live), note="HT110 drop stale code")
+            ], None
+        # nothing live: delete the whole comment (and the padding before it)
+        s = line_off + m.start()
+        e = line_off + len(line_text)  # comments run to end of line
+        while s > line_off and line_text[s - line_off - 1] in " \t":
+            s -= 1
+        return [Edit(ctx.path, s, e, "", note="HT110 delete comment")], None
+
+
+# ------------------------------------------------------------------ #
+# planning + execution
+# ------------------------------------------------------------------ #
+
+
+@dataclass
+class FixOutcome:
+    applied: List[dict] = field(default_factory=list)  # fingerprint, rule, ...
+    refused: List[dict] = field(default_factory=list)
+    diffs: Dict[str, str] = field(default_factory=dict)
+    new_sources: Dict[str, str] = field(default_factory=dict)
+    attempts: List[FixAttempt] = field(default_factory=list)
+
+    def fixed_fingerprints(self) -> List[str]:
+        return [a["fingerprint"] for a in self.applied]
+
+
+def plan_fixes(
+    findings: Sequence[Finding],
+    contexts: Dict[str, LintContext],
+    program,
+) -> List[FixAttempt]:
+    """One :class:`FixAttempt` per error finding whose rule has a fixer.
+    Overlapping edits are resolved deterministically: document order wins,
+    the loser is downgraded to a refusal (re-running --fix picks it up once
+    the first fix landed)."""
+    attempts: List[FixAttempt] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule, f.detail)):
+        if f.severity != "error":
+            continue
+        fixer = _FIXERS.get(f.rule)
+        if fixer is None:
+            continue
+        ctx = contexts.get(f.path)
+        if ctx is None:
+            attempts.append(
+                FixAttempt(f, fixer.name, refusal="no parsed context for this path")
+            )
+            continue
+        edits, reason = fixer.try_fix(f, ctx, program)
+        attempts.append(FixAttempt(f, fixer.name, edits=edits, refusal=reason))
+    # overlap resolution per path (imports dedupe by identity first)
+    taken: Dict[str, List[Tuple[int, int]]] = {}
+    seen_edits: set = set()
+    for att in attempts:
+        if att.refusal is not None or not att.edits:
+            continue
+        kept: List[Edit] = []
+        clash = False
+        for e in att.edits:
+            ident = (e.path, e.start, e.end, e.replacement)
+            if ident in seen_edits:
+                continue  # identical edit (shared import insertion)
+            spans = taken.setdefault(e.path, [])
+            if any(
+                not (e.end <= s or e.start >= t) and not (e.start == e.end == s == t)
+                for s, t in spans
+            ):
+                clash = True
+                break
+            kept.append(e)
+        if clash:
+            att.edits = []
+            att.refusal = (
+                "overlaps an earlier fix on the same span — re-run --fix "
+                "after it lands"
+            )
+            continue
+        for e in kept:
+            seen_edits.add((e.path, e.start, e.end, e.replacement))
+            taken[e.path].append((e.start, e.end))
+        att.edits = kept
+    return attempts
+
+
+def _relint_file_rules(path: str, source: str) -> List[Finding]:
+    ctx = LintContext(path, source)
+    disabled = disabled_rules_for(ctx.path)
+    out: List[Finding] = []
+    for rule in all_rules():
+        if rule.program_level or rule.code in disabled:
+            continue
+        out.extend(f for f in rule.check(ctx) if f is not None)
+    return out
+
+
+def execute_fixes(
+    attempts: Sequence[FixAttempt],
+    contexts: Dict[str, LintContext],
+    write: bool = True,
+) -> FixOutcome:
+    """Apply planned fixes with the engine's two-part contract asserted
+    BEFORE anything touches disk:
+
+    1. post-fix re-lint — every fixed fingerprint is gone from its file;
+    2. idempotence — re-planning on the fixed sources yields zero edits.
+
+    Raises :class:`FixError` on either violation."""
+    outcome = FixOutcome(attempts=list(attempts))
+    by_path: Dict[str, List[Edit]] = {}
+    for att in attempts:
+        f = att.finding
+        rec = {
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "qualname": f.qualname,
+            "fixer": att.fixer,
+        }
+        if att.refusal is not None:
+            outcome.refused.append(dict(rec, reason=att.refusal))
+            continue
+        if not att.edits:
+            continue
+        outcome.applied.append(rec)
+        for e in att.edits:
+            by_path.setdefault(e.path, []).append(e)
+
+    relint_contexts: Dict[str, LintContext] = {}
+    for path, edits in sorted(by_path.items()):
+        src = contexts[path].source
+        new_src = apply_edits(src, edits)
+        outcome.new_sources[path] = new_src
+        outcome.diffs[path] = "".join(
+            difflib.unified_diff(
+                src.splitlines(keepends=True),
+                new_src.splitlines(keepends=True),
+                fromfile=f"a/{path}",
+                tofile=f"b/{path}",
+            )
+        )
+        # contract 1: each fixed fingerprint's finding COUNT drops by the
+        # number of fixes applied to it.  Fingerprints are a multiset (two
+        # same-detail findings in one def are real), so a refused sibling
+        # legitimately still reporting the shared fingerprint must not
+        # convict the applied fix — and an applied fix that did not reduce
+        # the count is a genuine contract violation.
+        try:
+            remaining = _relint_file_rules(path, new_src)
+        except SyntaxError as exc:  # pragma: no cover - engine bug guard
+            raise FixError(f"fix broke the syntax of {path}: {exc}") from exc
+        pre_counts: Dict[str, int] = {}
+        for f in _relint_file_rules(path, src):
+            pre_counts[f.fingerprint] = pre_counts.get(f.fingerprint, 0) + 1
+        post_counts: Dict[str, int] = {}
+        for f in remaining:
+            post_counts[f.fingerprint] = post_counts.get(f.fingerprint, 0) + 1
+        applied_counts: Dict[str, int] = {}
+        for rec in outcome.applied:
+            if rec["path"] == path:
+                applied_counts[rec["fingerprint"]] = (
+                    applied_counts.get(rec["fingerprint"], 0) + 1
+                )
+        still = sorted(
+            fp
+            for fp, n in applied_counts.items()
+            if post_counts.get(fp, 0) > pre_counts.get(fp, 0) - n
+        )
+        if still:
+            raise FixError(
+                f"post-fix re-lint of {path} still reports fixed fingerprint(s): "
+                f"{still} — fixer contract violated, nothing written"
+            )
+        relint_contexts[path] = LintContext(path, new_src)
+
+    # contract 2: fix ∘ fix = fix — plan again on the fixed sources.  The
+    # second-pass Program is built over the FULL context set with the
+    # fixed sources substituted in: a program narrowed to just the fixed
+    # files would lose cross-file facts (e.g. the caller that arms a
+    # deadline), turn pass-1 refusals into pass-2 plans, and fail the
+    # idempotence assertion spuriously.
+    if relint_contexts:
+        second_findings: List[Finding] = []
+        for path, ctx2 in relint_contexts.items():
+            second_findings.extend(_relint_file_rules(path, ctx2.source))
+        second_contexts = dict(contexts)
+        second_contexts.update(relint_contexts)
+        program2 = None
+        try:
+            from . import summaries as _summaries
+
+            program2 = _summaries.build_program(second_contexts, cache_path=None)
+        except Exception:
+            program2 = None  # idempotence still checked with file facts only
+        second = plan_fixes(second_findings, second_contexts, program2)
+        regressions = [a for a in second if a.edits]
+        if regressions:
+            names = [
+                f"{a.finding.path}:{a.finding.line} {a.finding.rule}" for a in regressions
+            ]
+            raise FixError(
+                "fix engine is not idempotent: a second --fix pass would still "
+                f"edit {names} — nothing written"
+            )
+
+    if write:
+        for path, new_src in outcome.new_sources.items():
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(new_src)
+    return outcome
+
+
+# ------------------------------------------------------------------ #
+# SARIF `fixes` objects
+# ------------------------------------------------------------------ #
+
+
+def sarif_fixes(
+    attempts: Iterable[FixAttempt],
+    contexts: Dict[str, LintContext],
+    norm=None,
+) -> Dict[str, dict]:
+    """fingerprint → SARIF ``fix`` object for every planned (non-refused)
+    fix, so code-scanning surfaces the concrete patch next to the finding.
+    ``norm`` optionally normalizes artifact URIs (the CLI's baseline-
+    relative path scheme)."""
+    norm = norm or (lambda p: p)
+    out: Dict[str, dict] = {}
+    for att in attempts:
+        if att.refusal is not None or not att.edits:
+            continue
+        changes: Dict[str, List[dict]] = {}
+        for e in att.edits:
+            ctx = contexts.get(e.path)
+            if ctx is None:
+                continue
+            sl, sc = offset_to_linecol(ctx.source, e.start)
+            el, ec = offset_to_linecol(ctx.source, e.end)
+            changes.setdefault(e.path, []).append(
+                {
+                    "deletedRegion": {
+                        "startLine": sl,
+                        "startColumn": sc,
+                        "endLine": el,
+                        "endColumn": ec,
+                    },
+                    "insertedContent": {"text": e.replacement},
+                }
+            )
+        out[att.finding.fingerprint] = {
+            "description": {"text": f"{att.fixer}: {att.finding.rule} autofix"},
+            "artifactChanges": [
+                {
+                    "artifactLocation": {"uri": norm(p), "uriBaseId": "%SRCROOT%"},
+                    "replacements": reps,
+                }
+                for p, reps in sorted(changes.items())
+            ],
+        }
+    return out

@@ -119,7 +119,9 @@ class Finding:
 
 # codes are comma-separated tokens; the capture stops at the first token
 # that isn't followed by a comma, so a trailing free-text reason
-# (`# heatlint: disable=HT101 tolerated here`) doesn't corrupt the codes
+# (`disable=HT101 tolerated here`) doesn't corrupt the codes — spelling
+# the full comment syntax here would ARM a (stale) suppression on this
+# very line, which HT110 caught the day it was born
 _CODES = r"(?:[A-Za-z0-9_]+\s*,\s*)*[A-Za-z0-9_]+"
 _SUPPRESS_RE = re.compile(rf"#\s*heatlint:\s*disable=({_CODES})")
 _SUPPRESS_FILE_RE = re.compile(rf"#\s*heatlint:\s*disable-file=({_CODES})")
@@ -413,6 +415,8 @@ def lint_paths(
     cache_path: Optional[str] = None,
     unresolved_out: Optional[List[dict]] = None,
     split_inventory_out: Optional[List[dict]] = None,
+    contexts_out: Optional[Dict[str, "LintContext"]] = None,
+    program_out: Optional[List] = None,
 ) -> List[Finding]:
     """Lint ``paths`` with every selected rule — ONE parse + ONE walk index
     per file shared by all lexical rules AND the interprocedural passes,
@@ -421,7 +425,11 @@ def lint_paths(
     given, the call graph's unresolved bucket (every unresolvable call with
     its reason — the honesty policy's audit trail) is appended to it.
     When ``split_inventory_out`` is given, the absint layer's catalog of
-    every split-semantics site (the mesh-refactor work list) is appended."""
+    every split-semantics site (the mesh-refactor work list) is appended.
+    ``contexts_out``/``program_out`` hand the parsed contexts and the built
+    Program back to the caller (the autofix engine and migration planner
+    reuse them instead of re-parsing the repo); ``program_out`` forces the
+    program build even when no program-level rule is selected."""
     rules = all_rules(select)
     file_rules = [r for r in rules if not r.program_level]
     program_rules = [r for r in rules if r.program_level]
@@ -438,7 +446,11 @@ def lint_paths(
             if rule.code in disabled:
                 continue
             findings.extend(f for f in rule.check(ctx) if f is not None)
-    need_program = bool(program_rules) or split_inventory_out is not None
+    need_program = (
+        bool(program_rules)
+        or split_inventory_out is not None
+        or program_out is not None
+    )
     if need_program and contexts:
         from . import summaries as _summaries  # lazy: only when HT2xx selected
 
@@ -452,6 +464,10 @@ def lint_paths(
             unresolved_out.extend(program.graph.unresolved)
         if split_inventory_out is not None:
             split_inventory_out.extend(program.absint.inventory)
+        if program_out is not None:
+            program_out.append(program)
+    if contexts_out is not None:
+        contexts_out.update(contexts)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -566,6 +582,7 @@ def render_json(
     grandfathered: Sequence[Finding],
     info: Sequence[Finding] = (),
     unresolved: Optional[Sequence[dict]] = None,
+    fixes: Optional[dict] = None,
 ) -> str:
     payload = {
         "version": 2,
@@ -580,6 +597,10 @@ def render_json(
     }
     if unresolved is not None:
         payload["unresolved_calls"] = list(unresolved)
+    if fixes is not None:
+        # {"applied": [...], "refused": [{..., "reason": ...}]} — the
+        # refusal reasons are the autofix honesty policy's audit trail
+        payload["fixes"] = fixes
     return json.dumps(payload, indent=2)
 
 
@@ -605,7 +626,9 @@ def _sarif_location(path: str, line: int, col: int, message: Optional[str] = Non
     return loc
 
 
-def _sarif_result(f: Finding, level: str, baselined: bool = False) -> dict:
+def _sarif_result(
+    f: Finding, level: str, baselined: bool = False, fix: Optional[dict] = None
+) -> dict:
     result = {
         "ruleId": f.rule,
         "level": level,
@@ -613,6 +636,10 @@ def _sarif_result(f: Finding, level: str, baselined: bool = False) -> dict:
         "locations": [_sarif_location(f.path, f.line, f.col)],
         "partialFingerprints": {"heatlintFingerprint/v1": f.fingerprint},
     }
+    if fix is not None:
+        # SARIF `fixes`: code scanning renders the concrete patch (the
+        # autofix engine's planned, proof-carrying edit) next to the finding
+        result["fixes"] = [fix]
     if f.trace:
         # the interprocedural call chain maps onto one SARIF threadFlow:
         # entry -> helper -> sink, one location per hop
@@ -647,10 +674,14 @@ def render_sarif(
     grandfathered: Sequence[Finding],
     info: Sequence[Finding] = (),
     rules: Optional[Sequence[Rule]] = None,
+    fixes: Optional[Dict[str, dict]] = None,
 ) -> str:
     """SARIF 2.1.0 log: new findings at ``error``, info findings at
     ``note``, baselined findings at ``note`` with an external suppression
-    (so code-scanning shows them resolved instead of re-announcing them)."""
+    (so code-scanning shows them resolved instead of re-announcing them).
+    ``fixes`` maps finding fingerprints to SARIF fix objects (the autofix
+    engine's planned patches), attached to their results."""
+    fixes = fixes or {}
     rule_meta = [
         {
             "id": r.code,
@@ -661,9 +692,12 @@ def render_sarif(
         for r in (rules if rules is not None else all_rules())
     ]
     results = (
-        [_sarif_result(f, "error") for f in new]
+        [_sarif_result(f, "error", fix=fixes.get(f.fingerprint)) for f in new]
         + [_sarif_result(f, "note") for f in info]
-        + [_sarif_result(f, "note", baselined=True) for f in grandfathered]
+        + [
+            _sarif_result(f, "note", baselined=True, fix=fixes.get(f.fingerprint))
+            for f in grandfathered
+        ]
     )
     log = {
         "$schema": _SARIF_SCHEMA,

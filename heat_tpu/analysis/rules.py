@@ -13,6 +13,7 @@ contracts" for the full table):
 - HT107 — no naked blocking collective waits bypassing comm.deadline
 - HT108 — no collective staging bypassing the seq-stamp choke point
 - HT109 — no manual trace-identity fiddling outside the tracing helpers
+- HT110 — no stale suppressions (a disable comment must suppress something)
 
 The HT1xx analyses are intentionally *lexical and intra-procedural*: false
 negatives across call boundaries are accepted; false positives are kept
@@ -91,6 +92,7 @@ from .summaries import (
     _strip,
     module_matches,
     rank_marker,
+    routed_through_materializer,
     subtree_mentions_device_value,
 )
 
@@ -175,6 +177,11 @@ class HostSyncRule(Rule):
             la = last_attr(node)
             dn = call_name(node)
             if la == "item" and isinstance(node.func, ast.Attribute) and not node.args:
+                if routed_through_materializer(node.func.value):
+                    # .item() on an already-fetched host array (the autofix
+                    # engine's bare-item rewrite shape) is plain numpy, not
+                    # a device sync
+                    continue
                 out.append(
                     ctx.finding(
                         self, node,
@@ -874,6 +881,104 @@ class TraceIdentityRule(Rule):
                     )
                     if f is not None:
                         out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
+# HT110 — stale suppressions (hygiene: a disable that disables nothing)
+# -------------------------------------------------------------------- #
+
+
+@register
+class StaleSuppressionRule(Rule):
+    """A ``# heatlint: disable=HTxxx`` line comment that suppresses nothing
+    — the named rule is clean at that line — is itself a finding: stale
+    suppressions are load-bearing-looking noise that survives refactors and
+    silently swallows the NEXT real finding that lands on the line.  The
+    staleness check re-runs the named rule on a suppression-blind clone of
+    the file (the re-lint IS the proof), so a suppression is only ever
+    called stale when removing it provably changes nothing.
+
+    Scope, deliberately conservative:
+
+    - only line suppressions are audited (``disable-file=`` sweeps a whole
+      file and is an explicit policy statement, not per-site noise);
+    - program-level codes (HT2xx/HT3xx) are skipped — their findings
+      depend on the whole program, which a per-file re-lint cannot decide;
+    - ``disable=HT110`` itself is skipped (self-referential);
+    - a code naming NO registered rule suppresses nothing by definition
+      and is flagged;
+    - a rule that WOULD fire but is disabled for the directory is NOT
+      flagged (the comment is future-proof against config changes)."""
+
+    code = "HT110"
+    name = "stale-suppression"
+    description = "a heatlint disable comment that suppresses nothing at its line"
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        sups = getattr(ctx, "_line_suppressions", {})
+        if not sups:
+            return []
+        from .framework import all_rules as _all_rules
+
+        bare = LintContext(ctx.path, ctx.source, tree=ctx.tree)
+        bare._line_suppressions = {}
+        bare._file_suppressions = set()
+        rules = {
+            r.code: r
+            for r in _all_rules()
+            if not r.program_level and r.code != self.code
+        }
+        program_codes = {r.code for r in _all_rules() if r.program_level}
+        fired: set = set()
+        for rule in rules.values():
+            for f in rule.check(bare):
+                if f is not None:
+                    fired.add((f.line, f.rule))
+        lines_with_any = {ln for ln, _code in fired}
+        # an audited line's own `disable=all` must not self-suppress the
+        # audit — only an explicit HT110 code (or a file-level suppression)
+        # opts a line out of the staleness check
+        file_sup = {"HT110", "ALL"} & set(ctx._file_suppressions)
+        out: List[Finding] = []
+        for line in sorted(sups):
+            if file_sup or "HT110" in sups[line]:
+                continue
+            for code in sorted(sups[line]):
+                if code == self.code or code in program_codes:
+                    continue
+                if code == "ALL":
+                    stale = line not in lines_with_any
+                    why = "no rule fires at this line"
+                elif code not in rules:
+                    stale = True
+                    why = f"no registered rule is named {code}"
+                else:
+                    stale = (line, code) not in fired
+                    why = f"{code} is clean at this line"
+                if not stale:
+                    continue
+                qual = "<module>"
+                for node in ctx.walk():
+                    if getattr(node, "lineno", None) == line:
+                        qual = ctx.qualname(node)
+                        break
+                out.append(
+                    Finding(
+                        rule=self.code,
+                        path=ctx.path,
+                        line=line,
+                        col=0,
+                        message=(
+                            f"`# heatlint: disable={code}` suppresses nothing "
+                            f"({why}) — a stale suppression hides intent and "
+                            "silently swallows the next real finding on this "
+                            "line; delete it"
+                        ),
+                        qualname=qual,
+                        detail=code,
+                    )
+                )
         return out
 
 

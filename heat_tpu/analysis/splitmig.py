@@ -1,0 +1,358 @@
+"""splitmig — the named-axis mesh migration's codemod planner and executor.
+
+``SPLIT_INVENTORY.json`` (the absint pass's catalog of every
+single-``split``-axis assumption) is a work list with no executor.  This
+module turns it into a committed, drift-gated **plan**: every site is
+classified into a mechanically-rewritable class or a semantic one, ordered
+into dependency tranches via the PR 8 call graph, and the lowest-risk
+tranche is *executable* through the fix-engine's edit machinery against
+the ``core/axisspec.py`` compatibility shim (``split ↔ named-spec``
+translation, value-preserving by construction).
+
+Classes:
+
+- ``spec-kwarg`` — a ``split=`` keyword argument.  Mechanical when the
+  value is a literal: ``split=0`` rewrites to ``split=axisspec.named(0)``,
+  bit-identical at runtime (AxisSpec subclasses int) while already
+  speaking the named vocabulary.
+- ``axis-read`` — a ``.split`` attribute read.  Mechanical in principle
+  (the shim translates), staged after the kwargs.
+- ``respec`` — a ``resplit``/``resplit_``/``redistribute_`` call.
+  Mechanical when the axis is literal; becomes a respec once the
+  placement core speaks PartitionSpecs.
+- ``signature`` — a ``split`` *parameter*.  Never mechanical: changing a
+  signature changes every caller, which is exactly what the tranche
+  ordering exists to sequence.
+
+Tranches (lower = earlier, executed first):
+
+- **0** — mechanical ``spec-kwarg`` sites in pure consumer code
+  (``benchmarks/``, ``tutorials/``): nothing depends on them, the rewrite
+  is value-preserving, and the linter's shim-aware ``_literal_split``
+  keeps the inventory/plan byte-stable across execution.  SHIPPED
+  EXECUTED in this repo.
+- **1** — mechanical ``spec-kwarg`` sites in library modules few other
+  inventoried modules depend on (call-graph fan-in ≤ the threshold).
+- **2** — mechanical ``axis-read``/``respec`` sites, plus mechanical
+  kwargs in high-fan-in modules.
+- **3** — semantic sites: ``signature`` changes and anything in the
+  placement core / SUMMA / IO / tiling modules, where ``split`` is not a
+  label but the algorithm.
+
+The committed ``MIGRATION_PLAN.json`` is exact-match drift-gated in CI
+beside ``SPLIT_INVENTORY.json``: the plan can only change when a human
+regenerates and commits it — the denominator (414 sites) cannot silently
+rot.
+
+Stdlib-only and standalone-loadable, like the rest of ``analysis/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .callgraph import dotted_name
+from .fixes import Edit, _relative_core_prefix, ensure_import_edit, node_span
+from .framework import LintContext
+
+
+def _binds_heat_tpu(ctx: LintContext, name: str) -> bool:
+    """True when ``name`` is bound to the heat_tpu package anywhere in the
+    file (``import heat_tpu as ht`` — including the consumer idiom of
+    importing it lazily inside a function)."""
+    for node in ctx.walk(ast.Import):
+        for alias in node.names:
+            if alias.name == "heat_tpu" and (alias.asname or alias.name) == name:
+                return True
+    return False
+
+__all__ = [
+    "classify_site",
+    "build_plan",
+    "render_plan",
+    "tranche_edits",
+    "SEMANTIC_MODULES",
+]
+
+# modules where `split` IS the algorithm, not a label: the placement core,
+# the tiled redistribution planner, SUMMA's 2D-over-1D routing, IO's
+# chunk layout, and the tiling/stride machinery.  Sites here are semantic
+# regardless of lexical shape.
+SEMANTIC_MODULES = frozenset(
+    {
+        "heat_tpu/core/communication.py",
+        "heat_tpu/core/redistribution.py",
+        "heat_tpu/core/dndarray.py",
+        "heat_tpu/core/_operations.py",
+        "heat_tpu/core/factories.py",
+        "heat_tpu/core/manipulations.py",
+        "heat_tpu/core/io.py",
+        "heat_tpu/core/tiling.py",
+        "heat_tpu/core/stride_tricks.py",
+        "heat_tpu/linalg/basics.py",
+    }
+)
+
+_CONSUMER_TOPDIRS = ("benchmarks", "tutorials")
+_FANIN_THRESHOLD = 3  # dependent-module count above which a module is "load-bearing"
+
+_MIGRATED_RE = re.compile(
+    r"\bsplit\s*=\s*(?:[A-Za-z_][A-Za-z0-9_]*\s*\.\s*)*named\s*\("
+)
+
+
+def _module_dependents(program) -> Dict[str, set]:
+    """path → set of OTHER paths whose functions call into it (the PR 8
+    call graph, folded to module granularity)."""
+    deps: Dict[str, set] = {}
+    if program is None:
+        return deps
+    for ck in sorted(program.effects):
+        cpath = ck[0]
+        for r in program.resolved[ck]:
+            if r.kind == "resolved":
+                tpath = r.target[0]
+                if tpath != cpath:
+                    deps.setdefault(tpath, set()).add(cpath)
+    return deps
+
+
+def _is_consumer(path: str) -> bool:
+    p = "/" + path.replace("\\", "/")
+    return any(f"/{d}/" in p for d in _CONSUMER_TOPDIRS)
+
+
+def _is_semantic_module(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith(m) for m in SEMANTIC_MODULES)
+
+
+def classify_site(site: dict, dependents: Dict[str, set]) -> dict:
+    """class / mechanical / tranche / reason for one inventory site.
+
+    Path matching is suffix/segment-based so an absolute-path invocation
+    classifies identically to a repo-relative one — the committed plan's
+    drift gate must not depend on how the CLI was launched."""
+    path, kind, detail = site["path"], site["kind"], site["detail"]
+    consumer = _is_consumer(path)
+    fan_in = len(dependents.get(path, ()))
+
+    if kind == "split-param":
+        cls, mechanical = "signature", False
+        reason = "a `split` parameter is API surface: migrating it changes every caller"
+    elif _is_semantic_module(path):
+        cls = {
+            "split-read": "axis-read",
+            "split-kwarg": "spec-kwarg",
+            "resplit-call": "respec",
+        }[kind]
+        mechanical = False
+        reason = (
+            "placement-core/SUMMA/IO/tiling module: `split` is the algorithm "
+            "here, not a label — hand migration with the linter holding the "
+            "invariants"
+        )
+    elif kind == "split-read":
+        cls, mechanical = "axis-read", True
+        reason = "positional-axis read: shim-translatable once consumers speak specs"
+    elif kind == "resplit-call":
+        lit = "?" not in detail
+        cls, mechanical = "respec", lit
+        reason = (
+            "literal resplit axis: becomes a respec when the core speaks specs"
+            if lit
+            else "dynamic resplit axis: needs the dataflow, not a token rewrite"
+        )
+    else:  # split-kwarg
+        lit = "?" not in detail
+        cls, mechanical = "spec-kwarg", lit
+        reason = (
+            "literal split= kwarg: value-preserving rewrite through axisspec.named()"
+            if lit
+            else "dynamic split= kwarg: the value is computed, not a literal to name"
+        )
+
+    if not mechanical:
+        tranche = 3
+    elif cls == "spec-kwarg" and consumer:
+        tranche = 0
+    elif cls == "spec-kwarg":
+        tranche = 1 if fan_in <= _FANIN_THRESHOLD else 2
+    else:  # axis-read / respec
+        tranche = 2
+    return {
+        "class": cls,
+        "mechanical": mechanical,
+        "tranche": tranche,
+        "reason": reason,
+        "fan_in": fan_in,
+    }
+
+
+def _is_migrated(site: dict, contexts: Dict[str, LintContext]) -> bool:
+    ctx = contexts.get(site["path"])
+    if ctx is None or site["line"] - 1 >= len(ctx.lines):
+        return False
+    return bool(_MIGRATED_RE.search(ctx.lines[site["line"] - 1]))
+
+
+def build_plan(
+    inventory: Sequence[dict],
+    program,
+    contexts: Dict[str, LintContext],
+) -> dict:
+    """The full migration plan over ``inventory`` (every site classified,
+    tranched, and — for executed tranches — marked migrated)."""
+    dependents = _module_dependents(program)
+    sites: List[dict] = []
+    for raw in sorted(
+        inventory, key=lambda s: (s["path"], s["line"], s["kind"], s["detail"])
+    ):
+        info = classify_site(raw, dependents)
+        site = {
+            "path": raw["path"],
+            "line": raw["line"],
+            "qualname": raw.get("qualname", "<module>"),
+            "kind": raw["kind"],
+            "detail": raw["detail"],
+            "class": info["class"],
+            "mechanical": info["mechanical"],
+            "tranche": info["tranche"],
+            "fan_in": info["fan_in"],
+            "reason": info["reason"],
+            "migrated": (
+                info["tranche"] == 0
+                and info["class"] == "spec-kwarg"
+                and _is_migrated(raw, contexts)
+            ),
+        }
+        sites.append(site)
+    classes: Dict[str, int] = {}
+    tranches: Dict[str, dict] = {}
+    for s in sites:
+        classes[s["class"]] = classes.get(s["class"], 0) + 1
+        t = tranches.setdefault(
+            str(s["tranche"]), {"sites": 0, "mechanical": 0, "migrated": 0}
+        )
+        t["sites"] += 1
+        t["mechanical"] += int(s["mechanical"])
+        t["migrated"] += int(s["migrated"])
+    return {
+        "version": 1,
+        "comment": (
+            "Named-axis mesh migration plan over every SPLIT_INVENTORY.json "
+            "site: class + tranche per site, dependency-ordered via the "
+            "analysis call graph. Tranche 0 executes mechanically against "
+            "the core/axisspec.py shim (value-preserving, round-trip "
+            "tested). Regenerate with: python scripts/heatlint.py heat_tpu/ "
+            "benchmarks/ tutorials/ --split-plan MIGRATION_PLAN.json "
+            "(drift-gated in CI: regeneration must match this file exactly)."
+        ),
+        "count": len(sites),
+        "classes": {k: classes[k] for k in sorted(classes)},
+        "tranches": {k: tranches[k] for k in sorted(tranches)},
+        "sites": sites,
+    }
+
+
+def tranche_edits(
+    plan: dict, contexts: Dict[str, LintContext], tranche: int = 0
+) -> Tuple[List[Edit], List[dict]]:
+    """Concrete edits executing one tranche's mechanical ``spec-kwarg``
+    rewrites (``split=<k>`` → ``split=axisspec.named(<k>)``), plus the
+    skipped sites with reasons.  Idempotent by construction: an already-
+    migrated site no longer matches a literal-int kwarg and is skipped."""
+    edits: List[Edit] = []
+    skipped: List[dict] = []
+    for site in plan["sites"]:
+        if site["tranche"] != tranche or not site["mechanical"]:
+            continue
+        if site["class"] != "spec-kwarg":
+            skipped.append(
+                dict(site, skip_reason="only spec-kwarg sites execute mechanically today")
+            )
+            continue
+        if site["migrated"]:
+            continue
+        ctx = contexts.get(site["path"])
+        if ctx is None:
+            skipped.append(dict(site, skip_reason="no parsed context for this path"))
+            continue
+        kw_value = None
+        call_node = None
+        replicated = False
+        for node in ctx.walk(ast.Call):
+            if node.lineno != site["line"]:
+                continue
+            for kw in node.keywords:
+                if kw.arg != "split" or not isinstance(kw.value, ast.Constant):
+                    continue
+                if kw.value.value is None:
+                    replicated = True  # nothing to name: already axis-free
+                elif isinstance(kw.value.value, int) and not isinstance(
+                    kw.value.value, bool
+                ):
+                    kw_value = kw.value
+                    call_node = node
+                if kw_value is not None or replicated:
+                    break
+            if kw_value is not None or replicated:
+                break
+        if replicated:
+            continue
+        if kw_value is None:
+            skipped.append(
+                dict(site, skip_reason="no literal-int split= kwarg found at this line")
+            )
+            continue
+        # Prefer the call site's OWN heat_tpu binding (`ht.random.randn(...)`
+        # → `ht.axisspec.named(k)`): consumer entry points routinely set
+        # XLA_FLAGS env vars BEFORE importing heat_tpu, so a module-top
+        # `from heat_tpu.core import axisspec` would import jax early and
+        # silently void the device-count flags.  Only files with no such
+        # binding get the import inserted.
+        prefix_name = None
+        root = (dotted_name(call_node.func) or "").split(".")[0]
+        if root and _binds_heat_tpu(ctx, root):
+            prefix_name = f"{root}.axisspec.named"
+        s, e = node_span(ctx, kw_value)
+        if prefix_name is None:
+            edits.append(
+                Edit(
+                    ctx.path, s, e,
+                    f"axisspec.named({kw_value.value})",
+                    note=f"splitmig tranche-{tranche}",
+                )
+            )
+            prefix = _relative_core_prefix(ctx.path)
+            imp = ensure_import_edit(
+                ctx, f"from {prefix} import axisspec", "axisspec"
+            )
+            if imp is not None:
+                edits.append(imp)
+        else:
+            edits.append(
+                Edit(
+                    ctx.path, s, e,
+                    f"{prefix_name}({kw_value.value})",
+                    note=f"splitmig tranche-{tranche}",
+                )
+            )
+    # dedupe identical import insertions
+    seen: set = set()
+    unique: List[Edit] = []
+    for e in edits:
+        ident = (e.path, e.start, e.end, e.replacement)
+        if ident in seen:
+            continue
+        seen.add(ident)
+        unique.append(e)
+    return unique, skipped
+
+
+def render_plan(plan: dict) -> str:
+    import json
+
+    return json.dumps(plan, indent=2) + "\n"

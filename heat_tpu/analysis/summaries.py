@@ -68,7 +68,9 @@ CACHE_VERSION = 2  # JSON layout of the cache file
 # hash, which cannot see analyzer changes) become misses instead of
 # silently serving summaries that lack the new facts.
 # rev 2: absint records (rank-taint + array-metadata + split inventory)
-ANALYSIS_SCHEMA_REV = 2
+# rev 3: ISSUE 13 — item-on-materialized-data sink exemption, axisspec
+# named()-aware _literal_split, materializer-collective HT301 exclusion
+ANALYSIS_SCHEMA_REV = 3
 _EXPAND_CAP = 160  # atoms per expanded footprint before truncation
 _CHAIN_CAP = 12  # hops kept in a provenance chain
 
@@ -118,6 +120,22 @@ WAIT_SANCTIONED_MODULES = ("core/communication.py", "utils/health.py")
 
 def module_matches(path: str, suffixes: Tuple[str, ...]) -> bool:
     return any(path.endswith(s) for s in suffixes)
+
+
+def routed_through_materializer(node: ast.AST) -> bool:
+    """True when the value ``node`` evaluates to is PRODUCED by a
+    sanctioned materialization call (``host_fetch``/``numpy()``/``tolist``)
+    — i.e. the outermost producer, looking through attribute/subscript
+    views (``host_fetch(x).T``, ``host_fetch(x)[0]``), is a materializer:
+    the value is host data, so a trailing ``.item()`` on it is plain
+    numpy, not a device sync.  A materializer merely *somewhere inside*
+    does NOT count: ``jnp.abs(host_fetch(x) - y).item()`` re-enters the
+    device domain on top of the fetched data and is exactly the sync the
+    rule exists to flag.  ``item`` itself never counts as a route."""
+    cur = node
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        cur = cur.value
+    return isinstance(cur, ast.Call) and last_attr(cur) in MATERIALIZERS - {"item"}
 
 
 def subtree_mentions_device_value(node: ast.AST) -> bool:
@@ -367,8 +385,11 @@ class _EffectExtractor:
                 else "naked"
             )
             if la == "item" and isinstance(node.func, ast.Attribute) and not node.args:
-                out.append(["sink", "item", line, vis])
-                return
+                if not routed_through_materializer(node.func.value):
+                    # mirrors HT101: .item() on already-fetched host data is
+                    # not a sync, so it must not propagate as one either
+                    out.append(["sink", "item", line, vis])
+                    return
             if dn == "jax.device_get":
                 out.append(["sink", "device_get", line, vis])
                 return

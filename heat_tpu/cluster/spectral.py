@@ -17,6 +17,7 @@ from ..core.dndarray import DNDarray
 from ..graph.laplacian import Laplacian
 from ..linalg.solver import lanczos
 from .kmeans import KMeans
+from ..core.communication import Communication
 
 __all__ = ["Spectral"]
 
@@ -73,7 +74,7 @@ class Spectral(ClusteringMixin, BaseEstimator):
         if k is None:
             # largest eigen-gap heuristic (reference behavior)
             diffs = jnp.diff(evals)
-            k = int(jnp.argmax(diffs).item()) + 1
+            k = int(Communication.host_fetch(jnp.argmax(diffs))) + 1
             k = max(k, 2)
             self._cluster.n_clusters = k
         emb = components[:, :k]

@@ -17,6 +17,7 @@ from . import types
 from ._operations import _binary_op, _local_op, _reduce_op
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
+from ..core.communication import Communication
 
 __all__ = [
     "argmax",
@@ -129,7 +130,7 @@ def bincount(x, weights=None, minlength: int = 0) -> DNDarray:
         w = w.reshape(-1)
     else:
         w = None
-    length = int(jnp.max(x._jarray).item()) + 1 if x.size else 0
+    length = int(Communication.host_fetch(jnp.max(x._jarray))) + 1 if x.size else 0
     length = length if length > minlength else minlength
     res = jnp.bincount(x._jarray.reshape(-1), weights=w, length=length)
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
@@ -166,8 +167,8 @@ def cov(m, y=None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] 
 def histc(x, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
     lo, hi = float(min), float(max)
     if lo == 0.0 and hi == 0.0:
-        lo = float(jnp.min(x._jarray))
-        hi = float(jnp.max(x._jarray))
+        lo = float(Communication.host_fetch(jnp.min(x._jarray)))
+        hi = float(Communication.host_fetch(jnp.max(x._jarray)))
     hist, _ = jnp.histogram(x._jarray.reshape(-1), bins=bins, range=(lo, hi))
     hist = hist.astype(x.dtype.jax_dtype())
     res = DNDarray(hist, tuple(hist.shape), x.dtype, None, x.device, x.comm, True)

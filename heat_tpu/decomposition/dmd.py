@@ -14,6 +14,7 @@ from ..core import types
 from ..core.base import BaseEstimator
 from ..core.dndarray import DNDarray
 from ..linalg import svdtools
+from ..core.communication import Communication
 
 __all__ = ["DMD"]
 
@@ -65,9 +66,9 @@ class DMD(BaseEstimator):
             if self.svd_rank is not None:
                 r = min(self.svd_rank, s.shape[0])
             elif self.svd_tol is not None:
-                r = int(jnp.sum(s > self.svd_tol * s[0]).item())
+                r = int(Communication.host_fetch(jnp.sum(s > self.svd_tol * s[0])))
             else:
-                r = int(jnp.sum(s > 1e-10 * s[0]).item())
+                r = int(Communication.host_fetch(jnp.sum(s > 1e-10 * s[0])))
         r = max(r, 1)
         u_r, s_r, v_r = u[:, :r], s[:r], vt[:r].T
         # reduced operator Ã = Uᵀ X1 V Σ⁻¹

@@ -14,6 +14,7 @@ import numpy as np
 from ..core import types
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
+from ..core.communication import Communication
 
 __all__ = ["GaussianNB"]
 
@@ -86,7 +87,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         jX = x._jarray
         jy = y._jarray.reshape(-1)
         classes = jnp.unique(jy)  # eager: concrete sizes
-        self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0)))
+        self.epsilon_ = self.var_smoothing * float(Communication.host_fetch(jnp.max(jnp.var(jX, axis=0))))
         counts, means, var = self._batch_stats(jX, jy, classes)
         return self._finalize(x, classes, counts, means, var)
 
@@ -109,15 +110,15 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
             if classes is None:
                 raise ValueError("classes must be passed on the first call to partial_fit")
             cls = classes._jarray if isinstance(classes, DNDarray) else jnp.asarray(np.asarray(classes))
-            if bool(jnp.any(~jnp.isin(jy, cls))):
+            if bool(Communication.host_fetch(jnp.any(~jnp.isin(jy, cls)))):
                 raise ValueError("y contains labels not in the declared classes")
-            self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0)))
+            self.epsilon_ = self.var_smoothing * float(Communication.host_fetch(jnp.max(jnp.var(jX, axis=0))))
             counts, means, var = self._batch_stats(jX, jy, cls)
             return self._finalize(x, cls, counts, means, var)
 
         cls = self.classes_._jarray
         unseen = ~jnp.isin(jy, cls)
-        if bool(jnp.any(unseen)):
+        if bool(Communication.host_fetch(jnp.any(unseen))):
             raise ValueError("y contains labels not in the classes seen at first partial_fit")
         n_new, means_new, var_new = self._batch_stats(jX, jy, cls)
         n_old = self.class_count_._jarray
@@ -140,7 +141,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         )
         var = jnp.maximum(m2 / safe[:, None], 0.0)
         # widen the smoothing floor if the new batch has larger spread
-        self.epsilon_ = max(self.epsilon_, self.var_smoothing * float(jnp.max(jnp.var(jX, axis=0))))
+        self.epsilon_ = max(self.epsilon_, self.var_smoothing * float(Communication.host_fetch(jnp.max(jnp.var(jX, axis=0)))))
         return self._finalize(x, cls, n_tot, means, var)
 
     def _joint_log_likelihood(self, jX):

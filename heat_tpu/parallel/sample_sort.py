@@ -45,13 +45,14 @@ _NAN = jnp.uint32(0xFFFFFFFE)  # NaNs sort last among real values (numpy)
 import functools
 
 from ..core._cache import comm_cached
+from ..core import random as ht_random
 
 
 @functools.lru_cache(maxsize=16)
 def _shuffle_perm(cs: int) -> np.ndarray:
     """Fixed shuffle permutation, cached per block size (a fresh O(cs)
     host-side permutation per call would dominate repeated sorts)."""
-    return np.random.default_rng(0xC0FFEE).permutation(cs)
+    return ht_random.host_rng(0xC0FFEE).permutation(cs)
 
 
 def _encode_f32(x):

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from ..core import types
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
+from ..core.communication import Communication
 
 __all__ = ["Lasso"]
 
@@ -81,7 +82,7 @@ class Lasso(RegressionMixin, BaseEstimator):
         n_iter = 0
         for it in range(self.max_iter):
             new_theta = sweep(theta)
-            diff = float(jnp.max(jnp.abs(new_theta - theta)))
+            diff = float(Communication.host_fetch(jnp.max(jnp.abs(new_theta - theta))))
             theta = new_theta
             n_iter = it + 1
             if diff < self.tol:

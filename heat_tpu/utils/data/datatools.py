@@ -12,8 +12,8 @@ from __future__ import annotations
 from typing import Optional, Sequence, Union
 
 import jax
-import numpy as np
 
+from ...core import random as ht_random
 from ...core.dndarray import DNDarray
 
 __all__ = ["Dataset", "DataLoader", "dataset_shuffle", "dataset_ishuffle"]
@@ -45,8 +45,13 @@ class Dataset:
         return items[0] if len(items) == 1 else tuple(items)
 
     def shuffle(self, seed: Optional[int] = None):
-        """Global permutation of the sample axis (reference: Alltoall exchange)."""
-        key = jax.random.key(seed if seed is not None else np.random.randint(2**31))
+        """Global permutation of the sample axis (reference: Alltoall exchange).
+
+        The default seed comes from the broadcast RNG state
+        (``ht_random.derive_seed()``), never process entropy: every SPMD
+        rank must derive the IDENTICAL permutation or the shuffle silently
+        desynchronizes the sample axis across ranks."""
+        key = jax.random.key(seed if seed is not None else ht_random.derive_seed())
         n = len(self)
         perm = jax.random.permutation(key, n)
         new = []
@@ -57,8 +62,9 @@ class Dataset:
         self.arrays = new
 
     def ishuffle_start(self, seed: Optional[int] = None):
-        """Dispatch next epoch's shuffle asynchronously (JAX async dispatch)."""
-        key = jax.random.key(seed if seed is not None else np.random.randint(2**31))
+        """Dispatch next epoch's shuffle asynchronously (JAX async dispatch);
+        the default seed is broadcast-derived like :meth:`shuffle`."""
+        key = jax.random.key(seed if seed is not None else ht_random.derive_seed())
         perm = jax.random.permutation(key, len(self))
         self._pending = [a._jarray[perm] for a in self.arrays]
 

@@ -16,6 +16,7 @@ from typing import Optional
 import numpy as np
 
 from ...core import factories
+from ...core import random as ht_random
 from .datatools import Dataset
 
 __all__ = ["MNISTDataset"]
@@ -40,8 +41,13 @@ def _find(root: str, names) -> Optional[str]:
 
 
 def _synthetic(n: int, seed: int):
-    """Deterministic digit-like blobs: class k = gaussian bump at position k."""
-    rng = np.random.default_rng(seed)
+    """Deterministic digit-like blobs: class k = gaussian bump at position k.
+
+    The generator comes from the sanctioned ``ht_random.host_rng`` route:
+    callers pass an explicit seed, and the contract (documented there) is
+    that it must be rank-uniform so every SPMD process synthesizes the
+    identical dataset."""
+    rng = ht_random.host_rng(seed)
     labels = rng.integers(0, 10, size=n).astype(np.int32)
     yy, xx = np.mgrid[0:28, 0:28].astype(np.float32)
     cx = 4 + 2.2 * (labels % 5)

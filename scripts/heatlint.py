@@ -8,16 +8,34 @@ Usage:
     python scripts/heatlint.py heat_tpu/ --write-baseline   # regenerate
     python scripts/heatlint.py heat_tpu/ --select HT3*      # prefix wildcard
     python scripts/heatlint.py heat_tpu/ --split-inventory SPLIT_INVENTORY.json
-    python scripts/heatlint.py --list-rules                 # severity + level
+    python scripts/heatlint.py heat_tpu/ --split-plan MIGRATION_PLAN.json
+    python scripts/heatlint.py heat_tpu/ --split-apply 0    # execute a tranche
+    python scripts/heatlint.py heat_tpu/ --fix              # proof-carrying autofix
+    python scripts/heatlint.py heat_tpu/ --fix --dry-run-diff
+    python scripts/heatlint.py heat_tpu/ --fix-check        # CI: no autofixable news
+    python scripts/heatlint.py --list-rules                 # severity + fixable
 
 Exit codes: 0 = clean (no ERROR findings beyond the committed baseline),
-1 = new error findings, 2 = usage error.  ``info``-severity findings (the
-interprocedural rules' unresolved-call downgrades) never gate — they are
-counted in the summary, listed with ``--show-info``, and carried in the
-JSON/SARIF output at note level.
+1 = new error findings (after fixes, under ``--fix``; any autofixable new
+finding, under ``--fix-check``), 2 = usage error.  ``info``-severity
+findings (the interprocedural rules' unresolved-call downgrades) never
+gate — they are counted in the summary, listed with ``--show-info``, and
+carried in the JSON/SARIF output at note level.
+
+Autofix (``--fix``): each fixable finding is rewritten ONLY when its
+safety proof holds (0-d + untraced for host syncs, literal seed for
+entropy, no-caller-armed-deadline for waits — see analysis/fixes.py);
+unprovable sites are left byte-identical with a per-site refusal reason
+in the summary and ``--json``.  Every run asserts the engine's contract
+before writing: fixed files re-lint clean for their fingerprints, and
+fix ∘ fix = fix (a second pass plans zero edits).  ``--dry-run-diff``
+prints the unified diffs instead of writing.  SARIF output carries the
+planned patches as ``fixes`` objects.
 
 Suppressions: ``# heatlint: disable=HT101`` on the offending line,
-``# heatlint: disable-file=HT101`` anywhere for the whole file.
+``# heatlint: disable-file=HT101`` anywhere for the whole file.  A line
+suppression that suppresses nothing is itself a finding (HT110) with a
+fixer that deletes it.
 The baseline (default: .heatlint-baseline.json next to the repo root)
 grandfathers pre-existing findings by fingerprint — line drift does not
 invalidate it, and ``--write-baseline`` regenerates it after intentional
@@ -63,10 +81,14 @@ def _load_analysis():
     pkg.framework = framework
     rules = importlib.import_module(name + ".rules")
     pkg.rules = rules
+    pkg.fixes = importlib.import_module(name + ".fixes")
+    pkg.splitmig = importlib.import_module(name + ".splitmig")
     return framework
 
 
 _fw = _load_analysis()
+_fixes = sys.modules["_heatlint_analysis.fixes"]
+_splitmig = sys.modules["_heatlint_analysis.splitmig"]
 all_rules = _fw.all_rules
 lint_paths = _fw.lint_paths
 load_baseline = _fw.load_baseline
@@ -129,27 +151,98 @@ def main(argv=None) -> int:
         "work list: every .split read, split= kwarg, resplit* call, split "
         "parameter) as JSON to FILE ('-' = stdout)",
     )
+    ap.add_argument(
+        "--split-plan",
+        metavar="FILE",
+        help="write the named-axis migration plan (every inventory site "
+        "classified mechanical-vs-semantic and ordered into call-graph "
+        "dependency tranches) as JSON to FILE ('-' = stdout)",
+    )
+    ap.add_argument(
+        "--split-apply",
+        metavar="TRANCHE",
+        type=int,
+        help="execute a migration tranche's mechanical rewrites against the "
+        "core/axisspec.py shim (split=<k> -> split=axisspec.named(<k>)); "
+        "honors --dry-run-diff",
+    )
+    ap.add_argument(
+        "--fix",
+        action="store_true",
+        help="apply every provable autofix (post-fix re-lint + idempotence "
+        "asserted before anything is written); unprovable sites are left "
+        "byte-identical with a refusal reason",
+    )
+    ap.add_argument(
+        "--dry-run-diff",
+        action="store_true",
+        help="with --fix/--split-apply: print unified diffs instead of writing",
+    )
+    ap.add_argument(
+        "--fix-check",
+        action="store_true",
+        help="fail (exit 1) if any NEW finding is autofixable — the CI gate "
+        "that keeps autofixable debt at zero",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        # severity + program-level flag: a program-level rule consumes the
-        # package-wide Program (call graph + summaries + absint); a file
-        # rule sees one parsed module at a time
+        # severity + program-level flag + fixable column: a program-level
+        # rule consumes the package-wide Program (call graph + summaries +
+        # absint); a fixable rule has a registered proof-carrying autofixer
+        fixable = set(_fixes.fixable_rules())
         for rule in all_rules():
             level = "program" if rule.program_level else "file"
+            fix_col = "fixable" if rule.code in fixable else "-------"
             print(
-                f"{rule.code}  {rule.name:32s} [{level:7s}] [{rule.severity}]  "
-                f"{rule.description}"
+                f"{rule.code}  {rule.name:32s} [{level:7s}] [{rule.severity}] "
+                f"[{fix_col}]  {rule.description}"
             )
         return 0
 
     if not args.paths:
         ap.error("no paths given (try: heat_tpu/)")
+    if args.fix and args.fix_check:
+        ap.error("--fix and --fix-check are mutually exclusive (apply vs gate)")
+    if args.fix and args.write_baseline:
+        ap.error("--fix and --write-baseline are mutually exclusive")
+    if (args.fix or args.fix_check) and args.split_apply is not None:
+        # both rewrite (or plan against) the same pre-lint sources: the
+        # second writer would clobber the first's edits, and fix plans
+        # computed pre-apply would render against post-apply sources —
+        # run them as two passes
+        ap.error(
+            "--fix/--fix-check and --split-apply are mutually exclusive (run two passes)"
+        )
+    if args.dry_run_diff and not (args.fix or args.split_apply is not None):
+        ap.error("--dry-run-diff requires --fix or --split-apply")
 
     select = [c for c in (args.select or "").split(",") if c.strip()] or None
+    want_fix = args.fix or args.fix_check
+    if want_fix and select:
+        try:
+            selected_codes = {r.code for r in all_rules(select)}
+        except ValueError as exc:
+            print(f"heatlint: {exc}", file=sys.stderr)
+            return 2
+        fixable = set(_fixes.fixable_rules())
+        if not (selected_codes & fixable):
+            # mirrors the --write-baseline/--select refusal: a typo'd or
+            # fixer-less selection must fail loudly, not silently fix nothing
+            print(
+                f"heatlint: --select {args.select!r} matches no fixable rule — "
+                f"fixers exist for {sorted(fixable)}",
+                file=sys.stderr,
+            )
+            return 2
+
+    want_split = args.split_plan or args.split_apply is not None
+    need_extras = want_fix or want_split
     cache_path = None if args.no_cache else args.summaries_cache
     unresolved: list = []
     split_inventory: list = []
+    contexts: dict = {}
+    program_holder: list = []
     try:
         findings = lint_paths(
             args.paths,
@@ -157,12 +250,90 @@ def main(argv=None) -> int:
             cache_path=cache_path,
             unresolved_out=unresolved,
             split_inventory_out=(
-                split_inventory if args.split_inventory else None
+                split_inventory if (args.split_inventory or want_split) else None
             ),
+            contexts_out=contexts if need_extras else None,
+            program_out=program_holder if need_extras else None,
         )
     except ValueError as exc:
         print(f"heatlint: {exc}", file=sys.stderr)
         return 2
+    program = program_holder[0] if program_holder else None
+
+    # info findings (unresolved-call downgrades) are reported, never gated,
+    # never baselined: a baseline entry would imply a human signed off on a
+    # conclusion the analysis itself says it cannot prove
+    errors = [f for f in findings if f.severity == "error"]
+    info = [f for f in findings if f.severity != "error"]
+
+    # ---- autofix planning/execution (file paths still as linted) ---- #
+    fix_outcome = None
+    fix_attempts = None
+    if want_fix:
+        fix_attempts = _fixes.plan_fixes(errors, contexts, program)
+    if args.fix:
+        try:
+            fix_outcome = _fixes.execute_fixes(
+                fix_attempts, contexts, write=not args.dry_run_diff
+            )
+        except _fixes.FixError as exc:
+            print(f"heatlint: FIX CONTRACT VIOLATION: {exc}", file=sys.stderr)
+            return 2
+
+    # ---- migration plan / tranche execution (pre-normalization) ---- #
+    split_plan_obj = None
+    split_apply_report = None
+    if want_split:
+        split_plan_obj = _splitmig.build_plan(split_inventory, program, contexts)
+        if args.split_apply is not None:
+            edits, skipped = _splitmig.tranche_edits(
+                split_plan_obj, contexts, tranche=args.split_apply
+            )
+            by_path: dict = {}
+            for e in edits:
+                by_path.setdefault(e.path, []).append(e)
+            import difflib
+
+            split_apply_report = {"files": sorted(by_path), "edits": len(edits),
+                                  "skipped": len(skipped)}
+            for path in sorted(by_path):
+                src = contexts[path].source
+                new_src = _fixes.apply_edits(src, by_path[path])
+                if args.dry_run_diff:
+                    sys.stdout.write(
+                        "".join(
+                            difflib.unified_diff(
+                                src.splitlines(keepends=True),
+                                new_src.splitlines(keepends=True),
+                                fromfile=f"a/{path}",
+                                tofile=f"b/{path}",
+                            )
+                        )
+                    )
+                else:
+                    with open(path, "w", encoding="utf-8") as fh:
+                        fh.write(new_src)
+            # the plan (and inventory) written below must reflect the tree
+            # we leave behind — re-lint from scratch rather than patching:
+            # an inserted import shifts every later line, so reusing the
+            # pre-edit inventory would commit stale line numbers that fail
+            # the CI drift gate on the very next regeneration
+            if by_path and not args.dry_run_diff:
+                split_inventory = []
+                contexts = {}
+                rebuild_holder: list = []
+                lint_paths(
+                    args.paths,
+                    select=select,
+                    cache_path=cache_path,
+                    split_inventory_out=split_inventory,
+                    contexts_out=contexts,
+                    program_out=rebuild_holder,
+                )
+                program = rebuild_holder[0] if rebuild_holder else program
+                split_plan_obj = _splitmig.build_plan(
+                    split_inventory, program, contexts
+                )
 
     # normalize paths relative to the baseline file's directory so the
     # committed baseline matches regardless of how the CLI was invoked
@@ -183,6 +354,9 @@ def main(argv=None) -> int:
         u["caller_path"] = _norm(u["caller_path"])
     for s in split_inventory:
         s["path"] = _norm(s["path"])
+    if split_plan_obj is not None:
+        for s in split_plan_obj["sites"]:
+            s["path"] = _norm(s["path"])
 
     if args.split_inventory:
         by_kind: dict = {}
@@ -210,11 +384,13 @@ def main(argv=None) -> int:
             with open(args.split_inventory, "w", encoding="utf-8") as fh:
                 fh.write(catalog + "\n")
 
-    # info findings (unresolved-call downgrades) are reported, never gated,
-    # never baselined: a baseline entry would imply a human signed off on a
-    # conclusion the analysis itself says it cannot prove
-    errors = [f for f in findings if f.severity == "error"]
-    info = [f for f in findings if f.severity != "error"]
+    if args.split_plan:
+        payload = _splitmig.render_plan(split_plan_obj)
+        if args.split_plan == "-":
+            print(payload, end="")
+        else:
+            with open(args.split_plan, "w", encoding="utf-8") as fh:
+                fh.write(payload)
 
     if args.write_baseline:
         if select:
@@ -249,11 +425,46 @@ def main(argv=None) -> int:
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, grandfathered = split_by_baseline(errors, baseline)
 
+    # JSON-facing fix records (findings are normalized by now, so the
+    # fingerprints match the findings sections)
+    fixes_json = None
+    if fix_attempts is not None:
+        fixes_json = {
+            "applied": [
+                {
+                    "fingerprint": a.finding.fingerprint,
+                    "rule": a.finding.rule,
+                    "path": a.finding.path,
+                    "line": a.finding.line,
+                    "qualname": a.finding.qualname,
+                    "fixer": a.fixer,
+                }
+                for a in fix_attempts
+                if a.refusal is None and a.edits
+            ],
+            "refused": [
+                {
+                    "fingerprint": a.finding.fingerprint,
+                    "rule": a.finding.rule,
+                    "path": a.finding.path,
+                    "line": a.finding.line,
+                    "qualname": a.finding.qualname,
+                    "fixer": a.fixer,
+                    "reason": a.refusal,
+                }
+                for a in fix_attempts
+                if a.refusal is not None
+            ],
+        }
+
     if args.json:
         # the unresolved bucket rides along in the machine output: the
         # honesty policy's audit trail of every call the engine could not
-        # place, with its reason — never silently dropped
-        payload = render_json(new, grandfathered, info=info, unresolved=unresolved)
+        # place, with its reason — never silently dropped (same for the
+        # autofix refusal reasons)
+        payload = render_json(
+            new, grandfathered, info=info, unresolved=unresolved, fixes=fixes_json
+        )
         if args.json == "-":
             print(payload)
         else:
@@ -261,9 +472,75 @@ def main(argv=None) -> int:
                 fh.write(payload + "\n")
 
     if args.sarif:
-        sarif = render_sarif(new, grandfathered, info=info, rules=all_rules(select))
+        sarif_fix_map = (
+            _fixes.sarif_fixes(fix_attempts, contexts, norm=_norm)
+            if fix_attempts is not None
+            else None
+        )
+        sarif = render_sarif(
+            new, grandfathered, info=info, rules=all_rules(select), fixes=sarif_fix_map
+        )
         with open(args.sarif, "w", encoding="utf-8") as fh:
             fh.write(sarif + "\n")
+
+    # ---- human-facing fix/migration summaries + exit codes ---- #
+    if split_apply_report is not None:
+        print(
+            f"splitmig: tranche {args.split_apply} — "
+            f"{split_apply_report['edits']} edit(s) across "
+            f"{len(split_apply_report['files'])} file(s), "
+            f"{split_apply_report['skipped']} skipped"
+            + (" [dry run]" if args.dry_run_diff else "")
+        )
+
+    if args.fix_check:
+        new_ids = {id(f) for f in new}
+        offenders = [
+            a for a in fix_attempts if a.edits and not a.refusal and id(a.finding) in new_ids
+        ]
+        refused_new = sum(
+            1 for a in fix_attempts if a.refusal is not None and id(a.finding) in new_ids
+        )
+        if offenders:
+            for a in offenders:
+                print(
+                    f"{a.finding.path}:{a.finding.line}: {a.finding.rule} is "
+                    f"autofixable ({a.fixer}) — run scripts/heatlint.py --fix"
+                )
+            print(
+                f"heatlint: --fix-check FAILED: {len(offenders)} autofixable "
+                f"new finding(s) ({refused_new} unprovable refusal(s) reported only)"
+            )
+            return 1
+        print("heatlint: --fix-check OK: no autofixable new findings")
+        return 0
+
+    if fix_outcome is not None:
+        if args.dry_run_diff:
+            for path in sorted(fix_outcome.diffs):
+                sys.stdout.write(fix_outcome.diffs[path])
+        for rec in fixes_json["refused"]:
+            print(
+                f"{rec['path']}:{rec['line']}: {rec['rule']} NOT fixed — {rec['reason']}"
+            )
+        print(
+            f"heatfix: {len(fix_outcome.applied)} fix(es) "
+            + ("planned [dry run]" if args.dry_run_diff else "applied")
+            + f" across {len(fix_outcome.new_sources)} file(s), "
+            f"{len(fix_outcome.refused)} refusal(s); post-fix re-lint clean, "
+            "fix∘fix = fix"
+        )
+        # match by object identity, not fingerprint: fingerprints are a
+        # MULTISET (two same-detail findings in one function are real), so
+        # a fixed site must not absolve an unfixed sibling sharing its
+        # fingerprint
+        fixed_ids = {id(a.finding) for a in fix_attempts if a.edits and not a.refusal}
+        remaining_new = [f for f in new if id(f) not in fixed_ids]
+        if remaining_new:
+            for f in remaining_new:
+                print(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message} [in {f.qualname}]")
+            return 1
+        return 0
 
     print(
         render_text(

@@ -1,0 +1,132 @@
+"""core/axisspec.py — the split ↔ named-spec shim (mesh-refactor tranche 0).
+
+The shim's whole contract is *zero behavior change*: ``named(k)`` IS the
+int ``k`` everywhere the runtime looks (equality, hashing, arithmetic,
+serialization, cache keys, shardings), while carrying the named-spec view
+the future partitioner consumes.  These tests prove the construction and
+the round-trip both ways.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import axisspec
+from heat_tpu.core.axisspec import AxisSpec, named, spec_to_split, split_to_spec
+
+
+class TestIntEquivalence:
+    def test_named_is_the_int(self):
+        k = named(1)
+        assert k == 1 and 1 == k
+        assert isinstance(k, int)
+        assert hash(k) == hash(1)
+        assert k + 1 == 2 and k * 3 == 3 and -k == -1
+        assert list(range(3))[k] == 1  # indexing
+        assert f"{k}" == "1" and str(k) == "1"
+
+    def test_dict_and_set_keying_identical(self):
+        d = {0: "a", 1: "b"}
+        assert d[named(0)] == "a" and d[named(1)] == "b"
+        assert {named(0), 0} == {0}
+
+    def test_json_serialization_identical(self):
+        assert json.dumps({"split": named(0)}) == json.dumps({"split": 0})
+
+    def test_named_none_stays_none(self):
+        assert named(None) is None
+
+    def test_named_rejects_non_ints(self):
+        with pytest.raises(TypeError):
+            named("data")
+        with pytest.raises(TypeError):
+            named(True)
+
+    def test_repr_and_str_stay_ints(self):
+        # a custom repr would leak through object.__str__ into f-strings
+        # and format() — the shim keeps ALL text output identical
+        assert repr(named(0)) == "0" and str(named(0)) == "0"
+        assert axisspec.is_named(named(0))
+        assert not axisspec.is_named(0)
+
+
+class TestSpecRoundTrip:
+    @pytest.mark.parametrize("ndim", [1, 2, 3, 4])
+    def test_round_trip_every_axis(self, ndim):
+        for s in [None] + list(range(ndim)):
+            spec = split_to_spec(s, ndim)
+            assert len(spec) == ndim
+            assert spec_to_split(spec) == s
+
+    def test_negative_split_normalizes(self):
+        assert split_to_spec(-1, 3) == (None, None, "data")
+        assert spec_to_split(split_to_spec(-1, 3)) == 2
+
+    def test_replicated_spec(self):
+        assert split_to_spec(None, 3) == (None, None, None)
+        assert spec_to_split((None, None)) is None
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            split_to_spec(3, 2)
+
+    def test_multi_axis_spec_rejected(self):
+        with pytest.raises(ValueError, match="names 2 axes"):
+            spec_to_split(("data", "data"))
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            spec_to_split((None, "model"))
+
+    def test_axisspec_spec_view(self):
+        assert named(1).spec(3) == (None, "data", None)
+        assert named(1).axis_name == "data"
+
+
+class TestZeroBehaviorChange:
+    """A migrated call site (split=named(k)) must be bit-identical to the
+    raw int at every runtime layer: metadata, sharding, values, and the
+    sharding-keyed program cache."""
+
+    def test_factory_sharding_identical(self):
+        a = ht.zeros((8, 8), split=0)
+        b = ht.zeros((8, 8), split=named(0))
+        assert b.split == 0 and a.split == b.split
+        assert a._jarray.sharding == b._jarray.sharding
+        assert np.array_equal(a.numpy(), b.numpy())
+
+    def test_random_factory_identical_stream(self):
+        ht.random.seed(1234)
+        a = ht.random.randn(16, 4, split=0)
+        ht.random.seed(1234)
+        b = ht.random.randn(16, 4, split=named(0))
+        assert np.array_equal(a.numpy(), b.numpy())
+        assert a._jarray.sharding == b._jarray.sharding
+
+    def test_program_cache_key_shared(self):
+        # the PR 1 cache keys on (op, avals, split): named(0) must HIT the
+        # split=0 entry, proving migrated sites recompile nothing
+        from heat_tpu.utils import profiler
+
+        x = ht.ones((32, 32), split=0)
+        y = ht.ones((32, 32), split=named(0))
+        _ = (x + 1.0).numpy()  # warm the program
+        before = profiler.cache_stats()["misses"]
+        _ = (y + 1.0).numpy()
+        after = profiler.cache_stats()["misses"]
+        assert after == before, "named(0) must not recompile the split=0 program"
+
+    def test_resplit_accepts_named(self):
+        a = ht.arange(64, split=0).reshape((8, 8))
+        b = a.resplit(named(1))
+        assert b.split == 1
+        assert np.array_equal(a.numpy(), b.numpy())
+
+    def test_jnp_indexing_with_axisspec(self):
+        # shape[named(0)] and jnp reductions over an AxisSpec axis behave
+        arr = jnp.ones((4, 6))
+        assert arr.shape[named(1)] == 6
+        assert jnp.sum(arr, axis=named(1)).shape == (4,)

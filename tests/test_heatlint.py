@@ -758,7 +758,7 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
-            "HT108", "HT109", "HT201", "HT202", "HT203", "HT204",
+            "HT108", "HT109", "HT110", "HT201", "HT202", "HT203", "HT204",
             "HT301", "HT302", "HT303", "HT304",
         ]
 
