@@ -69,6 +69,38 @@ def _time_op(fn, sync, reps):
     return _mins(_time_interleaved([fn], sync, reps))[0]
 
 
+def _rotated_hook_gate(floor_fn, off_fn, off2_fn, on_fn, sync, reps):
+    """The shared measurement of the hook gates (flightrec, memledger):
+    rotated pairwise rounds hardened for cpu-quota-throttled hosts.
+    (1) the three hook states ROTATE through the round positions (the
+    later path in a round is systematically slower as quota decays, and a
+    fixed order biases the delta positive); (2) an off-vs-off NULL in the
+    same rounds sets the noise floor — a measurement cannot assert a
+    regression below its own noise; (3) the on-vs-off paired deltas must
+    shift WHOLESALE (q25 > 0) before a gate may fail: a real regression
+    taxes every round, symmetric scheduler noise cannot.  Returns
+    ``(off_above_floor_us, added_us, noise_floor_us, consistent,
+    added_pct)``."""
+    s_floor, s_off, s_off2, s_on = [], [], [], []
+    rotation = [(off_fn, s_off), (off2_fn, s_off2), (on_fn, s_on)]
+    for i in range(reps):
+        order = rotation[i % 3:] + rotation[: i % 3]
+        for fn, out_samples in [(floor_fn, s_floor)] + order:
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(20):
+                out = fn()
+            sync(out)
+            out_samples.append((time.perf_counter() - t0) / 20 * 1e6)
+    off_oh = max(_paired_delta(s_off, s_floor), 1.0)
+    added_us = _paired_delta(s_on, s_off)
+    d_null = sorted(a - b for a, b in zip(s_off2, s_off))
+    noise_us = abs(d_null[len(d_null) // 2])
+    d_on = sorted(a - b for a, b in zip(s_on, s_off))
+    consistent = d_on[len(d_on) // 4] > 0.0
+    return off_oh, added_us, noise_us, consistent, added_us / off_oh * 100.0
+
+
 def _peak_rss_subprocess(mode: str, size: int) -> float:
     """Peak RSS (MB) of one resplit of a (size, size) f32 array, measured in
     a fresh process so allocator history doesn't pollute the peak."""
@@ -190,6 +222,15 @@ def main(argv=None) -> int:
                          "telemetry gate — the monitor adds NO hot-path "
                          "hook, so this measures pure scrape-thread "
                          "interference)")
+    ap.add_argument("--memledger-gate", type=float, default=None, metavar="PCT",
+                    help="exit 8 if the armed device-memory ledger adds more "
+                         "than PCT%% to the dispatch cost above the "
+                         "compiled-program floor (the ISSUE 14 per-buffer "
+                         "registration overhead contract; same rotated "
+                         "pairwise methodology + off-vs-off noise floor + "
+                         "q25 wholesale-shift guard as the flightrec gate; "
+                         "the disarmed path stays ONE module-global load by "
+                         "construction)")
     ap.add_argument("--resplit-gate", action="store_true",
                     help="run the budgeted-resplit peak-RSS gate: exit 5 when "
                          "the chunked pipeline's peak RSS exceeds "
@@ -330,49 +371,63 @@ def main(argv=None) -> int:
 
     cached_fr_on()
     cached_fr_off()
-    # Two methodology hardenings over the plain fixed-order pairing, both
-    # forced by cpu-quota-throttled hosts where the *null* (off vs off)
-    # pairwise median alone swings by tens of µs — two orders above the
-    # sub-µs signal being measured:
-    # (1) ROTATE the three paths through the round positions, because the
-    #     later path in a round is systematically slower (quota decays
-    #     within the round) and a fixed order biases the delta positive;
-    # (2) measure the off-vs-off NULL in the same rounds and refuse to
-    #     flag an armed delta smaller than it — a measurement cannot
-    #     assert a regression below its own noise floor.  On a quiet CI
-    #     host the null is ~0 and the 5% threshold is what gates; a real
-    #     record_dispatch regression (µs scale, added to every round)
-    #     clears the null and still fails the gate anywhere.
-    s_floor3, s_fr_off, s_fr_off2, s_fr_on = [], [], [], []
-    rotation = [
-        (cached_fr_off, s_fr_off),
-        (cached_fr_off2, s_fr_off2),
-        (cached_fr_on, s_fr_on),
-    ]
-    for i in range(args.reps):
-        order = rotation[i % 3 :] + rotation[: i % 3]
-        for fn, out_samples in [(lambda: floor_prog(j1, j2), s_floor3)] + order:
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(20):
-                out = fn()
-            sync(out)
-            out_samples.append((time.perf_counter() - t0) / 20 * 1e6)
+    # rotated pairwise + null + q25 wholesale-shift guard — the shared
+    # throttled-host hardening, see _rotated_hook_gate
+    fr_off_oh, fr_added_us, fr_noise_us, fr_consistent, fr_added_pct = (
+        _rotated_hook_gate(
+            lambda: floor_prog(j1, j2), cached_fr_off, cached_fr_off2,
+            cached_fr_on, sync, args.reps,
+        )
+    )
     _ops._FLIGHTREC = None
     flightrec.disable()
     shutil.rmtree(fr_ring_dir, ignore_errors=True)
-    fr_off_oh = max(_paired_delta(s_fr_off, s_floor3), 1.0)
-    fr_added_us = _paired_delta(s_fr_on, s_fr_off)
-    d_null = sorted(a - b for a, b in zip(s_fr_off2, s_fr_off))
-    fr_noise_us = abs(d_null[len(d_null) // 2])
-    # a REAL regression is added to every round, so the paired deltas shift
-    # wholesale: their 25th percentile goes positive.  Symmetric round
-    # noise (which can push the median draw arbitrarily high on a
-    # throttled host) cannot do that — this is what keeps the gate from
-    # flapping where the noise floor draw alone happens to come out low.
-    d_on = sorted(a - b for a, b in zip(s_fr_on, s_fr_off))
-    fr_consistent = d_on[len(d_on) // 4] > 0.0
-    fr_added_pct = fr_added_us / fr_off_oh * 100.0
+
+    # --- memory-ledger-armed dispatch overhead (ISSUE 14 contract) ----- #
+    # identical rotated-pairwise methodology to the flightrec gate: cached
+    # dispatch with the ledger hooks disarmed vs armed, an off-vs-off null
+    # in the same rounds as the noise floor, and the q25 wholesale-shift
+    # guard.  What the armed path pays HERE is exactly what production's
+    # hot loop pays: these 256 KiB outputs sit under the 1 MiB dispatch
+    # threshold, so each dispatch is register_dispatch's COALESCED tier —
+    # one call + aval byte math + a counter bump (the flightrec cost
+    # class).  The full register() path (weakref + entry + provenance,
+    # ~5 µs) is deliberately NOT gated at 5%: it runs only for ≥1 MiB
+    # buffers, where microseconds amortize against megabyte lifetimes —
+    # its correctness (and cost class) is pinned by tests/test_memledger
+    # instead.  BOTH hook modules toggle (dispatch tail + _from_parts).
+    ml_added_pct = ml_added_us = ml_off_oh = ml_noise_us = float("nan")
+    ml_consistent = False
+    if args.memledger_gate is not None:
+        from heat_tpu.core import dndarray as _dnd
+        from heat_tpu.utils import memledger
+
+        memledger.enable()
+
+        def cached_ml_off():
+            _ops._MEMLEDGER = None
+            _dnd._MEMLEDGER = None
+            return x + y
+
+        def cached_ml_on():
+            _ops._MEMLEDGER = memledger
+            _dnd._MEMLEDGER = memledger
+            return x + y
+
+        def cached_ml_off2():  # second, identical off path: the NULL
+            _ops._MEMLEDGER = None
+            _dnd._MEMLEDGER = None
+            return x + y
+
+        cached_ml_on()
+        cached_ml_off()
+        ml_off_oh, ml_added_us, ml_noise_us, ml_consistent, ml_added_pct = (
+            _rotated_hook_gate(
+                lambda: floor_prog(j1, j2), cached_ml_off, cached_ml_off2,
+                cached_ml_on, sync, args.reps,
+            )
+        )
+        memledger.disable()
 
     # --- monitor-armed dispatch overhead (ISSUE 11 contract) ----------- #
     # the /metrics endpoint adds NO hot-path hook (there is nothing to
@@ -633,6 +688,16 @@ def main(argv=None) -> int:
             "flightrec_on_added_us_snapshot": round(fr_added_us, 2),
             "flightrec_on_added_dispatch_pct": round(fr_added_pct, 1),
             "flightrec_noise_floor_us_snapshot": round(fr_noise_us, 2),
+            # NaN-guarded like the monitor rows below: a run without
+            # --memledger-gate must not write the invalid `NaN` token
+            "memledger_off_above_floor_us_snapshot": round(ml_off_oh, 2)
+            if ml_off_oh == ml_off_oh else None,
+            "memledger_on_added_us_snapshot": round(ml_added_us, 2)
+            if ml_added_us == ml_added_us else None,
+            "memledger_on_added_dispatch_pct": round(ml_added_pct, 1)
+            if ml_added_pct == ml_added_pct else None,
+            "memledger_noise_floor_us_snapshot": round(ml_noise_us, 2)
+            if ml_noise_us == ml_noise_us else None,
             # NaN-guarded (x == x): a run without --monitor-gate must not
             # write the invalid-strict-JSON `NaN` token into the payload
             "monitor_quiet_above_floor_us_snapshot": round(mon_off_oh, 2)
@@ -676,6 +741,22 @@ def main(argv=None) -> int:
             f"off-vs-off noise floor {fr_noise_us:.2f} us)",
             file=sys.stderr,
         )
+    memledger_gate_ok = True
+    if (
+        args.memledger_gate is not None
+        and ml_added_pct > args.memledger_gate
+        and ml_added_us > ml_noise_us
+        and ml_consistent
+    ):
+        memledger_gate_ok = False
+        print(
+            f"MEMLEDGER GATE: the armed device-memory ledger adds "
+            f"{ml_added_pct:.1f}% ({ml_added_us:.2f} us) to the dispatch "
+            f"cost above floor ({ml_off_oh:.1f} us; limit "
+            f"{args.memledger_gate:.1f}%, in-run off-vs-off noise floor "
+            f"{ml_noise_us:.2f} us, wholesale shift confirmed)",
+            file=sys.stderr,
+        )
     monitor_gate_ok = True
     if (
         args.monitor_gate is not None
@@ -709,6 +790,8 @@ def main(argv=None) -> int:
         return 6
     if not monitor_gate_ok:
         return 7
+    if not memledger_gate_ok:
+        return 8
     return 0
 
 

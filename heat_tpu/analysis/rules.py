@@ -983,6 +983,124 @@ class StaleSuppressionRule(Rule):
 
 
 # -------------------------------------------------------------------- #
+# HT111 — device buffers minted around the memory-ledger choke points
+# -------------------------------------------------------------------- #
+
+
+@register
+class UnledgeredDeviceBufferRule(Rule):
+    """Every long-lived device buffer should be minted through a
+    memory-ledger registration choke point (``factories._finalize``,
+    ``DNDarray._from_parts``, ``Communication.resplit``, checkpoint load)
+    — that is what makes ``mem.live_bytes`` truthful and gives an OOM
+    post-mortem its provenance.  Library code creating mesh buffers
+    around those points is invisible to the ledger: the live-bytes gauge
+    under-reports, and the buffer shows up in an OOM dump as nothing at
+    all.  Same shape as HT108's seq-stamp rule.  Flagged in library code
+    (outside the registration layer itself):
+
+    - ``jax.make_array_from_callback(...)`` — raw global-buffer assembly;
+      the sanctioned wrapper is ``communication._array_from_callback``
+      (whose callers wrap the result in a registering constructor);
+    - a ``device_put`` whose placement argument lexically mentions mesh
+      sharding machinery (``NamedSharding``/``comm.sharding(...)``) —
+      a mesh buffer minted outside the choke points.  ``device_put`` onto
+      a plain *device* (the hosted-complex transport commit) is not a
+      mesh buffer and is not flagged.
+
+    An enclosing function that itself registers the buffer with the
+    ledger (``memledger.register(...)`` / ``_MEMLEDGER.register(...)``)
+    is a sanctioned registrar — the optimizer's parameter placement does
+    exactly this — and is exempt."""
+
+    code = "HT111"
+    name = "unledgered-device-buffer"
+    description = "device buffer minted around the memory-ledger registration choke points"
+
+    SANCTIONED_MODULES = (
+        # the registration layer: these ARE the choke points (or feed them)
+        "core/communication.py",
+        "core/factories.py",
+        "core/dndarray.py",
+        "core/io.py",
+        "core/redistribution.py",
+        "core/_operations.py",
+        "core/_complexsafe.py",  # host-backend commit — not a mesh buffer
+        "utils/memledger.py",
+    )
+    SHARDING_MARKERS = {"sharding", "NamedSharding", "PositionalSharding"}
+    LEDGER_NAMES = {"memledger", "_memledger", "_MEMLEDGER", "_ml"}
+
+    def _mentions_sharding(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in self.SHARDING_MARKERS:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.SHARDING_MARKERS:
+                return True
+        return False
+
+    def _function_registers(self, ctx: LintContext, node: ast.AST) -> bool:
+        """True when the enclosing function lexically registers with the
+        ledger (``memledger.register(...)``) — it IS a registrar, the
+        HT104 "accounting counts as delegation" shape."""
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            if last_attr(sub) not in ("register", "reclassify"):
+                continue
+            dn = call_name(sub)
+            if dn and any(part in self.LEDGER_NAMES for part in dn.split(".")):
+                return True
+        return False
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.SANCTIONED_MODULES):
+            return []
+        out = []
+        for node in ctx.walk(ast.Call):
+            la = last_attr(node)
+            if la == "make_array_from_callback":
+                if self._function_registers(ctx, node):
+                    continue
+                f = ctx.finding(
+                    self, node,
+                    "raw `make_array_from_callback` mints a device buffer the "
+                    "memory ledger never sees — route through a registering "
+                    "constructor (factories/_from_parts) or register the "
+                    "result with memledger.register(...)",
+                    detail="make_array_from_callback",
+                )
+                if f is not None:
+                    out.append(f)
+            elif la == "device_put":
+                # placement target: second positional OR the device= kwarg
+                # (both spellings mint the buffer identically)
+                target = node.args[1] if len(node.args) >= 2 else next(
+                    (kw.value for kw in node.keywords if kw.arg == "device"),
+                    None,
+                )
+                if target is None or not self._mentions_sharding(target):
+                    continue  # plain device placement, not a mesh buffer
+                if self._function_registers(ctx, node):
+                    continue
+                f = ctx.finding(
+                    self, node,
+                    "`device_put` onto mesh sharding machinery mints a buffer "
+                    "around the ledger's registration choke points — "
+                    "mem.live_bytes under-reports and an OOM dump cannot name "
+                    "it; use the registering constructors or register the "
+                    "result with memledger.register(...)",
+                    detail="device_put",
+                )
+                if f is not None:
+                    out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
 # HT2xx — the interprocedural family (callgraph + summaries engine)
 # -------------------------------------------------------------------- #
 

@@ -62,6 +62,16 @@ _CHECKS = None
 # overhead contract, gated by ``benchmarks/dispatch.py --flightrec-gate``).
 _FLIGHTREC = None
 
+# device-memory-ledger hot-path hook (``utils.memledger.enable()`` pokes
+# the module in, ``disable()`` clears it): armed, donated operands are
+# consumed and a RESOURCE_EXHAUSTED out of a dispatched program renders
+# the ledger dump into the flight ring before re-raising; the dispatch
+# OUTPUT registration itself rides ``DNDarray._from_parts`` (one lean
+# ``register_dispatch`` call — see the threshold coalescing note there).
+# Disabled cost: one module-global load (gated by
+# ``benchmarks/dispatch.py --memledger-gate``).
+_MEMLEDGER = None
+
 
 def _run_prog(tel, name: str, op, prog, args, cache_hit: bool):
     """Run a cached dispatch executable with the telemetry tail around it
@@ -215,11 +225,16 @@ def _local_op(op: Callable, x: DNDarray, out: Optional[DNDarray] = None, **kwarg
         )
         if entry is not _SLOW:
             prog, rshape, rdtype, rsplit = entry
-            res = (
-                prog(j)
-                if tel is None
-                else _run_prog(tel, "dispatch.local", op, prog, (j,), _cache._STATS["misses"] == m0)
-            )
+            try:
+                res = (
+                    prog(j)
+                    if tel is None
+                    else _run_prog(tel, "dispatch.local", op, prog, (j,), _cache._STATS["misses"] == m0)
+                )
+            except Exception as e:
+                if _MEMLEDGER is not None:
+                    _MEMLEDGER.note_oom(e, "dispatch.local", None)
+                raise
             if _FLIGHTREC is not None:
                 _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
             ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, comm)
@@ -320,16 +335,28 @@ def _binary_op(
                         t1._jarray if d1 else t1,
                         t2._jarray if isinstance(t2, DNDarray) else t2,
                     )
-                    res = (
-                        prog(*args)
-                        if tel is None
-                        else _run_prog(
-                            tel, "dispatch.binary", op, prog, args,
-                            _cache._STATS["misses"] == m0,
+                    try:
+                        res = (
+                            prog(*args)
+                            if tel is None
+                            else _run_prog(
+                                tel, "dispatch.binary", op, prog, args,
+                                _cache._STATS["misses"] == m0,
+                            )
                         )
-                    )
+                    except Exception as e:
+                        if _MEMLEDGER is not None:
+                            _MEMLEDGER.note_oom(e, "dispatch.binary", None)
+                        raise
                     if _FLIGHTREC is not None:
                         _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
+                    if donate and _MEMLEDGER is not None and args[0].is_deleted():
+                        # the donated left operand's buffer is gone — but
+                        # only when the program REALLY consumed it: the plan
+                        # may have narrowed donation off (dtype/shape-changing
+                        # results), and is_deleted() is the runtime's own
+                        # truth, so a live buffer is never dropped early
+                        _MEMLEDGER.consume(args[0])
                     ret = DNDarray._from_parts(
                         res, rshape, rdtype, rsplit, proto.device, comm
                     )
@@ -606,11 +633,16 @@ def _reduce_op(
         )
         if entry is not _SLOW:
             prog, rshape, rdtype, rsplit = entry
-            res = (
-                prog(j)
-                if tel is None
-                else _run_prog(tel, "dispatch.reduce", op, prog, (j,), _cache._STATS["misses"] == m0)
-            )
+            try:
+                res = (
+                    prog(j)
+                    if tel is None
+                    else _run_prog(tel, "dispatch.reduce", op, prog, (j,), _cache._STATS["misses"] == m0)
+                )
+            except Exception as e:
+                if _MEMLEDGER is not None:
+                    _MEMLEDGER.note_oom(e, "dispatch.reduce", None)
+                raise
             if _FLIGHTREC is not None:
                 _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
             ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
@@ -684,11 +716,16 @@ def _cum_op(
         )
         if entry is not _SLOW:
             prog, rshape, rdtype, rsplit = entry
-            res = (
-                prog(j)
-                if tel is None
-                else _run_prog(tel, "dispatch.cum", op, prog, (j,), _cache._STATS["misses"] == m0)
-            )
+            try:
+                res = (
+                    prog(j)
+                    if tel is None
+                    else _run_prog(tel, "dispatch.cum", op, prog, (j,), _cache._STATS["misses"] == m0)
+                )
+            except Exception as e:
+                if _MEMLEDGER is not None:
+                    _MEMLEDGER.note_oom(e, "dispatch.cum", None)
+                raise
             if _FLIGHTREC is not None:
                 _FLIGHTREC.record_dispatch(getattr(op, "__name__", str(op)))
             ret = DNDarray._from_parts(res, rshape, rdtype, rsplit, x.device, x.comm)
@@ -741,7 +778,12 @@ if _t is not None and _t._ENABLED:
 _fr = _sys.modules.get("heat_tpu.utils.flightrec")
 if _fr is not None and _fr.enabled():
     _FLIGHTREC = _fr
-del _sys, _t, _fr
+# same race for the memory ledger (HEAT_TPU_MEMLEDGER=1 arms at
+# utils.memledger import time)
+_ml = _sys.modules.get("heat_tpu.utils.memledger")
+if _ml is not None and _ml.enabled():
+    _MEMLEDGER = _ml
+del _sys, _t, _fr, _ml
 
 # same race for the sanitizer: HEAT_TPU_CHECKS=1 arms at core.sanitation
 # import time, which runs DURING this module's import (sanitation is imported

@@ -77,6 +77,14 @@ _RESPLIT_CHECK = None
 # exactly like the two hooks above.
 _FLIGHTREC = None
 
+# device-memory-ledger hook (``utils.memledger.enable()`` pokes the module
+# in): resplit outputs are registration choke points, the ``mem.alloc``
+# fault site fires ahead of each transfer's allocation, donated sources
+# are consumed, and a RESOURCE_EXHAUSTED out of the transfer renders the
+# ledger dump into the flight ring before re-raising.  Disabled cost: one
+# module-global load per resplit.  Module bottom re-arms.
+_MEMLEDGER = None
+
 
 def _telemetry():
     global _TELEMETRY_MOD
@@ -517,24 +525,51 @@ class Communication:
         )
         tel = _telemetry()
         tel.counter_inc("comm.resplit.tiles", 1)
+        nbytes = _payload_nbytes(array)
         with tel.span(
             "comm.resplit",
             split=split,
             donate=donate,
-            nbytes=_payload_nbytes(array),
+            nbytes=nbytes,
             tiles=1,
         ):
-            if donate and self._donatable(array, split):
-                # no already-placed test here: _already_placed() at the top
-                # returned for every case a donatable array could hit
-                sh = self.sharding(array.ndim, split)
-                try:
-                    out = jax.device_put(array, sh, donate=True)
-                except TypeError:  # jax without the donate kwarg
-                    self._note_donate_fallback()
-                    out = jax.device_put(array, sh)
-            else:
-                out = self.shard(array, split)
+            ml = _MEMLEDGER
+            src_cat = ml.category_of(array) if ml is not None else None
+            try:
+                if ml is not None:
+                    # the mem.alloc fault site: chaos CI injects a
+                    # deterministic allocation failure ahead of the transfer
+                    ml.alloc_check(nbytes, "comm.resplit")
+                if donate and self._donatable(array, split):
+                    # no already-placed test here: _already_placed() at the
+                    # top returned for every case a donatable array could hit
+                    sh = self.sharding(array.ndim, split)
+                    donated = False
+                    try:
+                        out = jax.device_put(array, sh, donate=True)
+                        donated = True
+                    except TypeError:  # jax without the donate kwarg
+                        self._note_donate_fallback()
+                        out = jax.device_put(array, sh)
+                    if ml is not None and donated:
+                        # consumed only AFTER a successful donating transfer:
+                        # a RESOURCE_EXHAUSTED out of the device_put must
+                        # still find the in-flight source in the OOM dump
+                        # (it is typically the dominant buffer), and the
+                        # donate-less ancient-jax fallback keeps the source
+                        # alive for real.  Metadata-only id lookup, not a
+                        # buffer read.
+                        ml.consume(array)  # heatlint: disable=HT103 — ledger id-lookup decrement, no storage read
+                else:
+                    out = self.shard(array, split)
+            except Exception as e:
+                if ml is not None:
+                    ml.note_oom(e, "comm.resplit", nbytes)
+                raise
+            if ml is not None:
+                # the output inherits the source's category (a resplit moves
+                # a buffer, it does not change what the buffer IS)
+                ml.register(out, op="resplit", site="resplit", category=src_cat)
             if _RESPLIT_CHECK is not None:
                 _RESPLIT_CHECK(out, self, split, where="comm.resplit")
             return out
@@ -569,16 +604,34 @@ class Communication:
         if plan is None or plan.n_tiles <= 1:
             return self.resplit(array, split, donate=donate, memory_budget=0)
         tel = _telemetry()
+        nbytes = _payload_nbytes(array)
         with tel.span(
             "comm.resplit",
             split=split,
             donate=donate,
-            nbytes=_payload_nbytes(array),
+            nbytes=nbytes,
             tiles=plan.n_tiles,
             tile_axis=plan.tile_axis,
             budget=plan.budget,
         ):
-            out = _redist.execute_plan(self, array, plan, donate=donate)
+            ml = _MEMLEDGER
+            src_cat = ml.category_of(array) if ml is not None else None
+            try:
+                out = _redist.execute_plan(self, array, plan, donate=donate)
+            except Exception as e:
+                if ml is not None:
+                    # the per-tile alloc_check inside execute_plan (or a
+                    # real RESOURCE_EXHAUSTED mid-plan) lands here: dump
+                    # the ledger with the failed tile's request size
+                    ml.note_oom(e, "comm.resplit_tiled", plan.max_tile_bytes)
+                raise
+            if ml is not None:
+                # the finished destination is no longer a transient: it IS
+                # the moved array, carrying its source's category
+                ml.reclassify(
+                    out, op="resplit",
+                    category=src_cat or "activation", site="resplit",
+                )
             if _RESPLIT_CHECK is not None:
                 _RESPLIT_CHECK(out, self, split, where="comm.resplit_tiled")
             return out
@@ -1033,4 +1086,9 @@ if _san is not None and getattr(_san, "checks_enabled", lambda: False)():
 _fr = _sys.modules.get("heat_tpu.utils.flightrec")
 if _fr is not None and getattr(_fr, "enabled", lambda: False)():
     _FLIGHTREC = _fr
-del _sys, _san, _fr
+# and for the memory ledger (HEAT_TPU_MEMLEDGER=1 arms at utils.memledger
+# import time, which may precede or follow this module)
+_ml = _sys.modules.get("heat_tpu.utils.memledger")
+if _ml is not None and getattr(_ml, "enabled", lambda: False)():
+    _MEMLEDGER = _ml
+del _sys, _san, _fr, _ml

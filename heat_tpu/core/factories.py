@@ -21,6 +21,12 @@ from .communication import Communication, sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 
+# device-memory-ledger hook (``utils.memledger.enable()`` pokes the module
+# in): ``_finalize``/``_filled`` are where every factory's buffer becomes
+# live, so they are registration choke points.  Disabled cost: one
+# module-global load (telemetry-hook pattern; module bottom re-arms).
+_MEMLEDGER = None
+
 __all__ = [
     "arange",
     "array",
@@ -62,11 +68,15 @@ def _finalize(
     # float64 METADATA on a float32 buffer (runtime sanitizer's first catch)
     dtype = types.canonical_heat_type(jarr.dtype)
     jarr = comm.shard(jarr, split)
+    ret = DNDarray(jarr, tuple(jarr.shape), dtype, split, device, comm, True)
+    if _MEMLEDGER is not None:
+        # ledger choke point: op=None -> the ledger's frame walk names the
+        # public factory up-stack (arange/linspace/eye/..., skipping
+        # comprehension frames — meshgrid/ix_ call from list comps)
+        _MEMLEDGER.register(ret._parray, op=None, site="factory")
     # factory boundary of the runtime sanitizer (HEAT_TPU_CHECKS=1):
     # no-op unless armed, metadata-only when armed
-    return sanitation.check(
-        DNDarray(jarr, tuple(jarr.shape), dtype, split, device, comm, True), "factory"
-    )
+    return sanitation.check(ret, "factory")
 
 
 def array(
@@ -134,7 +144,10 @@ def _filled(shape, value, dtype, split, device, comm, like=None) -> DNDarray:
             jarr = jnp.full(shape, value, dtype=jdt, out_sharding=sharding)
         except (TypeError, ValueError):
             jarr = comm_s.shard(jnp.full(shape, value, dtype=jdt), split_s)
-    return DNDarray(jarr, shape, dtype, split_s, devices.sanitize_device(device), comm_s, True)
+    ret = DNDarray(jarr, shape, dtype, split_s, devices.sanitize_device(device), comm_s, True)
+    if _MEMLEDGER is not None:
+        _MEMLEDGER.register(ret._parray, op=None, site="factory")
+    return ret
 
 
 def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -445,6 +458,16 @@ def kaiser(M: int, beta: float) -> DNDarray:
     jarr = jnp.kaiser(int(M), beta)
     return _finalize(jarr, None, None, None, types.canonical_heat_type(jarr.dtype))
 
+
+# the memory ledger may have been env-armed (HEAT_TPU_MEMLEDGER=1) while
+# this module was still importing — re-read the flag now (defensive
+# module-bottom re-arm, same pattern as _operations/communication)
+import sys as _sys  # noqa: E402
+
+_ml = _sys.modules.get("heat_tpu.utils.memledger")
+if _ml is not None and _ml.enabled():
+    _MEMLEDGER = _ml
+del _sys, _ml
 
 __all__ += [
     "bartlett",

@@ -26,6 +26,7 @@ from .dndarray import DNDarray
 # stdlib-only modules; safe to import from the innermost write paths
 from ..utils import faults as _faults
 from ..utils import flightrec as _flightrec
+from ..utils import memledger as _memledger
 from ..utils import telemetry as _telemetry
 
 __all__ = [
@@ -1009,7 +1010,11 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
     dev = devices.sanitize_device(device)
     if split is None:
         data = np.load(os.path.join(directory, "chunk_0.npy"))
-        return factories.array(data.reshape(gshape), split=None, device=device, comm=comm)
+        # the scoped override reaches factories._finalize's registration:
+        # a restored checkpoint is `param` on this path too, not an
+        # anonymous activation minted by `array`
+        with _memledger.category("param"):
+            return factories.array(data.reshape(gshape), split=None, device=device, comm=comm)
 
     ndim = len(gshape)
     n = gshape[split]
@@ -1051,6 +1056,10 @@ def load_array_checkpoint(directory: str, device=None, comm=None) -> DNDarray:
             block[sl] = data
         singles.append(jax.device_put(block, d))
     arr = jax.make_array_from_single_device_arrays(pshape, sharding, singles)
+    # ledger choke point: a restored checkpoint minting is ``param`` by
+    # definition (register() is a no-op when the ledger is disarmed)
+    _memledger.register(arr, op="load_array_checkpoint", site="ckpt",
+                        category="param")
     return DNDarray(arr, gshape, types.canonical_heat_type(np_dtype), split, dev, comm, True)
 
 
@@ -1209,5 +1218,9 @@ def load_checkpoint(tree_like, path: str):
                 f"checkpoint {p!r}: leaf {name} has dtype {np.dtype(arr.dtype)} "
                 f"but the target tree expects {np.dtype(want_dtype)}"
             )
-        leaves.append(jnp.asarray(arr))
+        leaf = jnp.asarray(arr)
+        # ledger choke point: restored pytree leaves are params (the
+        # category() context can override for opt-state restores)
+        _memledger.register(leaf, op="load_checkpoint", site="ckpt")
+        leaves.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, leaves)

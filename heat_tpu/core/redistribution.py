@@ -60,6 +60,15 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+# device-memory-ledger hook (``utils.memledger.enable()`` pokes the module
+# in): the streaming executor registers every staged tile (category
+# ``transient``), fires the ``mem.alloc`` fault site ahead of each tile's
+# allocation, consumes donated buffers at donation, and transfers the
+# aliased accumulator entry — so ``mem.live_bytes`` observes the
+# budget + one-tile transient contract FROM INSIDE.  Disabled cost: one
+# module-global load per plan.  Module bottom re-arms.
+_MEMLEDGER = None
+
 __all__ = [
     "ResplitPlan",
     "plan_resplit",
@@ -356,7 +365,12 @@ def execute_plan(comm, array, plan: ResplitPlan, donate: bool = False):
 
     from ..utils import profiler as _prof
 
+    ml = _MEMLEDGER
     out = _program("init", 0, _build_init)()
+    if ml is not None:
+        # the preallocated destination: a transient until the finished plan
+        # reclassifies it (comm.resplit_tiled)
+        ml.register(out, op="resplit.init", site="resplit.tile")
     accounted = 0  # telescoped: totals match the monolithic path to the byte
     moved = 0
     for i in range(plan.n_tiles):
@@ -369,12 +383,19 @@ def execute_plan(comm, array, plan: ResplitPlan, donate: bool = False):
             "resplit", wire, x=array,
             src_split=plan.src_split, dst_split=plan.dst_split,
         )
+        if ml is not None:
+            # the mem.alloc fault site, per tile: chaos CI injects the
+            # deterministic mid-resplit allocation failure HERE — the
+            # caller's catch dumps the ledger and re-raises
+            ml.alloc_check(tile_bytes, "comm.resplit.tile")
         # plan-shape counters advance PER TILE so a mid-plan failure (hung
         # tile tripping the deadline) leaves calls/bytes/tiles consistent in
         # the post-mortem report instead of tiles=0 masquerading as monolithic
         _tel.counter_inc("comm.resplit.tiles", 1)
         _prof.counter_max("comm.resplit.peak_tile_bytes", tile_bytes)
-        tile = _program("slice", length, lambda: _build_slice(length))(array, start)
+        staged = _program("slice", length, lambda: _build_slice(length))(array, start)
+        if ml is not None:
+            ml.register(staged, op="resplit.tile", site="resplit.tile")
         if donate and i == plan.n_tiles - 1:
             # every byte has been sliced out — free the source NOW, before
             # the last transfer, so peak memory never holds src + dst + tile
@@ -382,8 +403,25 @@ def execute_plan(comm, array, plan: ResplitPlan, donate: bool = False):
                 array.delete()
             except Exception:
                 pass
-        tile = _quiet(_program("move", length, _build_move), tile)
-        out = _quiet(_program("update", length, _build_update), out, tile, start)
+            if ml is not None:
+                ml.consume(array)
+        tile = _quiet(_program("move", length, _build_move), staged)
+        if ml is not None:
+            # consumed only AFTER the donating program ran (the monolithic
+            # path's rule): an OOM inside the move must still find the
+            # in-flight staged tile in the dump.  The ledger briefly holds
+            # both tile stages — still within budget + one tile whenever a
+            # tile fits the budget (the floor-at-one-slice case overcounts
+            # transiently; the RSS gate owns that bound physically).
+            ml.consume(staged)
+            ml.register(tile, op="resplit.tile", site="resplit.tile")
+        prev = out
+        out = _quiet(_program("update", length, _build_update), prev, tile, start)
+        if ml is not None:
+            ml.consume(tile)  # donated into (and consumed by) the update
+            # the accumulator was donated and aliases in place: move the
+            # entry to the new handle without double-counting the buffer
+            ml.transfer(prev, out, op="resplit.init")
         if _hlth.active_deadline() is not None:
             # deadline armed: await this tile under the watchdog so a hung
             # transfer raises CollectiveTimeoutError at the offending tile
@@ -395,3 +433,14 @@ def execute_plan(comm, array, plan: ResplitPlan, donate: bool = False):
                 "comm.resplit.tile",
             )
     return out
+
+
+# the memory ledger may have been env-armed (HEAT_TPU_MEMLEDGER=1) while
+# this module was still importing — re-read the flag now (defensive
+# module-bottom re-arm, the established hot-path-hook pattern)
+import sys as _sys  # noqa: E402
+
+_ml = _sys.modules.get("heat_tpu.utils.memledger")
+if _ml is not None and getattr(_ml, "enabled", lambda: False)():
+    _MEMLEDGER = _ml
+del _sys, _ml

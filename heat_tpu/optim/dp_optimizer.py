@@ -379,6 +379,27 @@ class DASO:
             lambda s: jnp.broadcast_to(s[None], (self.n_groups,) + s.shape) if hasattr(s, "ndim") else s,
             self.local_optimizer.optax_optimizer.init(jax.tree.map(lambda p: p[0], self._params)),
         )
+        # memory-ledger registration (HT111 registrar): params and the
+        # per-group optimizer moments are the long-lived buffers the
+        # ROADMAP's ZeRO-1 item promises to shrink — categorized here so
+        # mem.live_bytes.opt-state IS the before-number that PR must beat
+        from ..utils import memledger
+
+        if memledger.enabled():
+            jax.tree.map(
+                lambda p: memledger.register(
+                    p, op="daso.init", site="factory", category="param"
+                ),
+                self._params,
+            )
+            jax.tree.map(
+                lambda s: memledger.register(
+                    s, op="daso.init", site="factory", category="opt-state"
+                )
+                if hasattr(s, "ndim")
+                else None,
+                self._opt_state,
+            )
         self.module = module
         return self._params
 
@@ -792,6 +813,31 @@ class DASO:
 
         self._params = jax.tree.map(place, loaded["params"], self._params)
         self._opt_state = jax.tree.map(place, loaded["opt_state"], self._opt_state)
+        # re-register the REPLACEMENT buffers with the memory ledger, like
+        # init() does: the leaves io.load_checkpoint registered were the
+        # host-side intermediates place() discarded (their weakref deaths
+        # decrement), and without this a resumed job's mem.live_bytes.param/
+        # .opt-state would collapse to ~0 — losing the very before-numbers
+        # the ZeRO-1 ROADMAP item measures
+        from ..utils import memledger as _memledger
+
+        if _memledger.enabled():
+
+            def _reg(leaf, cat):
+                # register covers the freshly-placed buffers; reclassify
+                # corrects leaves place() passed through UNCHANGED — those
+                # are the very objects load_checkpoint already registered
+                # (site=ckpt defaults to `param`), and first-registration-
+                # wins would otherwise leave moments misfiled as params
+                _memledger.register(leaf, op="daso.resume", site="ckpt",
+                                    category=cat)
+                _memledger.reclassify(leaf, op="daso.resume", category=cat)
+
+            jax.tree.map(lambda p: _reg(p, "param"), self._params)
+            jax.tree.map(
+                lambda s: _reg(s, "opt-state") if hasattr(s, "ndim") else None,
+                self._opt_state,
+            )
         self._step_count = int(loaded["step"])
         if meta is not None and not used_fallback and int(meta.get("step", -1)) not in (
             -1, self._step_count

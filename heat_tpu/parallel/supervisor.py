@@ -275,6 +275,11 @@ class Supervisor:
         msg = f" (stuck at seq {mine['seq']} {mine.get('collective', '?')}"
         if peers:
             msg += f"; peers at seq {max(peers)}"
+        if isinstance(mine.get("mem_live"), int):
+            # memory rides the beacon too (the memory ledger's live bytes):
+            # "stuck at seq 4 resplit, 1.9 GB live" tells an OOM-adjacent
+            # wedge apart from a plain network stall at a glance
+            msg += f"; {mine['mem_live']} B live"
         return msg + ")"
 
     def _clear_heartbeats(self) -> None:

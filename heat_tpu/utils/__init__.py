@@ -3,6 +3,7 @@
 from . import data
 from . import faults
 from . import health
+from . import memledger
 from . import monitor
 from . import profiler
 from . import telemetry

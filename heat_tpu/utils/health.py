@@ -154,6 +154,16 @@ def write_heartbeat(
             last = None
         if last is not None:
             rec["seq"], rec["collective"] = int(last[0]), str(last[1])
+    # fold the memory ledger's live bytes in the same way: the supervisor's
+    # staleness lines then report memory alongside seq progress, and the
+    # /metrics heartbeat gauges get a per-rank memory view for free
+    ml = sys.modules.get("heat_tpu.utils.memledger")
+    if ml is not None:
+        try:
+            if ml.enabled():
+                rec["mem_live"] = int(ml.live_bytes())
+        except Exception:
+            pass
     if extra:
         rec.update(extra)
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"

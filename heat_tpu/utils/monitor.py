@@ -118,6 +118,7 @@ def _runtime_counters() -> Dict[str, float]:
         ("heat_tpu.utils.health", "counters"),
         ("heat_tpu.parallel.scheduler", "counters"),
         ("heat_tpu.utils.faults", "counters"),
+        ("heat_tpu.utils.memledger", "counters"),  # mem_live/peak gauges
         ("heat_tpu.utils.profiler", "counters"),  # last: the merged superset
     ):
         mod = sys.modules.get(modname)
@@ -196,7 +197,7 @@ def _heartbeat_view(
             with open(path) as fh:
                 payload = json.load(fh)
             if isinstance(payload, dict):
-                for k in ("step", "seq", "status", "restart_epoch"):
+                for k in ("step", "seq", "status", "restart_epoch", "mem_live"):
                     if payload.get(k) is not None:
                         row[k] = payload[k]
         except (OSError, ValueError):
@@ -248,6 +249,17 @@ def metrics_text(
             lines.append("# TYPE heartbeat_seq_lag gauge")
             for rank, seq in sorted(seqs.items()):
                 lines.append(f'heartbeat_seq_lag{{rank="{rank}"}} {top - seq}')
+        # per-rank device-memory live bytes, carried in the beacons by the
+        # memory ledger — the supervisor-side memory view of a whole world
+        mems = {
+            r["rank"]: r["mem_live"]
+            for r in rows
+            if isinstance(r.get("mem_live"), int)
+        }
+        if mems:
+            lines.append("# TYPE heartbeat_mem_live_bytes gauge")
+            for rank, v in sorted(mems.items()):
+                lines.append(f'heartbeat_mem_live_bytes{{rank="{rank}"}} {v}')
     lines.append("# TYPE restart_epoch gauge")
     try:
         epoch = int(os.environ.get("HEAT_TPU_RESTART_EPOCH", "0") or 0)

@@ -583,6 +583,22 @@ def worker(pid: int, port: int, tmpdir: str) -> None:
         assert last is not None, "flight recorder armed but no collective stamped"
         print(f"[{pid}] FLIGHTREC seq={last[0]} op={last[1]}", flush=True)
 
+    # ---- device-memory ledger (env-armed HEAT_TPU_MEMLEDGER=1) -------- #
+    # every buffer minted at the choke points above was registered with
+    # provenance; dump the final watermark + top buffers into the flight
+    # ring (the telemetry_report memory section reads them back) and print
+    # the greppable per-rank peak asserted by tests/test_multiprocess.py
+    from heat_tpu.utils import memledger
+
+    if memledger.enabled():
+        assert memledger.peak_bytes() > 0, "ledger armed but nothing registered"
+        memledger.dump_to_ring()
+        print(
+            f"[{pid}] MEM-PEAK rank={pid} bytes={memledger.peak_bytes()}",
+            flush=True,
+        )
+    hb.beat()
+
     print(f"[{pid}] {MARKER}", flush=True)
     faulthandler.cancel_dump_traceback_later()
     ht.core.bootstrap.finalize_distributed()
@@ -1026,6 +1042,10 @@ def main() -> int:
         # the explicit rank is the fallback when jax isn't live yet
         env["HEAT_TPU_FLIGHTREC_DIR"] = fr_dir
         env["HEAT_TPU_FLIGHTREC_RANK"] = str(rank)
+        # device-memory ledger (env-armed at heat_tpu import): live/peak
+        # bytes ride the heartbeat beacons and the flight-ring watermark
+        # records, and each worker prints its greppable MEM-PEAK line
+        env["HEAT_TPU_MEMLEDGER"] = "1"
         env["HEAT_TPU_RESTART_EPOCH"] = str(epoch)
         env["PYTHONUNBUFFERED"] = "1"
         # scrub accelerator plumbing HERE (popping inside the worker is too

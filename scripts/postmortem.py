@@ -230,12 +230,83 @@ def analyze(
     colls = {r: _coll_by_seq(ring) for r, ring in rings.items()}
     with_colls = [r for r in sorted(colls) if colls[r]]
     coll_less = [r for r in sorted(colls) if not colls[r]]
+    if with_colls:
+        last_seq = {r: max(colls[r]) for r in with_colls}
+        verdict["last_seq"] = {str(r): last_seq[r] for r in with_colls}
+
+    # ---- oom: an explicit allocation-failure dump (memory-ledger ``mem``
+    # record with oom=1, written by utils.memledger.dump_oom before the
+    # error re-raised) is a CAUSE, not a symptom — it outranks every
+    # stream heuristic below.  Earliest dump wins when several ranks blew
+    # up; the membuf records that follow it carry the dominant live
+    # buffers with their minting provenance. ----------------------------- #
+    oom_hits = []
+    for r in sorted(rings):
+        for rec in rings[r].get("records", []):
+            if rec.get("k") == "mem" and rec.get("oom"):
+                oom_hits.append((rec.get("t", 0), r, rec))
+    if oom_hits:
+        oom_hits.sort(key=lambda x: x[0])
+        _, r, rec = oom_hits[0]
+        # ONLY the membuf records of THIS dump: they follow their oom
+        # record contiguously (the ledger writes them in one burst), and a
+        # ring may hold several dumps (an earlier end-of-step attestation,
+        # a second OOM) whose rows must not interleave stale duplicates
+        top = []
+        seen_i = set()
+        collecting = False
+        for x in rings[r].get("records", []):
+            if x is rec:
+                collecting = True
+                continue
+            if not collecting:
+                continue
+            if x.get("k") == "membuf":
+                i = x.get("i")
+                if i in seen_i:
+                    break  # a LATER dump's burst restarted its index
+                seen_i.add(i)
+                top.append(x)
+            elif x.get("k") == "mem" and (x.get("oom") or x.get("att")):
+                # the next DUMP's header (a second OOM, or an attestation
+                # written by dump_to_ring — tagged att=1): its rows belong
+                # to it, not this failure — even when THIS dump wrote zero
+                # membuf rows (every live buffer under the dispatch
+                # threshold), the later burst must not be absorbed
+                break
+            # anything else — a racing coalesced "d" record, or a
+            # concurrent thread's peak-WATERMARK "mem" record landing
+            # mid-burst (the dump's rows are separate unlocked appends) —
+            # interleaves without ending the collection
+        top.sort(key=lambda x: (x.get("i", 1 << 30), -(x.get("nb") or 0)))
+        verdict["verdict"] = "oom"
+        verdict["oom"] = {
+            "rank": r,
+            "req_bytes": rec.get("req"),
+            "where": rec.get("where"),
+            "live_bytes": rec.get("live"),
+            "peak_bytes": rec.get("peak"),
+            "error": rec.get("err"),
+            "top_buffers": top[:8],
+        }
+        head = top[0] if top else None
+        verdict["detail"] = (
+            f"rank {r} failed a {rec.get('req', '?')}-byte device allocation "
+            f"at {rec.get('where', '?')} with {rec.get('live', '?')} bytes "
+            "live"
+            + (
+                f"; dominant live buffer: {head.get('op')} "
+                f"({head.get('nb')} B, {head.get('cat')})"
+                if head
+                else ""
+            )
+        )
+        return verdict
+
     if not with_colls:
         verdict["detail"] = "rings contain no collective records"
         return verdict
-    last_seq = {r: max(colls[r]) for r in with_colls}
     first_seq = {r: min(colls[r]) for r in with_colls}
-    verdict["last_seq"] = {str(r): last_seq[r] for r in with_colls}
 
     # ---- desync: first seq (inside the window every ring still holds)
     # where the rank fingerprints differ ------------------------------- #
@@ -374,7 +445,14 @@ def summary_line(verdict: dict, epoch: Optional[int] = None) -> str:
         parts.append(f"epoch={epoch}")
     parts.append(f"verdict={verdict.get('verdict')}")
     s = verdict.get("straggler")
-    if s:
+    o = verdict.get("oom")
+    if o:
+        parts.append(f"rank={o['rank']} req={o.get('req_bytes')} "
+                     f"where={o.get('where')}")
+        top = o.get("top_buffers") or []
+        if top:
+            parts.append(f"top={top[0].get('op')}:{top[0].get('nb')}")
+    elif s:
         parts.append(f"rank={s['rank']} seq={s['seq']} op={s['op']} lag={s['lag']}")
     elif verdict.get("first_divergent_seq") is not None:
         parts.append(f"seq={verdict['first_divergent_seq']}")
@@ -453,6 +531,20 @@ def render(verdict: dict, rings: Optional[Dict[int, dict]] = None) -> str:
         for r, hb in sorted(hbs.items(), key=by_rank):
             fields = " ".join(f"{k}={v}" for k, v in hb.items())
             out.append(f"heartbeat rank {r}: {fields}")
+    o = verdict.get("oom")
+    if o:
+        out.append(
+            f"rank {o['rank']} OOM at {o.get('where')}: requested "
+            f"{o.get('req_bytes')} B with {o.get('live_bytes')} B live "
+            f"(peak {o.get('peak_bytes')} B); dominant live buffers:"
+        )
+        for b in o.get("top_buffers") or []:
+            prov = f"op={b.get('op')} cat={b.get('cat')}"
+            if b.get("span"):
+                prov += f" span={b['span']}"
+            if b.get("tid"):
+                prov += f" trace={b['tid']}"
+            out.append(f"  {b.get('nb')} B  {prov}")
     s = verdict.get("straggler")
     if s and s.get("wait"):
         out.append(f"rank {s['rank']} blocking-wait evidence:")

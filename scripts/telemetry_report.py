@@ -325,6 +325,82 @@ def flightrec_section(dirs: List[str], context: int = 5) -> str:
     return "\n".join(out)
 
 
+def memory_section(dirs: List[str], timeline: int = 12) -> str:
+    """The device-memory section: per-rank watermark timeline + a
+    top-buffers table, both read from the flight-ring ``mem``/``membuf``
+    records the memory ledger writes (``heat_tpu/utils/memledger.py``);
+    '' when no target dir holds rings with memory records.  An ``oom=1``
+    record is called out explicitly with the failed request size — the
+    same evidence ``scripts/postmortem.py`` turns into its ``oom``
+    verdict."""
+    pm = _postmortem_mod()
+    if pm is None:
+        return ""
+    out: List[str] = []
+    for d in dirs:
+        rings = pm.load_rings(d)
+        if not rings:
+            continue
+        per_rank: Dict[int, List[dict]] = {}
+        bufs: List[dict] = []
+        for r, ring in sorted(rings.items()):
+            # a ring may hold several dumps (per-step attestations + an OOM
+            # dump); keep only each rank's LAST membuf burst — the freshest
+            # view — so stale rows from earlier dumps never interleave as
+            # "top live buffers" (the same per-dump scoping postmortem.py's
+            # collector applies)
+            burst: List[dict] = []
+            last_burst: List[dict] = []
+            for rec in ring.get("records", []):
+                if rec.get("k") == "mem":
+                    per_rank.setdefault(r, []).append(rec)
+                    if burst:
+                        last_burst = burst
+                    burst = []
+                elif rec.get("k") == "membuf":
+                    burst.append(dict(rec, rank=r))
+            bufs.extend(burst or last_burst)
+        if not per_rank and not bufs:
+            continue
+        out.append(f"\n-- device memory (ledger watermarks) from {d} --")
+        for r, recs in sorted(per_rank.items()):
+            peak = max((rec.get("peak") or 0) for rec in recs)
+            out.append(f"MEM-PEAK rank={r} bytes={peak}")
+            t0 = recs[0].get("t", 0.0)
+            for rec in recs[-timeline:]:
+                by = rec.get("by") or {}
+                cats = " ".join(f"{c}={v}" for c, v in sorted(by.items()))
+                flag = (
+                    f"  OOM req={rec.get('req')} where={rec.get('where')}"
+                    if rec.get("oom")
+                    else ""
+                )
+                out.append(
+                    f"  rank {r} t+{rec.get('t', 0.0) - t0:7.3f}s  "
+                    f"live={rec.get('live', 0):>12}  "
+                    f"peak={rec.get('peak', 0):>12}  {cats}{flag}"
+                )
+        if bufs:
+            bufs.sort(key=lambda b: -(b.get("nb") or 0))
+            rows = [
+                [
+                    str(b.get("rank")),
+                    str(b.get("nb")),
+                    str(b.get("op")),
+                    str(b.get("cat")),
+                    str(b.get("span") or "-"),
+                    str(b.get("tid") or "-"),
+                ]
+                for b in bufs[:10]
+            ]
+            out.append("top live buffers (from ledger dumps):")
+            out.append(
+                _fmt_table(rows, ["rank", "bytes", "op", "category", "span",
+                                  "trace"])
+            )
+    return "\n".join(out)
+
+
 _stepprof = None
 
 
@@ -642,6 +718,7 @@ def main(argv=None) -> int:
     section = flightrec_section(
         [t for t in args.targets if os.path.isdir(t)], context=args.context
     )
+    mem = memory_section([t for t in args.targets if os.path.isdir(t)])
     merged = merge_files(paths) if paths else None
     # reuse the merge's already-parsed spans instead of re-reading every
     # rank file just to pick out the sched.job records
@@ -655,11 +732,13 @@ def main(argv=None) -> int:
         # contain rings but no telemetry jsonl, and a SIGKILLed serving
         # rank leaves a journal and nothing else — the timeline / SLO
         # table is exactly what a post-mortem reader comes for
-        if section or slo:
+        if section or slo or mem:
             print(f"no rank*.jsonl telemetry files under {args.targets}; "
                   "rendering the journal/ring artifacts only")
             if section:
                 print(section)
+            if mem:
+                print(mem)
             if slo:
                 print(slo)
             return 0
@@ -672,6 +751,8 @@ def main(argv=None) -> int:
     print(render(merged, top=args.top, timeline=args.timeline))
     if section:
         print(section)
+    if mem:
+        print(mem)
     if slo:
         print(slo)
     overlap = overlap_section(merged["timeline"])

@@ -168,6 +168,80 @@ class TestKillMidSave:
         np.testing.assert_array_equal(back.numpy(), d3)
 
 
+# the OOM victim: parks a dominant live buffer, then starts a budgeted
+# resplit whose first tile's env-armed mem.alloc fault fires mid-plan —
+# the memory ledger dumps the account into the crash-durable flight ring
+# before the error re-raises, and the victim then SIGKILLs ITSELF, so the
+# only surviving evidence is the harvested ring (announcing DUMPED first
+# lets the parent assert ordering)
+OOM_VICTIM = """
+import os, signal, sys
+import heat_tpu as ht
+from heat_tpu.utils import memledger
+
+park = ht.zeros((128, 128), dtype=ht.float32, split=0)  # the dominant buffer
+p = ht.communication.get_comm().size
+src = ht.zeros((p, 16, p), dtype=ht.float32, split=0)
+print("ARMED", flush=True)
+try:
+    src.resplit_(2, memory_budget=2 * p * p * 4)
+    print("NO-OOM", flush=True)  # must never be reached
+except Exception as e:
+    assert memledger.is_oom(e), e
+    print("DUMPED", flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)  # die like a real OOM-killed rank
+"""
+
+
+class TestInjectedOOM:
+    def test_injected_oom_mid_resplit_yields_oom_verdict_after_sigkill(
+        self, tmp_path
+    ):
+        """Acceptance (ISSUE 14): the ``mem.alloc`` fault armed mid-resplit
+        kills a rank AFTER the memory ledger dumped its account into the
+        crash-durable ring; harvesting the ring post-SIGKILL must yield
+        ``POSTMORTEM verdict=oom`` naming the rank, the failed request
+        bytes and the top live buffer with its minting provenance intact."""
+        import importlib.util
+
+        script = tmp_path / "oom_victim.py"
+        script.write_text(OOM_VICTIM)
+        ring_dir = tmp_path / "flightrec"
+        env = _env("mem.alloc:fail=1")
+        env["HEAT_TPU_FLIGHTREC_DIR"] = str(ring_dir)
+        env["HEAT_TPU_MEMLEDGER"] = "1"
+        victim = subprocess.run(
+            [sys.executable, str(script)],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+        )
+        assert "DUMPED" in victim.stdout, victim.stdout + victim.stderr
+        assert "NO-OOM" not in victim.stdout
+        assert victim.returncode == -signal.SIGKILL, victim.returncode
+
+        spec = importlib.util.spec_from_file_location(
+            "pm_chaos_oom", os.path.join(REPO, "scripts", "postmortem.py")
+        )
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+        rings = pm.load_rings(str(ring_dir))
+        assert rings, "SIGKILL destroyed the ring — durability contract broken"
+        verdict = pm.analyze(rings, expected_ranks=[0])
+        assert verdict["verdict"] == "oom", verdict
+        oom = verdict["oom"]
+        assert oom["rank"] == 0
+        assert oom["req_bytes"] > 0  # the failed tile allocation
+        assert oom["where"] == "comm.resplit_tiled"
+        # the dominant live buffer: the parked 64 KiB factory output, with
+        # minting provenance (op + category) intact across the SIGKILL
+        top = oom["top_buffers"][0]
+        assert top["op"] == "zeros"
+        assert top["nb"] == 128 * 128 * 4
+        assert top["cat"] == "activation"
+        line = pm.summary_line(verdict)
+        assert "POSTMORTEM verdict=oom rank=0" in line
+        assert "top=zeros" in line
+
+
 class TestCollectiveDeadline:
     def test_injected_hang_trips_deadline_within_budget(self, ht):
         """Acceptance (ISSUE 5): an injected collective hang raises

@@ -31,6 +31,7 @@ from heat_tpu.analysis.rules import (
     RawEntropyRule,
     SeqStampBypassRule,
     TraceIdentityRule,
+    UnledgeredDeviceBufferRule,
     UseAfterDonateRule,
 )
 
@@ -635,6 +636,93 @@ class TestHT108:
 
 
 # ---------------------------------------------------------------------- #
+# HT111 — device buffers minted around the memory-ledger choke points
+# ---------------------------------------------------------------------- #
+class TestHT111:
+    def test_raw_make_array_from_callback_flagged(self):
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            def f(host, sh):
+                return jax.make_array_from_callback(host.shape, sh, lambda i: host[i])
+        """)
+        assert [f.detail for f in fs] == ["make_array_from_callback"]
+        assert fs[0].rule == "HT111"
+
+    def test_sharded_device_put_flagged(self):
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def f(mesh, p):
+                return jax.device_put(p, NamedSharding(mesh, P("dcn")))
+        """)
+        assert [f.detail for f in fs] == ["device_put"]
+
+    def test_comm_sharding_target_flagged(self):
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            def f(comm, host):
+                return jax.device_put(host, comm.sharding(2, 0))
+        """)
+        assert [f.detail for f in fs] == ["device_put"]
+
+    def test_device_kwarg_spelling_flagged(self):
+        # device_put(x, device=NamedSharding(...)) mints the same buffer
+        # as the positional form — the kwarg spelling must not slip through
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def f(mesh, p):
+                return jax.device_put(p, device=NamedSharding(mesh, P("dcn")))
+        """)
+        assert [f.detail for f in fs] == ["device_put"]
+
+    def test_plain_device_placement_not_flagged(self):
+        # device_put onto a DEVICE (the hosted-complex transport commit)
+        # is placement, not a mesh buffer the ledger needs to see
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            def f(arr, dev):
+                return jax.device_put(arr, dev)
+        """)
+        assert fs == []
+
+    def test_registrar_function_exempt(self):
+        # a function that registers its result with the ledger IS a
+        # registration choke point (the DASO.init shape)
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from heat_tpu.utils import memledger
+            def f(mesh, p):
+                placed = jax.device_put(p, NamedSharding(mesh, P("dcn")))
+                memledger.register(placed, op="init", category="param")
+                return placed
+        """)
+        assert fs == []
+
+    def test_registration_layer_sanctioned(self):
+        src = """
+            import jax
+            def _finalize(host, sh):
+                return jax.make_array_from_callback(host.shape, sh, lambda i: host[i])
+        """
+        for path in (
+            "heat_tpu/core/factories.py",
+            "heat_tpu/core/communication.py",
+            "heat_tpu/core/io.py",
+        ):
+            assert run_rule(UnledgeredDeviceBufferRule(), src, path=path) == []
+
+    def test_suppression_works(self):
+        fs = run_rule(UnledgeredDeviceBufferRule(), """
+            import jax
+            def f(host, sh):
+                return jax.make_array_from_callback(host.shape, sh, lambda i: host[i])  # heatlint: disable=HT111 ingest shim
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------- #
 # HT109 — trace identity owned by the tracing choke points
 # ---------------------------------------------------------------------- #
 class TestHT109:
@@ -758,8 +846,8 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
-            "HT108", "HT109", "HT110", "HT201", "HT202", "HT203", "HT204",
-            "HT301", "HT302", "HT303", "HT304",
+            "HT108", "HT109", "HT110", "HT111", "HT201", "HT202", "HT203",
+            "HT204", "HT301", "HT302", "HT303", "HT304",
         ]
 
     def test_select_unknown_rule_raises(self):

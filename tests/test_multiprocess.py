@@ -68,6 +68,12 @@ def test_n_process_spmd_tier(n_proc, devs):
         # flight-recorder ring (ISSUE 7): lockstep SPMD means every rank
         # reports the IDENTICAL final sequence number
         assert re.search(rf"\[{pid}\] FLIGHTREC seq=\d+ op=", out), out[-2000:]
+        # ...and the device-memory ledger (ISSUE 14, env-armed via
+        # HEAT_TPU_MEMLEDGER=1) tracked every choke-point buffer: each rank
+        # prints its greppable high-water line with a nonzero peak
+        mm = re.search(rf"\[{pid}\] MEM-PEAK rank={pid} bytes=(\d+)", out)
+        assert mm, out[-2000:]
+        assert int(mm.group(1)) > 0
     seqs = set(re.findall(r"\] FLIGHTREC seq=(\d+) op=", out))
     assert len(seqs) == 1, f"ranks disagree on the collective seq: {seqs}"
     # ...and rank 0 armed the live /metrics + /healthz endpoint and scraped
