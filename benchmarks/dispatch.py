@@ -196,6 +196,112 @@ print(json.dumps({{
                 "peak_tile_bytes": 0, "resplit_bytes": 0}
 
 
+def _overlap_capture(steps: int, warmup: int, budget: str) -> dict:
+    """Rotated-pairwise DASO sync comparison, measured in a fresh process:
+    two overlapped-sync DASO arms share one process — ``monolithic`` pins
+    the single-bucket plan (budget 0), ``bucketed`` splits the sync under
+    ``budget`` — and their steps are interleaved in alternating AB/BA order
+    so scheduler drift cancels.  Per step: wall time (with the mpdryrun
+    lockstep ``comm.Wait(loss)`` fence) and the guarded blocking-wait
+    seconds (``comm.allreduce.wait`` + ``comm.Wait.wait`` histograms, which
+    is what ``scripts/stepprof.py`` attributes too); overlap fraction =
+    1 − wait/step.  Also captured: the per-arm ``comm.allreduce.bytes``
+    deltas (the byte-invariance contract) and the steady-state program-
+    cache stats after warmup (the zero-recompile contract)."""
+    code = f"""
+import json, os, statistics, sys, time
+os.environ.pop("HEAT_TPU_GRAD_BUCKET_BYTES", None)  # arms pin their own plans
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.utils import profiler, telemetry
+
+steps, warmup, budget = {int(steps)}, {int(warmup)}, {budget!r}
+telemetry.enable()  # arms the wait observer guard_blocking feeds
+
+def build(bucket_budget):
+    model = ht.nn.Sequential(
+        ht.nn.Flatten(), ht.nn.Linear(128, 512), ht.nn.ReLU(),
+        ht.nn.Linear(512, 128),
+    )
+    daso = ht.optim.DASO(
+        ht.optim.DataParallelOptimizer("sgd", lr=0.05),
+        total_local_comm_size=2,
+        warmup_steps=0, global_skip=1, stale_steps=0,
+        overlap_sync=True, grad_bucket_bytes=bucket_budget,
+    )
+    daso.init(model, key=jax.random.key(3))
+    return daso
+
+def mse(pred, y):
+    return jax.numpy.mean((pred - y) ** 2)
+
+def wait_s():
+    return (telemetry.histogram("comm.allreduce.wait").total
+            + telemetry.histogram("comm.Wait.wait").total)
+
+comm = ht.communication.get_comm()
+rng = np.random.default_rng(11)
+
+def step(daso):
+    x = jax.numpy.asarray(rng.normal(size=(32, 128)).astype(np.float32))
+    y = jax.numpy.asarray(rng.normal(size=(32, 128)).astype(np.float32))
+    w0, t0 = wait_s(), time.perf_counter()
+    loss = daso.step(mse, x, y)
+    comm.Wait(loss)  # lockstep fence: the wait lands in comm.Wait.wait
+    return time.perf_counter() - t0, wait_s() - w0
+
+# budget 0 parses to None -> the forced single-bucket (monolithic) plan
+arms = [("monolithic", build(0)), ("bucketed", build(budget))]
+for _, d in arms:
+    for _ in range(warmup):
+        step(d)
+profiler.reset_cache_stats()
+rows = {{name: [] for name, _ in arms}}
+bytes_delta = {{name: 0 for name, _ in arms}}
+for i in range(steps):
+    for name, d in (arms if i % 2 == 0 else arms[::-1]):
+        c0 = profiler.counters().get("comm.allreduce.bytes", 0)
+        rows[name].append(step(d))
+        bytes_delta[name] += (
+            profiler.counters().get("comm.allreduce.bytes", 0) - c0
+        )
+stats = profiler.cache_stats()
+
+def med_overlap(rs):
+    return statistics.median(1.0 - min(w, dt) / dt for dt, w in rs)
+
+print(json.dumps({{
+    "overlap": {{k: round(med_overlap(v), 4) for k, v in rows.items()}},
+    "step_ms": {{k: round(statistics.median(dt for dt, _ in v) * 1e3, 3)
+                for k, v in rows.items()}},
+    "wait_ms": {{k: round(statistics.median(w for _, w in v) * 1e3, 3)
+                for k, v in rows.items()}},
+    "allreduce_bytes": bytes_delta,
+    "n_buckets": {{name: d._overlap_state()[1].n_buckets for name, d in arms}},
+    "steady_cache_misses": stats["misses"],
+    "steady_cache_hits": stats["hits"],
+}}))
+"""
+    r = None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=600,
+        )
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        print(f"overlap capture failed: {exc!r}", file=sys.stderr)
+        if r is not None:
+            print(f"  returncode={r.returncode}", file=sys.stderr)
+            if r.stderr:
+                print(r.stderr[-2000:], file=sys.stderr)
+        return {}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="write payload JSON here")
@@ -250,6 +356,26 @@ def main(argv=None) -> int:
                          "are not byte-exact; 48 MB keeps the gate below the "
                          "64 MB whole-array-staging regression it exists to "
                          "catch)")
+    ap.add_argument("--overlap-gate", action="store_true",
+                    help="run the ISSUE 16 overlapped-sync gate: exit 9 "
+                         "unless the bucketed lookahead-1 DASO sync beats "
+                         "the single-bucket (monolithic) sync on median "
+                         "compute/comm overlap fraction in a rotated "
+                         "pairwise short training loop, with byte-identical "
+                         "comm.allreduce.bytes and zero steady-state "
+                         "recompiles")
+    ap.add_argument("--overlap-out", default=None, metavar="PATH",
+                    help="write the overlap-gate payload here "
+                         "(committed capture: BENCH_OVERLAP.json)")
+    ap.add_argument("--overlap-steps", type=int, default=24,
+                    help="measured rotated step pairs for the overlap gate")
+    ap.add_argument("--overlap-warmup", type=int, default=6,
+                    help="per-arm warmup steps (compiles the bucket "
+                         "programs) before the overlap gate measures")
+    ap.add_argument("--overlap-budget", default="256K",
+                    help="grad-bucket budget of the bucketed arm (K/M/G "
+                         "suffixes; the monolithic arm always pins the "
+                         "single-bucket plan)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
@@ -643,6 +769,83 @@ def main(argv=None) -> int:
         if not args.resplit_gate:
             resplit_gate_ok = True  # capture-only run: report, don't gate
 
+    # --- overlapped-sync gate (ISSUE 16) ------------------------------- #
+    # the perf contract of the bucketed lookahead-1 sync, measured: same
+    # bytes on the wire, zero steady-state recompiles, and MORE of the
+    # step hidden behind compute than the single-bucket sync manages.
+    overlap_gate_ok = True
+    overlap_payload = None
+    if args.overlap_gate or args.overlap_out:
+        cap = _overlap_capture(
+            args.overlap_steps, args.overlap_warmup, args.overlap_budget
+        )
+        if not cap:
+            overlap_gate_ok = False
+            print("OVERLAP GATE: capture subprocess failed", file=sys.stderr)
+        else:
+            ov = cap["overlap"]
+            ab = cap["allreduce_bytes"]
+            overlap_payload = {
+                "metric": "daso_sync_overlap_gain",
+                "value": round(ov["bucketed"] - ov["monolithic"], 4),
+                "unit": "overlap fraction gained (bucketed - monolithic, "
+                        "median over rotated pairs; 1 - wait/step)",
+                "vs_baseline": None,
+                "extra": {
+                    "platform": platform,
+                    "n_devices": n_dev,
+                    "overlap_monolithic": ov["monolithic"],
+                    "overlap_bucketed": ov["bucketed"],
+                    "step_ms_snapshot": cap["step_ms"],
+                    "wait_ms_snapshot": cap["wait_ms"],
+                    "allreduce_bytes": ab,
+                    "n_buckets": cap["n_buckets"],
+                    "bucket_budget": args.overlap_budget,
+                    "measured_steps_per_arm": args.overlap_steps,
+                    "steady_cache_misses": cap["steady_cache_misses"],
+                    "steady_cache_hits": cap["steady_cache_hits"],
+                    "provenance": "benchmarks/dispatch.py --overlap-gate, "
+                                  "fresh subprocess, rotated AB/BA step "
+                                  "pairs on the host mesh",
+                },
+            }
+            print(json.dumps(overlap_payload, indent=1))
+            if cap["n_buckets"].get("bucketed", 0) < 2:
+                overlap_gate_ok = False
+                print(
+                    f"OVERLAP GATE: expected a multi-bucket plan, got "
+                    f"{cap['n_buckets']} (budget {args.overlap_budget})",
+                    file=sys.stderr,
+                )
+            if ab.get("monolithic") != ab.get("bucketed") or not ab.get("bucketed"):
+                overlap_gate_ok = False
+                print(
+                    f"OVERLAP GATE: comm.allreduce.bytes must be byte-"
+                    f"identical across arms, got {ab} (the telescoped "
+                    f"stage accounting broke)",
+                    file=sys.stderr,
+                )
+            if cap["steady_cache_misses"] != 0:
+                overlap_gate_ok = False
+                print(
+                    f"OVERLAP GATE: {cap['steady_cache_misses']} steady-state "
+                    f"recompiles after warmup (contract: 0)",
+                    file=sys.stderr,
+                )
+            if not (ov["bucketed"] > ov["monolithic"]):
+                overlap_gate_ok = False
+                print(
+                    f"OVERLAP GATE: bucketed sync hides no more comm than "
+                    f"monolithic (overlap {ov['bucketed']:.3f} vs "
+                    f"{ov['monolithic']:.3f})",
+                    file=sys.stderr,
+                )
+            if args.overlap_out:
+                with open(args.overlap_out, "w") as fh:
+                    json.dump(overlap_payload, fh, indent=1)
+        if not args.overlap_gate:
+            overlap_gate_ok = True  # capture-only run: report, don't gate
+
     # Row-name scheme (scripts/bench_compare.py infers direction by name):
     # the TRACKED contract rows are the host-portable ratios (*_speedup,
     # higher-better); absolute µs figures carry a *_snapshot suffix — no
@@ -792,6 +995,8 @@ def main(argv=None) -> int:
         return 7
     if not memledger_gate_ok:
         return 8
+    if not overlap_gate_ok:
+        return 9
     return 0
 
 

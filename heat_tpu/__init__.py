@@ -12,6 +12,7 @@ from . import core
 from .core import axisspec
 from .core import random
 from .core.redistribution import set_redistribution_budget, get_redistribution_budget
+from .core.collectives import set_grad_bucket_budget, get_grad_bucket_budget
 from . import linalg
 from .linalg import matmul, dot, transpose, norm  # hoist reference's flat exports
 from .linalg.basics import outer, trace, tril, triu, vdot, cross, projection, vector_norm, matrix_norm, einsum, einsum_path, kron, inner, tensordot, vecdot

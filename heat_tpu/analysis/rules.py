@@ -444,16 +444,75 @@ class CollectiveAccountingRule(Rule):
     description = "public collective without comm.<name> byte accounting"
 
     TARGET_SUFFIX = ("communication.py",)
+    # the hierarchical/bucketed staging layer: module-level public staging
+    # functions (``hierarchical_*``/``bucketed_*``/``dispatch_*``) must
+    # account the same way — directly, through the telescoped stage
+    # accountant ``_account_stages`` (which loops ``comm._account_bytes``
+    # per stage), or by delegating to another staging function that does
+    STAGING_SUFFIX = ("core/collectives.py",)
+    STAGING_PREFIXES = ("hierarchical_", "bucketed_", "dispatch_")
     # public-but-not-traffic: Wait is a completion fence, Barrier moves one
     # scalar token (accounting it would pollute the traffic metric)
     EXEMPT = {"Wait", "Barrier"}
-    # direct accounting calls at a collective's staging entry
-    ACCOUNT_CALLS = {"self._account", "self._account_bytes"}
+    # direct accounting calls at a collective's staging entry; the
+    # comm.-qualified forms are the module-level staging layer's spelling
+    # of the same choke-point delegation (comm IS a Communication)
+    ACCOUNT_CALLS = {
+        "self._account",
+        "self._account_bytes",
+        "comm._account",
+        "comm._account_bytes",
+        "_account_stages",
+    }
     # the tiled executor: accounts each tile exactly once via _account_bytes
     # (core/redistribution.py), so delegating to it IS accounting
     TILED_EXECUTORS = {"execute_plan"}
 
+    def _accounts(self, fn: ast.FunctionDef) -> bool:
+        """Direct accounting: an ACCOUNT_CALLS call anywhere in ``fn``."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and call_name(node) in self.ACCOUNT_CALLS:
+                return True
+        return False
+
+    def _staging_findings(self, ctx: LintContext) -> Iterable[Finding]:
+        """Module-level staging functions of the hierarchical/bucketed
+        layer: account directly or delegate to a sibling staging function
+        (the lookahead pipelines delegate to their ``dispatch_*`` half)."""
+        out = []
+        for fn in ctx.tree.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            if not fn.name.startswith(self.STAGING_PREFIXES):
+                continue
+            accounted = self._accounts(fn)
+            if not accounted:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        la = last_attr(node)
+                        if (
+                            la
+                            and la != fn.name
+                            and la.startswith(self.STAGING_PREFIXES)
+                        ):
+                            accounted = True  # delegates to an accounted stager
+                            break
+            if not accounted:
+                f = ctx.finding(
+                    self, fn,
+                    f"staging function `{fn.name}` never routes through "
+                    "_account_stages / comm._account_bytes nor delegates to a "
+                    "staging function that does — its collective traffic is "
+                    "invisible to comm.<name>.calls/.bytes and the flight ring",
+                    detail=fn.name,
+                )
+                if f is not None:
+                    out.append(f)
+        return out
+
     def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if module_matches(ctx.path, self.STAGING_SUFFIX):
+            return self._staging_findings(ctx)
         if not module_matches(ctx.path, self.TARGET_SUFFIX):
             return []
         out = []
@@ -462,17 +521,20 @@ class CollectiveAccountingRule(Rule):
                 if not isinstance(fn, ast.FunctionDef):
                     continue
                 is_mpi_name = fn.name[:1].isupper()
-                if not (is_mpi_name or fn.name.startswith("resplit")):
+                if not (
+                    is_mpi_name
+                    or fn.name.startswith("resplit")
+                    or fn.name.startswith("hierarchical")
+                ):
                     continue
                 if fn.name in self.EXEMPT:
                     continue
-                accounted = False
-                for node in ast.walk(fn):
-                    if isinstance(node, ast.Call):
+                accounted = self._accounts(fn)
+                if not accounted:
+                    for node in ast.walk(fn):
+                        if not isinstance(node, ast.Call):
+                            continue
                         dn = call_name(node)
-                        if dn in self.ACCOUNT_CALLS:
-                            accounted = True
-                            break
                         la = last_attr(node)
                         if la in self.TILED_EXECUTORS and fn.name.startswith("resplit"):
                             # scoped to the resplit* entries: a future public
@@ -730,10 +792,13 @@ class SeqStampBypassRule(Rule):
 
     # the accounting layer itself: _account_bytes lives in communication.py;
     # execute_plan (redistribution.py) byte-accounts + stamps every tile
-    # through it at the executor's own staging point
+    # through it at the executor's own staging point; the hierarchical/
+    # bucketed staging layer (collectives.py) routes every stage through
+    # _account_stages → comm._account_bytes (HT104 enforces that)
     SANCTIONED_MODULES = (
         "core/communication.py",
         "core/redistribution.py",
+        "core/collectives.py",
     )
     SHARDING_MARKERS = {"sharding", "NamedSharding", "PositionalSharding"}
 

@@ -817,6 +817,46 @@ class Communication:
             return lax.pmax(x.astype(jnp.int32), self.__axis).astype(jnp.bool_)
         return ops[op](x, self.__axis)
 
+    def hierarchical_allreduce(self, x, op: str = "sum", domains: Optional[int] = None):
+        """Two-level allreduce over this communicator's axis (valid only
+        inside ``shard_map``, like ``Allreduce``): reduce-scatter within
+        each of ``domains`` contiguous process subgroups (the fast tier),
+        cross-domain exchange of the 1/i shard (the slow tier — the only
+        traffic that crosses domains), allgather back (arXiv 2004.09362).
+
+        ``domains=None`` derives the slow-domain count from the process
+        topology (one domain per host process); when the world has one
+        domain — or the hierarchy does not divide the axis — this falls
+        back to the flat allreduce.  ``op`` is ``"sum"`` or ``"mean"``.
+
+        Accounting: every stage routes through ``_account_bytes`` under
+        ``comm.allreduce`` — per-stage seq stamps in the flight ring, the
+        ``comm.collective`` fault site, deadline enforcement — with the
+        stage factors telescoping exactly to the flat ring total:
+        (i−1)/i + 2(d−1)/(d·i) + (i−1)/i = 2(p−1)/p, so
+        ``comm.allreduce.bytes`` for the K staged records reconciles
+        against the monolithic accounting to the byte."""
+        if op not in ("sum", "mean"):
+            raise ValueError(f"hierarchical_allreduce supports sum/mean, got {op!r}")
+        from . import collectives as _coll
+
+        p = self.size
+        d = _coll._derive_domains(self, domains)
+        factors = _coll._hier_stage_factors(p, d)
+        if factors is None:
+            # single domain: the hierarchy is the flat ring
+            self._account_bytes(
+                "allreduce",
+                int(round(_payload_nbytes(x) * 2.0 * (p - 1) / p)),
+                x=x,
+            )
+            out = lax.psum(x, self.__axis)
+            return out / p if op == "mean" else out
+        nbytes = _payload_nbytes(x)
+        tele = _coll._Telescope()
+        _coll._account_stages(self, tele, nbytes, factors, x=x)
+        return _coll._hierarchical_body(x, self.__axis, p, d, mean=(op == "mean"))
+
     def Allgather(self, x, axis: int = 0, tiled: bool = True):
         self._account("Allgather", x, self.size - 1)
         return lax.all_gather(x, self.__axis, axis=axis, tiled=tiled)

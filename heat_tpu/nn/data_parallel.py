@@ -32,12 +32,14 @@ def _as_jax(x):
     return x._jarray if isinstance(x, DNDarray) else x
 
 
-def _instrumented_step(jitted):
+def _instrumented_step(jitted, sync=None):
     """Wrap a jitted train step with the telemetry tail: an ``nn.train_step``
     span plus the ``nn.train_step_dispatch_s`` latency histogram when
     telemetry is enabled (dispatch-side wall time — the step stays async,
     no host sync is added).  Disabled cost: one flag check.  The jitted
-    function's introspection surface (``.lower``) is preserved."""
+    function's introspection surface (``.lower``) is preserved.  ``sync``
+    (a 0-arg callable or a string) labels the span's ``sync=`` attribute so
+    stepprof can split monolithic vs bucketed runs."""
     import functools
     import time
 
@@ -48,12 +50,14 @@ def _instrumented_step(jitted):
         if not _tel._ENABLED:
             return jitted(*args)
         t0 = time.perf_counter()
-        with _tel.span("nn.train_step"):
+        attrs = {} if sync is None else {"sync": sync() if callable(sync) else sync}
+        with _tel.span("nn.train_step", **attrs):
             out = jitted(*args)
         _tel.observe("nn.train_step_dispatch_s", time.perf_counter() - t0)
         return out
 
-    step.lower = jitted.lower
+    if hasattr(jitted, "lower"):
+        step.lower = jitted.lower
     return step
 
 
@@ -138,7 +142,8 @@ class DataParallel:
 
     # -- fused train step ----------------------------------------------- #
     def make_train_step(self, loss_fn: Callable, with_rng: bool = False,
-                        donate: bool = True):
+                        donate: bool = True, overlap_sync=None,
+                        grad_bucket_bytes=None, sync_domains=None):
         """Build a jitted (params, opt_state, x, y[, key]) →
         (params, opt_state, loss) step.  The batch arrives sharded; the mean
         loss over the GLOBAL batch makes XLA emit the gradient psum (the
@@ -161,10 +166,30 @@ class DataParallel:
         anything still pointing at the pre-step tree (e.g. this wrapper's
         ``.parameters`` from ``init()``) is consumed; reassign
         ``dp.parameters = params`` before calling ``forward`` again.
+
+        ``overlap_sync`` (default: the optimizer's ``overlap_sync`` flag)
+        opts into the bucketed hierarchical gradient sync
+        (``core.collectives``): per-shard gradients are computed explicitly,
+        mean-allreduced in byte-budgeted buckets (``grad_bucket_bytes`` /
+        ``ht.set_grad_bucket_budget`` / ``HEAT_TPU_GRAD_BUCKET_BYTES``) with
+        bucket k+1's collective in flight while bucket k is consumed, then
+        applied by a donated update program.  ``sync_domains`` overrides the
+        topology-derived slow-domain count.  The default (``False``) keeps
+        today's single-program path bit-exact; the overlapped step has no
+        ``.lower`` (it is three programs, not one).
         """
         if self.optimizer is None:
             raise RuntimeError("make_train_step requires an attached optimizer")
         import functools
+
+        if overlap_sync is None:
+            overlap_sync = getattr(self.optimizer, "overlap_sync", False)
+        if grad_bucket_bytes is None:
+            grad_bucket_bytes = getattr(self.optimizer, "grad_bucket_bytes", None)
+        if overlap_sync:
+            return self._make_overlapped_step(
+                loss_fn, with_rng, donate, grad_bucket_bytes, sync_domains
+            )
 
         _jit = functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         apply = self.module.apply
@@ -207,6 +232,104 @@ class DataParallel:
                 return new_params, new_state, lval
 
         step = _instrumented_step(step)
+        self._train_step = step
+        return step
+
+    def _make_overlapped_step(self, loss_fn, with_rng, donate,
+                              grad_bucket_bytes, sync_domains):
+        """The opt-in bucketed path: (1) a shard_map program computes each
+        shard's loss and gradient explicitly (stacked over the batch axis),
+        (2) ``core.collectives.bucketed_grad_allreduce`` mean-reduces the
+        stack in byte-budgeted buckets — two-level hierarchical stages,
+        bucket k+1 in flight while bucket k is awaited, every stage
+        accounted through ``Communication._account_bytes`` — and (3) a
+        donated update program applies the replicated mean.  Math matches
+        the fused path (global-mean loss gradient) up to float reordering."""
+        import functools
+
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..core import collectives as _coll
+        from ..core.communication import _jax_shard_map
+
+        apply = self.module.apply
+        opt = self.optimizer
+        comm = self.comm
+        ax, p, mesh = comm.axis, comm.size, comm.mesh
+
+        from .modules import _module_accepts_train
+
+        accepts_train = _module_accepts_train(self.module)
+
+        def _forward(q, jx, key):
+            if accepts_train:
+                return apply(q, jx, train=True, key=key)
+            return apply(q, jx)
+
+        def _body(params, jx, jy, key):
+            if key is not None:
+                # one independent stream per shard — the fused path's
+                # sharded-mask semantics, expressed explicitly
+                key = jax.random.fold_in(key, lax.axis_index(ax))
+
+            def loss(q):
+                return loss_fn(_forward(q, jx, key), jy)
+
+            lval, grads = jax.value_and_grad(loss)(params)
+            # stack under P(ax): shard k contributes block k of the leading
+            # axis — the global mean of these IS the fused path's gradient
+            return lval[None], jax.tree.map(lambda g: g[None], grads)
+
+        in_specs = (P(), P(ax), P(ax)) + ((P(),) if with_rng else ())
+        fn = (
+            (lambda q, jx, jy, key: _body(q, jx, jy, key))
+            if with_rng
+            else (lambda q, jx, jy: _body(q, jx, jy, None))
+        )
+        # params NOT donated here — the update program reads them again
+        grad_prog = jax.jit(
+            _jax_shard_map(
+                fn, mesh=mesh, in_specs=in_specs,
+                out_specs=(P(ax), P(ax)), check_vma=False,
+            )
+        )
+        update_prog = jax.jit(
+            opt._update, donate_argnums=(0, 2) if donate else ()
+        )
+        state = {}  # bucket plan, computed once from the first params tree
+
+        def _plan_for(params):
+            if "plan" not in state:
+                # grads stack one block per shard: plan over the STACKED
+                # payload (p × param bytes), the transient the ledger sees
+                state["plan"] = _coll.plan_grad_buckets(
+                    [p * a.nbytes for a in jax.tree_util.tree_leaves(params)],
+                    grad_bucket_bytes,
+                )
+            return state["plan"]
+
+        def raw_step(params, opt_state, jx, jy, key=None):
+            if jx.shape[0] % p:
+                raise ValueError(
+                    f"global batch {jx.shape[0]} must be divisible by the "
+                    f"data-parallel world size {p} (overlap_sync shards the "
+                    "batch explicitly)"
+                )
+            args = (params, jx, jy) + ((key,) if with_rng else ())
+            losses, grads = grad_prog(*args)
+            mean_grads = _coll.bucketed_grad_allreduce(
+                comm, grads, plan=_plan_for(params), domains=sync_domains
+            )
+            new_params, new_state = update_prog(params, mean_grads, opt_state)
+            return new_params, new_state, jnp.mean(losses)
+
+        step = _instrumented_step(
+            raw_step,
+            sync=lambda: (
+                "bucketed" if state and state["plan"].n_buckets > 1 else "monolithic"
+            ),
+        )
         self._train_step = step
         return step
 

@@ -197,7 +197,15 @@ class DataParallelOptimizer:
     the model; see :func:`nonfinite_guard`.  Counters: :meth:`guard_stats`.
     """
 
-    def __init__(self, optimizer, blocking: bool = False, guard_nonfinite: bool = True, **kwargs):
+    def __init__(
+        self,
+        optimizer,
+        blocking: bool = False,
+        guard_nonfinite: bool = True,
+        overlap_sync: bool = False,
+        grad_bucket_bytes=None,
+        **kwargs,
+    ):
         if isinstance(optimizer, str):
             optimizer = _named_optimizer(optimizer, **kwargs)
         # buffers (BatchNorm running stats) get neither updates nor decay
@@ -205,6 +213,11 @@ class DataParallelOptimizer:
         self.guarded = bool(guard_nonfinite)
         self.optax_optimizer = nonfinite_guard(base) if self.guarded else base
         self.blocking = blocking
+        # opt-in bucketed hierarchical gradient sync (core.collectives):
+        # picked up by DataParallel.make_train_step / allreduce_grads; the
+        # default train step is bit-exact unchanged when False
+        self.overlap_sync = bool(overlap_sync)
+        self.grad_bucket_bytes = grad_bucket_bytes
         self._dp = None
         self._opt_state = None
         from ..utils import profiler as _profiler
@@ -263,6 +276,21 @@ class DataParallelOptimizer:
         _tel.observe("optim.step_dispatch_s", time.perf_counter() - t0)
         return new_params
 
+    def allreduce_grads(self, comm, stacked_grads, domains=None):
+        """Bucketed hierarchical mean-allreduce of per-shard gradients
+        stacked on a leading axis sharded over ``comm``'s mesh axis
+        (``core.collectives.bucketed_grad_allreduce``): byte-budgeted
+        buckets (``grad_bucket_bytes`` / ``ht.set_grad_bucket_budget`` /
+        ``HEAT_TPU_GRAD_BUCKET_BYTES``), bucket k+1's transfer in flight
+        while bucket k is consumed, two-level reduce-scatter → cross-domain
+        exchange → allgather when the topology has more than one domain
+        (flat allreduce otherwise).  Returns the replicated mean tree."""
+        from ..core import collectives as _coll
+
+        return _coll.bucketed_grad_allreduce(
+            comm, stacked_grads, budget=self.grad_bucket_bytes, domains=domains
+        )
+
     def zero_grad(self) -> None:
         """No-op: JAX gradients are functional (kept for API parity)."""
 
@@ -301,6 +329,8 @@ class DASO:
         mesh=None,
         checkpoint_every: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
+        overlap_sync: bool = False,
+        grad_bucket_bytes=None,
     ):
         if isinstance(local_optimizer, DataParallelOptimizer):
             self.local_optimizer = local_optimizer
@@ -340,6 +370,12 @@ class DASO:
         self._pending = None  # (dispatched global average, due_step)
         self._train_step = None
         self._sync_step = None
+        # opt-in bucketed hierarchical dcn-tier sync (core.collectives):
+        # the default schedule below is bit-exact unchanged when False
+        self.overlap_sync = bool(overlap_sync)
+        self.grad_bucket_bytes = grad_bucket_bytes
+        self._sync_comm = None  # lazy Communication(mesh, 'dcn') + bucket plan
+        self._bucket_plan = None
         # opt-in durable auto-checkpoint: every K steps the full training
         # state (per-group params + opt state + step count) is written
         # atomically; resume() restores it after a preemption/crash
@@ -354,6 +390,32 @@ class DASO:
         self.profiler_key = _profiler.register_counter_provider(
             "daso", self._counter_snapshot
         )
+
+    def _overlap_state(self):
+        """Lazy (Communication('dcn'), GradBucketPlan) for the opt-in
+        overlapped sync — the comm instance carries the per-bucket program
+        cache and the accounting/flight-ring/deadline choke point; the plan
+        is computed ONCE (leaf sizes are static for a model's lifetime), so
+        steady state re-plans and recompiles nothing."""
+        if self._sync_comm is None:
+            from ..core import collectives as _coll
+            from ..core.communication import Communication
+
+            self._sync_comm = Communication(self.mesh, "dcn")
+            leaves = jax.tree_util.tree_leaves(self._params)
+            self._bucket_plan = _coll.plan_grad_buckets(
+                [a.nbytes for a in leaves], self.grad_bucket_bytes
+            )
+        return self._sync_comm, self._bucket_plan
+
+    def _sync_label(self) -> str:
+        """``sync=`` attribute of the ``daso.step`` span: 'bucketed' when
+        the opt-in overlapped path splits the sync, 'monolithic' otherwise
+        (stepprof groups on it and prints STEP-OVERLAP-DELTA when a merge
+        dir holds both)."""
+        if not self.overlap_sync or getattr(self, "_params", None) is None:
+            return "monolithic"
+        return "bucketed" if self._overlap_state()[1].n_buckets > 1 else "monolithic"
 
     @staticmethod
     def _default_ici(n: int) -> int:
@@ -527,7 +589,9 @@ class DASO:
         if not _tel._ENABLED:
             return self._step_impl(loss_fn, x, y, key)
         t0 = time.perf_counter()
-        with _tel.span("daso.step", step=self._step_count + 1):
+        with _tel.span(
+            "daso.step", step=self._step_count + 1, sync=self._sync_label()
+        ):
             out = self._step_impl(loss_fn, x, y, key)
         _tel.observe("daso.step_dispatch_s", time.perf_counter() - t0)
         return out
@@ -556,24 +620,55 @@ class DASO:
         self._step_count += 1
         t = self._step_count
 
+        if self.overlap_sync:
+            from ..core import collectives as _coll
+
         if t <= self.warmup_steps:
-            avg = self._global_average(self._params)
-            self._params = self._blend(self._params, avg, 1.0)  # full sync
+            if self.overlap_sync:
+                comm, plan = self._overlap_state()
+                self._params = _coll.bucketed_param_sync(
+                    comm, self._params, 1.0, plan=plan
+                )
+            else:
+                avg = self._global_average(self._params)
+                self._params = self._blend(self._params, avg, 1.0)  # full sync
         else:
             if self._pending is not None and t >= self._pending[1]:
                 avg, _ = self._pending
-                self._params = self._blend(self._params, avg, self.staleness_weight)
+                if self.overlap_sync:
+                    self._params = _coll.consume_bucket_averages_all(
+                        self._sync_comm, self._params, avg, self.staleness_weight
+                    )
+                else:
+                    self._params = self._blend(self._params, avg, self.staleness_weight)
                 self._pending = None
             # dispatch a new global average only when none is in flight —
             # otherwise stale_steps > global_skip would overwrite the pending
             # average forever and the dcn tier would never sync
             if t % self.global_skip == 0 and self._pending is None:
-                # dispatched now (async under JAX), consumed stale_steps later
-                avg = self._global_average(self._params)
-                if self.stale_steps == 0:
-                    self._params = self._blend(self._params, avg, self.staleness_weight)
+                if self.overlap_sync:
+                    comm, plan = self._overlap_state()
+                    if self.stale_steps == 0:
+                        self._params = _coll.bucketed_param_sync(
+                            comm, self._params, self.staleness_weight, plan=plan
+                        )
+                    else:
+                        # pending payload = every bucket's average in flight at
+                        # once (the stale window IS the overlap); consumed
+                        # stale_steps later by consume_bucket_averages_all
+                        self._pending = (
+                            _coll.dispatch_all_bucket_averages(
+                                comm, self._params, plan=plan
+                            ),
+                            t + self.stale_steps,
+                        )
                 else:
-                    self._pending = (avg, t + self.stale_steps)
+                    # dispatched now (async under JAX), consumed stale_steps later
+                    avg = self._global_average(self._params)
+                    if self.stale_steps == 0:
+                        self._params = self._blend(self._params, avg, self.staleness_weight)
+                    else:
+                        self._pending = (avg, t + self.stale_steps)
         if self.checkpoint_every and t % self.checkpoint_every == 0:
             self.checkpoint()
         # fault site ``proc.exit`` (elastic-runtime chaos lane): arming

@@ -788,6 +788,7 @@ _CONSUMER_MODULES = (
     "heat_tpu.core.dndarray",
     "heat_tpu.core.communication",
     "heat_tpu.core.redistribution",
+    "heat_tpu.core.collectives",
     "heat_tpu.core.random",
 )
 

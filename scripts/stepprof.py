@@ -172,6 +172,9 @@ def step_breakdown(
                 rows.append({
                     "rank": rank,
                     "step": name,
+                    # the span's sync= attribute ('monolithic'/'bucketed'),
+                    # when the emitter labeled it — feeds STEP-OVERLAP-DELTA
+                    "sync": (st.get("attrs") or {}).get("sync"),
                     "n": i,
                     "ts": round(t0, 6),
                     "total_s": round(total, 6),
@@ -221,6 +224,36 @@ def aggregate(rows: List[dict]) -> List[dict]:
     return out
 
 
+def overlap_delta(rows: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Per step-kind overlap fractions split by the steps' ``sync=`` span
+    attribute — {kind: {'monolithic': f, 'bucketed': f}} for every kind
+    whose merged input holds BOTH labels (a monolithic and a bucketed run
+    flushed into the same directory).  The before/after comparison of the
+    hierarchical-collectives work, computed from one merge dir so the two
+    runs share clocks and methodology."""
+    by: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for r in rows:
+        lbl = r.get("sync")
+        if lbl not in ("monolithic", "bucketed"):
+            continue
+        a = by.setdefault(r["step"], {}).setdefault(
+            lbl, {"total": 0.0, "wait": 0.0}
+        )
+        a["total"] += r["total_s"]
+        a["wait"] += r["comm_wait_s"]
+    out: Dict[str, Dict[str, float]] = {}
+    for kind in sorted(by):
+        labels = by[kind]
+        if {"monolithic", "bucketed"} <= set(labels):
+            out[kind] = {
+                lbl: round(
+                    1.0 - (v["wait"] / v["total"] if v["total"] else 0.0), 4
+                )
+                for lbl, v in labels.items()
+            }
+    return out
+
+
 def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
     widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
@@ -254,6 +287,15 @@ def render(rows: List[dict], per_step: int = 0) -> str:
             f"overlap={a['overlap_fraction']:.3f} "
             f"comm_wait_ms={a['comm_wait_s'] * 1e3:.1f} "
             f"total_ms={a['total_s'] * 1e3:.1f}"
+        )
+    # monolithic-vs-bucketed delta, when both labeled runs share this merge
+    # dir (the CI-greppable improvement line; the STEP-OVERLAP format above
+    # is asserted elsewhere and stays untouched)
+    for kind, f in overlap_delta(rows).items():
+        out.append(
+            f"STEP-OVERLAP-DELTA kind={kind} "
+            f"monolithic={f['monolithic']:.3f} bucketed={f['bucketed']:.3f} "
+            f"delta={f['bucketed'] - f['monolithic']:+.3f}"
         )
     if per_step > 0:
         out.append("")

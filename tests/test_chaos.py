@@ -262,6 +262,102 @@ class TestCollectiveDeadline:
         assert profiler.counters()["health.deadline.trips"] == base + 1
 
 
+class TestOverlappedSyncHang:
+    def test_hang_on_one_bucket_names_straggler_at_that_bucket(self, ht, tmp_path):
+        """Acceptance (ISSUE 16): a ``comm.collective`` hang on ONE bucket in
+        the middle of an overlapped bucketed param sync raises
+        ``CollectiveTimeoutError`` at the offending bucket — not at the end of
+        the step — and the flight-recorder post-mortem names this rank a
+        straggler stuck at exactly that bucket's seq with op ``allreduce``.
+
+        The seq stamp lands BEFORE the fault site fires (the
+        ``_account_bytes`` contract), so the hung bucket is the rank's last
+        ring record and the analyzer can convict it precisely."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from heat_tpu.core import collectives as coll
+        from heat_tpu.core.communication import Communication
+        from heat_tpu.utils import faults, flightrec, health, telemetry
+
+        devs = jax.devices()
+        if len(devs) != 8:
+            pytest.skip("needs the 8-device test mesh")
+        mesh = Mesh(np.asarray(devs).reshape(4, 2), ("dcn", "ici"))
+        comm = Communication(mesh, "dcn")
+        sh = NamedSharding(mesh, P("dcn"))
+        params = {
+            f"w{j}": jax.device_put(jnp.ones((4, 64, 3 + j), jnp.float32), sh)
+            for j in range(4)
+        }
+        leaves = jax.tree_util.tree_leaves(params)
+        plan = coll.plan_grad_buckets([a.nbytes for a in leaves], 6144)
+        assert plan.n_buckets == 4  # 6144-byte budget: one bucket per leaf
+
+        d = str(tmp_path)
+        try:
+            flightrec.enable(d, rank=0)
+            # round 1: a clean overlapped sync — compiles the bucket
+            # programs and stamps every staged collective into the ring
+            params = coll.bucketed_param_sync(comm, params, 0.5, plan=plan)
+            # round 2: one-shot hang — lands on the FIRST staged stage of
+            # the next sync's first bucket; the armed deadline converts the
+            # hang into a timeout at that bucket instead of blocking
+            t0 = time.monotonic()
+            with faults.inject("comm.collective", hang=1):
+                with comm.deadline(1.0):
+                    with pytest.raises(health.CollectiveTimeoutError):
+                        coll.bucketed_param_sync(comm, params, 0.5, plan=plan)
+            took = time.monotonic() - t0
+            assert took < 10.0, f"hang took {took:.1f}s — deadline not arming"
+        finally:
+            flightrec.disable()
+            telemetry._uninstall_signal_flush()
+
+        ring = flightrec.read_ring(os.path.join(d, "flight_rank0.ring"))
+        colls0 = [r for r in ring["records"] if r["k"] == "coll"]
+        stuck = colls0[-1]
+        assert stuck["op"] == "allreduce"
+        # clean sync: the DASO bucket-average program accounts two stages
+        # (cross-domain exchange + allgather) per bucket; the hang hit the
+        # first stage of round 2's first bucket
+        assert stuck["seq"] == 2 * plan.n_buckets + 1
+
+        # synthetic rank-1 peer: identical op stream on the common window
+        # (fingerprints must agree, else the verdict would be desync), but
+        # it progressed `lag` collectives further — rank 0 is the straggler
+        lag = 3
+        fp_fields = ("op", "gshape", "dtype", "src", "dst", "wire")
+        r1 = flightrec.FlightRecorder(
+            os.path.join(d, "flight_rank1.ring"), rank=1
+        )
+        seq = 0
+        for rec in colls0:
+            seq = rec["seq"]
+            r1.record(
+                "coll", seq=seq, **{f: rec[f] for f in fp_fields if f in rec}
+            )
+        tail = {f: stuck[f] for f in fp_fields if f in stuck}
+        for _ in range(lag):
+            seq += 1
+            r1.record("coll", seq=seq, **tail)
+        r1.close()
+
+        spec = importlib.util.spec_from_file_location(
+            "pm_overlap_chaos", os.path.join(REPO, "scripts", "postmortem.py")
+        )
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+        v = pm.analyze_dir(d)
+        assert v["verdict"] == "straggler"
+        s = v["straggler"]
+        assert s["rank"] == 0 and s["op"] == "allreduce"
+        assert s["seq"] == stuck["seq"]
+        assert s["lag"] == lag and s["peers_at"] == stuck["seq"] + lag
+        assert f"rank 0 stuck at seq {stuck['seq']}" in v["detail"]
+
+
 class TestKillAndResume:
     def test_sigkill_rank_mid_daso_training_supervisor_resumes(self):
         """Acceptance (ISSUE 5): ``kill -9`` of one rank mid-DASO-training →

@@ -1,0 +1,414 @@
+"""Bucketed hierarchical allreduce + backward-overlapped gradient sync
+(ISSUE 16): the planner, the telescoped stage accounting, the two-level
+``hierarchical_allreduce``, and the DASO / DataParallel opt-in engines.
+
+The invariants under test are the acceptance criteria:
+
+- bucketing splits WORK, never MATH — K-bucket results match the
+  monolithic path to float tolerance, and ``comm.allreduce.bytes`` is
+  byte-IDENTICAL between the K=1 and K=N arms (cumulative-rounding
+  telescoping across stages and buckets);
+- steady state recompiles nothing (per-bucket programs live in the
+  sharding-keyed program cache);
+- the default paths are untouched (opt-in only).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import collectives as coll
+from heat_tpu.utils import profiler
+
+
+def _allreduce_bytes() -> int:
+    return profiler.counters().get("comm.allreduce.bytes", 0)
+
+
+def _bucket_count() -> int:
+    return profiler.counters().get("comm.allreduce.buckets", 0)
+
+
+# ---------------------------------------------------------------------- #
+# planner
+# ---------------------------------------------------------------------- #
+class TestPlanner:
+    def test_no_leaves(self):
+        plan = coll.plan_grad_buckets([], budget=1024)
+        assert plan.n_buckets == 0 and plan.reason == "no-leaves"
+
+    def test_no_budget_single_bucket(self):
+        plan = coll.plan_grad_buckets([100, 200, 300], budget=0)
+        assert plan.reason == "no-budget"
+        assert plan.buckets == ((0, 1, 2),)
+        assert plan.total_bytes == 600
+
+    def test_fits_in_budget(self):
+        plan = coll.plan_grad_buckets([100, 200], budget=1024)
+        assert plan.reason == "fits-in-budget" and plan.n_buckets == 1
+
+    def test_greedy_in_order_packing(self):
+        plan = coll.plan_grad_buckets([100, 100, 100, 100], budget=250)
+        assert plan.reason == "bucketed"
+        assert plan.buckets == ((0, 1), (2, 3))
+        assert plan.bucket_nbytes(0) == 200
+        assert plan.max_bucket_bytes == 200
+
+    def test_oversized_leaf_gets_own_bucket(self):
+        plan = coll.plan_grad_buckets([50, 1000, 50], budget=100)
+        assert plan.buckets == ((0,), (1,), (2,))
+        assert plan.max_bucket_bytes == 1000
+
+    def test_contiguity_preserved(self):
+        # buckets partition the leaf indices in tree order — the program
+        # signature stability the cache hit-rate depends on
+        plan = coll.plan_grad_buckets([30, 90, 10, 60, 60], budget=100)
+        flat = [j for b in plan.buckets for j in b]
+        assert flat == list(range(5))
+
+    def test_suffix_parsing_via_default(self):
+        prev = coll.set_grad_bucket_budget("2K")
+        try:
+            assert coll.get_grad_bucket_budget() == 2048
+            plan = coll.plan_grad_buckets([1500, 1500])
+            assert plan.n_buckets == 2
+        finally:
+            coll.set_grad_bucket_budget(prev)
+
+    def test_explicit_budget_overrides_default(self):
+        prev = coll.set_grad_bucket_budget(64)
+        try:
+            plan = coll.plan_grad_buckets([100, 100], budget=1024)
+            assert plan.n_buckets == 1
+        finally:
+            coll.set_grad_bucket_budget(prev)
+
+
+# ---------------------------------------------------------------------- #
+# stage math + telescoped accounting
+# ---------------------------------------------------------------------- #
+class TestStageMath:
+    @pytest.mark.parametrize("p,d", [(8, 2), (8, 4), (16, 4), (12, 3)])
+    def test_factors_telescope_to_flat_ring(self, p, d):
+        factors = coll._hier_stage_factors(p, d)
+        assert factors is not None
+        assert sum(factors) == pytest.approx(2.0 * (p - 1) / p, abs=1e-12)
+
+    def test_degenerate_hierarchies(self):
+        assert coll._hier_stage_factors(8, 1) is None  # one domain
+        assert coll._hier_stage_factors(8, 8) is None  # one member each
+        assert coll._hier_stage_factors(8, 3) is None  # does not divide
+
+    def test_daso_factors_match_two_wire_stages(self):
+        d, i = 4, 2
+        ex, ag = coll._daso_stage_factors(d, i)
+        assert ex == pytest.approx(2.0 * (d - 1) / (d * i))
+        assert ag == pytest.approx((i - 1) / i)
+
+    def test_telescope_sum_is_split_invariant(self):
+        total = 12345.678
+        for k in (1, 3, 7):
+            tele = coll._Telescope()
+            moved = sum(tele.wire(total / k) for _ in range(k))
+            assert moved == int(round(total))
+
+    def test_derive_domains(self):
+        comm = ht.communication.get_comm()
+        # single-process world: topology derives one domain (flat path)
+        assert coll._derive_domains(comm) == 1
+        if comm.size == 8:
+            assert coll._derive_domains(comm, 4) == 4
+            assert coll._derive_domains(comm, 8) == 1  # i == 1: degenerate
+            assert coll._derive_domains(comm, 3) == 1  # does not divide
+
+
+# ---------------------------------------------------------------------- #
+# hierarchical_allreduce (two-level subgroup decomposition)
+# ---------------------------------------------------------------------- #
+class TestHierarchicalAllreduce:
+    def _comm(self):
+        comm = ht.communication.get_comm()
+        if comm.size != 8:
+            pytest.skip("needs the 8-device test mesh")
+        return comm
+
+    @pytest.mark.parametrize("domains", [2, 4])
+    @pytest.mark.parametrize("op", ["sum", "mean"])
+    def test_matches_flat_allreduce(self, domains, op):
+        comm = self._comm()
+        p = comm.size
+        mapped = comm.shard_map(
+            lambda x: comm.hierarchical_allreduce(x, op, domains=domains),
+            in_splits=((1, 0),),
+            out_splits=(1, 0),
+        )
+        vals = np.arange(p * 3, dtype=np.float32).reshape(p, 3)
+        out = np.asarray(mapped(jnp.asarray(vals.reshape(-1))))
+        want = vals.sum(axis=0)
+        if op == "mean":
+            want = want / p
+        np.testing.assert_allclose(out.reshape(p, 3), np.tile(want, (p, 1)), rtol=1e-6)
+
+    def test_padding_path(self):
+        # payload not divisible by i = p/d: the body pads and crops
+        comm = self._comm()
+        p = comm.size
+        mapped = comm.shard_map(
+            lambda x: comm.hierarchical_allreduce(x, "sum", domains=4),
+            in_splits=((1, 0),),
+            out_splits=(1, 0),
+        )
+        vals = np.arange(p * 5, dtype=np.float32).reshape(p, 5)  # 5 % 2 != 0
+        out = np.asarray(mapped(jnp.asarray(vals.reshape(-1)))).reshape(p, 5)
+        np.testing.assert_allclose(out, np.tile(vals.sum(axis=0), (p, 1)), rtol=1e-6)
+
+    def test_single_domain_falls_back_flat(self):
+        comm = self._comm()
+        p = comm.size
+        mapped = comm.shard_map(
+            lambda x: comm.hierarchical_allreduce(x, "sum"),  # domains derived: 1
+            in_splits=((1, 0),),
+            out_splits=(1, 0),
+        )
+        vals = np.arange(float(p), dtype=np.float32)
+        out = np.asarray(mapped(jnp.asarray(vals)))
+        np.testing.assert_allclose(out, np.full(p, vals.sum()), rtol=1e-6)
+
+    def test_bad_op_rejected(self):
+        comm = self._comm()
+        with pytest.raises(ValueError):
+            comm.hierarchical_allreduce(jnp.zeros(8), "max")
+
+    def test_stage_bytes_reconcile_against_flat(self):
+        # the telescoping identity, observed end to end: the K staged
+        # comm.allreduce.bytes records of the hierarchical path sum to the
+        # flat fallback's single record exactly
+        comm = self._comm()
+        x = jnp.zeros(1000, jnp.float32)  # odd payload: rounding matters
+
+        def _trace_bytes(domains):
+            b0 = _allreduce_bytes()
+            comm.shard_map(
+                lambda v: comm.hierarchical_allreduce(v, "sum", domains=domains),
+                in_splits=((1, 0),),
+                out_splits=(1, 0),
+            )(x)
+            return _allreduce_bytes() - b0
+
+        flat = _trace_bytes(1)
+        hier = _trace_bytes(4)
+        assert flat > 0
+        assert hier == flat
+
+
+# ---------------------------------------------------------------------- #
+# DASO opt-in engine
+# ---------------------------------------------------------------------- #
+def _make_daso(overlap, budget, **kw):
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device test mesh")
+    model = ht.nn.Sequential(
+        ht.nn.Flatten(), ht.nn.Linear(24, 16), ht.nn.ReLU(), ht.nn.Linear(16, 4)
+    )
+    daso = ht.optim.DASO(
+        ht.optim.DataParallelOptimizer("sgd", lr=0.05),
+        total_local_comm_size=2,
+        warmup_steps=kw.pop("warmup_steps", 2),
+        global_skip=kw.pop("global_skip", 2),
+        stale_steps=kw.pop("stale_steps", 1),
+        overlap_sync=overlap,
+        grad_bucket_bytes=budget,
+        **kw,
+    )
+    daso.init(model, key=jax.random.key(3))
+    return daso
+
+
+def _mse(pred, y):
+    return jnp.mean((pred - y) ** 2)
+
+
+def _drive(daso, steps=7):
+    rng = np.random.default_rng(7)
+    for _ in range(steps):
+        x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+        y = jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+        loss = daso.step(_mse, x, y)
+    jax.block_until_ready(loss)
+    return jax.tree.map(np.asarray, daso.parameters)
+
+
+class TestDASOOverlap:
+    def test_bucketed_matches_monolithic(self):
+        p_mono = _drive(_make_daso(False, None))
+        p_buck = _drive(_make_daso(True, "2K"))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_mono), jax.tree_util.tree_leaves(p_buck)
+        ):
+            np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+
+    def test_single_bucket_overlap_matches_monolithic(self):
+        p_mono = _drive(_make_daso(False, None))
+        p_one = _drive(_make_daso(True, None))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_mono), jax.tree_util.tree_leaves(p_one)
+        ):
+            np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+
+    def test_immediate_blend_path(self):
+        # stale_steps=0: dispatch and consume in the same step
+        p_mono = _drive(_make_daso(False, None, stale_steps=0))
+        p_buck = _drive(_make_daso(True, "2K", stale_steps=0))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_mono), jax.tree_util.tree_leaves(p_buck)
+        ):
+            np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+
+    def test_bytes_k_invariant(self):
+        # comm.allreduce.bytes is byte-IDENTICAL between the K=1 and K=N
+        # arms — the acceptance criterion, via cumulative-rounding
+        # telescoping across stages and buckets
+        deltas = {}
+        for label, budget in (("k1", None), ("kN", "2K")):
+            daso = _make_daso(True, budget)
+            b0 = _allreduce_bytes()
+            _drive(daso, steps=5)
+            deltas[label] = _allreduce_bytes() - b0
+        assert deltas["k1"] > 0
+        assert deltas["k1"] == deltas["kN"]
+
+    def test_zero_steady_state_recompiles(self):
+        daso = _make_daso(True, "2K")
+        _drive(daso, steps=4)  # warmup + first syncs build the programs
+        profiler.reset_cache_stats()
+        _drive(daso, steps=4)
+        stats = profiler.cache_stats()
+        assert stats["misses"] == 0
+        assert stats["hits"] > 0
+
+    def test_bucket_counters_advance(self):
+        daso = _make_daso(True, "2K")
+        assert daso._overlap_state()[1].n_buckets > 1
+        c0 = _bucket_count()
+        _drive(daso, steps=3)
+        assert _bucket_count() > c0
+
+    def test_sync_label(self):
+        assert _make_daso(True, "2K")._sync_label() == "bucketed"
+        assert _make_daso(True, None)._sync_label() == "monolithic"
+        assert _make_daso(False, None)._sync_label() == "monolithic"
+
+    def test_cooldown_drops_pending_bucketed_average(self):
+        # epoch_loss_logic's cooldown clears an in-flight bucketed pending
+        # payload without consuming it (same contract as the default path)
+        daso = _make_daso(True, "2K", warmup_steps=0, global_skip=1,
+                          stale_steps=4, cooldown_epochs=1, total_epochs=2)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+        y = jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+        daso.step(_mse, x, y)
+        assert daso._pending is not None
+        daso.epoch_loss_logic(1.0)  # ends epoch 1 of 2 → cooldown
+        assert daso.in_cooldown and daso._pending is None
+        daso.step(_mse, x, y)  # and the fully-synchronous step still runs
+
+
+# ---------------------------------------------------------------------- #
+# DataParallel opt-in engine
+# ---------------------------------------------------------------------- #
+class TestDataParallelOverlap:
+    def _run(self, steps=5, **kw):
+        if len(jax.devices()) != 8:
+            pytest.skip("needs the 8-device test mesh")
+        model = ht.nn.Sequential(
+            ht.nn.Flatten(), ht.nn.Linear(24, 16), ht.nn.ReLU(), ht.nn.Linear(16, 4)
+        )
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.05)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        params = dp.init(jax.random.key(5))
+        state = opt.init_state(params)
+        step = dp.make_train_step(_mse, **kw)
+        rng = np.random.default_rng(11)
+        for _ in range(steps):
+            x = jnp.asarray(rng.normal(size=(32, 24)).astype(np.float32))
+            y = jnp.asarray(rng.normal(size=(32, 4)).astype(np.float32))
+            params, state, loss = step(params, state, x, y)
+        jax.block_until_ready(loss)
+        return jax.tree.map(np.asarray, params), float(loss)
+
+    def test_overlapped_matches_fused(self):
+        p0, l0 = self._run()
+        p1, l1 = self._run(overlap_sync=True, grad_bucket_bytes="8K", sync_domains=4)
+        for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
+            np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+        assert l1 == pytest.approx(l0, abs=1e-4)
+
+    def test_overlapped_flat_domains_matches_fused(self):
+        p0, l0 = self._run()
+        p1, l1 = self._run(overlap_sync=True)  # topology-derived: flat
+        for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
+            np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+        assert l1 == pytest.approx(l0, abs=1e-4)
+
+    def test_optimizer_flag_is_the_default(self):
+        if len(jax.devices()) != 8:
+            pytest.skip("needs the 8-device test mesh")
+        model = ht.nn.Sequential(ht.nn.Linear(8, 4))
+        opt = ht.optim.DataParallelOptimizer(
+            "sgd", lr=0.05, overlap_sync=True, grad_bucket_bytes="4K"
+        )
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        dp.init(jax.random.key(0))
+        step = dp.make_train_step(_mse)
+        # the overlapped step is three programs, not one jitted callable
+        assert not hasattr(step, "lower")
+
+    def test_batch_divisibility_enforced(self):
+        if len(jax.devices()) != 8:
+            pytest.skip("needs the 8-device test mesh")
+        model = ht.nn.Sequential(ht.nn.Linear(8, 4))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.05)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        params = dp.init(jax.random.key(0))
+        state = opt.init_state(params)
+        step = dp.make_train_step(_mse, overlap_sync=True)
+        with pytest.raises(ValueError, match="divisible"):
+            step(params, state, jnp.zeros((9, 8)), jnp.zeros((9, 4)))
+
+    def test_allreduce_grads_entry_point(self):
+        # DataParallelOptimizer.allreduce_grads: the reference's hook-fired
+        # Iallreduce, as one explicit call over a stacked grad tree
+        if len(jax.devices()) != 8:
+            pytest.skip("needs the 8-device test mesh")
+        comm = ht.communication.get_comm()
+        p = comm.size
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1, grad_bucket_bytes=64)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stacked = {
+            "w": jax.device_put(
+                jnp.arange(p * 6, dtype=jnp.float32).reshape(p, 6),
+                NamedSharding(comm.mesh, P(comm.axis)),
+            )
+        }
+        out = opt.allreduce_grads(comm, stacked, domains=4)
+        np.testing.assert_allclose(
+            np.asarray(out["w"]),
+            np.arange(p * 6, dtype=np.float32).reshape(p, 6).mean(axis=0),
+            rtol=1e-6,
+        )
+
+
+# ---------------------------------------------------------------------- #
+# exports
+# ---------------------------------------------------------------------- #
+class TestExports:
+    def test_budget_setters_exported(self):
+        prev = ht.set_grad_bucket_budget("1M")
+        try:
+            assert ht.get_grad_bucket_budget() == 1024 * 1024
+        finally:
+            ht.set_grad_bucket_budget(prev)

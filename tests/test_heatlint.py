@@ -373,6 +373,80 @@ class TestHT104:
         """, path=self.PATH)
         assert fs == []
 
+    # -- ISSUE 16: the hierarchical/bucketed staging layer ------------- #
+    COLL_PATH = "heat_tpu/core/collectives.py"
+
+    def test_hierarchical_method_accounting_via_stage_accountant(self):
+        # hierarchical_allreduce stages through _account_stages (which loops
+        # comm._account_bytes) — delegation to the choke point IS accounting
+        fs = run_rule(CollectiveAccountingRule(), """
+            from jax import lax
+            class Communication:
+                def hierarchical_allreduce(self, x, op="sum"):
+                    from . import collectives as _coll
+                    _account_stages(self, _coll._Telescope(), 128, (0.5, 0.5), x=x)
+                    return lax.psum(x, "x")
+        """, path=self.PATH)
+        assert fs == []
+
+    def test_hierarchical_method_without_accounting_flagged(self):
+        fs = run_rule(CollectiveAccountingRule(), """
+            from jax import lax
+            class Communication:
+                def hierarchical_allreduce(self, x, op="sum"):
+                    return lax.psum(x, "x")
+        """, path=self.PATH)
+        assert [f.detail for f in fs] == ["hierarchical_allreduce"]
+
+    def test_staging_function_accounting_via_stage_accountant(self):
+        fs = run_rule(CollectiveAccountingRule(), """
+            def dispatch_bucket_averages(comm, leaves, plan, k, tele):
+                _account_stages(comm, tele, 1024.0, (0.5, 0.5))
+                return [leaves[j] for j in plan.buckets[k]]
+        """, path=self.COLL_PATH)
+        assert fs == []
+
+    def test_staging_function_delegating_to_dispatch_half_not_flagged(self):
+        # the lookahead pipelines account through their dispatch_* half
+        fs = run_rule(CollectiveAccountingRule(), """
+            def dispatch_bucket_averages(comm, leaves, plan, k, tele):
+                comm._account_bytes("allreduce", tele.wire(128.0))
+                return list(leaves)
+
+            def bucketed_param_sync(comm, params, w, plan=None):
+                avgs = dispatch_bucket_averages(comm, params, plan, 0, None)
+                return avgs
+        """, path=self.COLL_PATH)
+        assert fs == []
+
+    def test_staging_function_without_accounting_flagged(self):
+        fs = run_rule(CollectiveAccountingRule(), """
+            from jax import lax
+            def bucketed_param_sync(comm, params, w):
+                return lax.psum(params, "dcn")
+        """, path=self.COLL_PATH)
+        assert [f.detail for f in fs] == ["bucketed_param_sync"]
+
+    def test_staging_helpers_not_in_scope(self):
+        # underscore-private helpers (stage math, traced bodies) are not
+        # staging entries — the traced body deliberately never accounts
+        fs = run_rule(CollectiveAccountingRule(), """
+            from jax import lax
+            def _hierarchical_body(x, axis, p, d):
+                return lax.psum(x, axis)
+            def consume_bucket_averages(comm, leaves, avgs, plan, k, w):
+                return leaves
+        """, path=self.COLL_PATH)
+        assert fs == []
+
+    def test_repo_collectives_is_fully_accounted(self):
+        # the live invariant: the real collectives.py has NO findings
+        fs = lint_paths(
+            [os.path.join(REPO, "heat_tpu", "core", "collectives.py")],
+            select=["HT104"],
+        )
+        assert fs == []
+
     def test_repo_communication_is_fully_accounted(self):
         # the live invariant: the real communication.py has NO findings
         fs = lint_paths(
@@ -633,6 +707,28 @@ class TestHT108:
                 return redistribution.execute_plan(comm, array, plan)  # heatlint: disable=HT108 bench harness
         """)
         assert fs == []
+
+    def test_collectives_staging_layer_sanctioned(self):
+        # ISSUE 16: the hierarchical/bucketed staging layer routes every
+        # stage through _account_stages → comm._account_bytes (HT104 proves
+        # that), so its sharded program staging is the choke point, not a
+        # bypass of it
+        fs = run_rule(SeqStampBypassRule(), """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def dispatch_bucket_averages(comm, leaves, mesh):
+                return jax.device_put(leaves[0]._jarray, NamedSharding(mesh, P("dcn")))
+        """, path="heat_tpu/core/collectives.py")
+        assert fs == []
+
+    def test_same_staging_outside_sanctioned_layer_still_flagged(self):
+        fs = run_rule(SeqStampBypassRule(), """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def dispatch_bucket_averages(comm, leaves, mesh):
+                return jax.device_put(leaves[0]._jarray, NamedSharding(mesh, P("dcn")))
+        """, path="heat_tpu/optim/helper.py")
+        assert [f.detail for f in fs] == ["device_put"]
 
 
 # ---------------------------------------------------------------------- #

@@ -699,3 +699,76 @@ class TestWatermarks:
         # hysteresis: strictly growing by >5% per record
         for a, b in zip(peaks, peaks[1:]):
             assert b > a * (1 + memledger.WATERMARK_FRACTION)
+
+
+# ---------------------------------------------------------------------- #
+# bucketed hierarchical sync: the transient pipeline observed from inside
+# ---------------------------------------------------------------------- #
+class TestBucketedSyncTransients:
+    """ISSUE 16 reconciliation: the overlapped sync's in-flight bucket
+    averages are ledgered transients — peak ≤ budget + one bucket (the
+    lookahead-1 bound), dead after consumption, and the staged
+    ``comm.allreduce.bytes`` telescopes against the plan's stage factors
+    exactly."""
+
+    def _sync(self, budget):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from heat_tpu.core import collectives as coll
+        from heat_tpu.core.communication import Communication
+
+        devs = jax.devices()
+        if len(devs) != 8:
+            pytest.skip("needs the 8-device test mesh")
+        mesh = Mesh(np.asarray(devs).reshape(4, 2), ("dcn", "ici"))
+        comm = Communication(mesh, "dcn")
+        sh = NamedSharding(mesh, P("dcn"))
+        params = {
+            f"w{j}": jax.device_put(
+                jnp.ones((4, 64, 3 + j), jnp.float32), sh
+            )
+            for j in range(4)
+        }
+        leaves = jax.tree_util.tree_leaves(params)
+        plan = coll.plan_grad_buckets([a.nbytes for a in leaves], budget)
+        out = coll.bucketed_param_sync(comm, params, 0.5, plan=plan)
+        return plan, out
+
+    def test_transient_peak_bounded_by_budget_plus_one_bucket(self):
+        budget = 6144  # bytes: forces one bucket per leaf
+        plan, out = self._sync(budget)
+        assert plan.n_buckets > 2
+        peak = memledger.peak_by_category().get("transient", 0)
+        assert peak > 0
+        # lookahead-1: at most TWO buckets ever in flight
+        assert peak <= budget + plan.max_bucket_bytes
+        assert out is not None
+
+    def test_buckets_die_after_consumption(self):
+        _, out = self._sync(6144)
+        gc.collect()
+        live = memledger.live_by_category().get("transient", 0)
+        assert live == 0, live
+        assert out is not None  # the blended tree survives; transients died
+
+    def test_bytes_telescope_against_plan(self):
+        from heat_tpu.core import collectives as coll
+
+        b0 = profiler.counters().get("comm.allreduce.bytes", 0)
+        plan, _ = self._sync(6144)
+        moved = profiler.counters().get("comm.allreduce.bytes", 0) - b0
+        d, i = 4, 2
+        want = int(round(
+            plan.total_bytes / d * sum(coll._daso_stage_factors(d, i))
+        ))
+        assert moved == want
+
+    def test_bytes_k_invariant_under_ledger(self):
+        deltas = []
+        for budget in (None, 6144):
+            b0 = profiler.counters().get("comm.allreduce.bytes", 0)
+            self._sync(budget)
+            deltas.append(profiler.counters().get("comm.allreduce.bytes", 0) - b0)
+        assert deltas[0] > 0 and deltas[0] == deltas[1]

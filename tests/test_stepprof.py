@@ -110,6 +110,61 @@ class TestBreakdown:
         assert sp.step_breakdown([], ()) == []
 
 
+class TestOverlapDelta:
+    """ISSUE 16: a merge dir holding BOTH sync labels yields the
+    STEP-OVERLAP-DELTA comparison line; the existing STEP-OVERLAP format
+    (asserted by the chaos lane and CI greps) must not change."""
+
+    # two monolithic-sync steps (20% of the window waiting on comm) and
+    # two bucketed ones (5% waiting): overlap 0.8 vs 0.95, delta +0.15
+    SPANS = [
+        _span("daso.step", 0.00, 0.10, attrs={"sync": "monolithic"}),
+        _span("comm.Wait.wait", 0.05, 0.02, depth=1),
+        _span("daso.step", 0.10, 0.10, attrs={"sync": "monolithic"}),
+        _span("comm.allreduce.wait", 0.15, 0.02, depth=1),
+        _span("daso.step", 0.20, 0.10, attrs={"sync": "bucketed"}),
+        _span("comm.allreduce.wait", 0.25, 0.005, depth=1),
+        _span("daso.step", 0.30, 0.10, attrs={"sync": "bucketed"}),
+        _span("comm.allreduce.wait", 0.35, 0.005, depth=1),
+    ]
+
+    def test_delta_line_when_both_labels_present(self):
+        rows = sp.step_breakdown(self.SPANS, ("daso.step",))
+        d = sp.overlap_delta(rows)
+        assert d["daso.step"]["monolithic"] == 0.8
+        assert d["daso.step"]["bucketed"] == 0.95
+        text = sp.render(rows)
+        # the pre-existing marker format is untouched
+        assert "STEP-OVERLAP kind=daso.step steps=4 overlap=" in text
+        assert (
+            "STEP-OVERLAP-DELTA kind=daso.step "
+            "monolithic=0.800 bucketed=0.950 delta=+0.150" in text
+        )
+
+    def test_no_delta_line_for_single_label(self):
+        rows = sp.step_breakdown(self.SPANS[:4], ("daso.step",))
+        assert sp.overlap_delta(rows) == {}
+        assert "STEP-OVERLAP-DELTA" not in sp.render(rows)
+
+    def test_unlabeled_steps_do_not_fabricate_a_comparison(self):
+        spans = [
+            _span("daso.step", 0.0, 0.1),
+            _span("comm.Wait.wait", 0.05, 0.02, depth=1),
+            _span("daso.step", 0.1, 0.1, attrs={"sync": "bucketed"}),
+        ]
+        rows = sp.step_breakdown(spans, ("daso.step",))
+        assert sp.overlap_delta(rows) == {}
+
+    def test_delta_rides_the_cli(self, tmp_path, capsys):
+        d = str(tmp_path)
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            for rec in self.SPANS:
+                fh.write(json.dumps(rec) + "\n")
+        assert sp.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "STEP-OVERLAP-DELTA kind=daso.step" in out
+
+
 class TestCLI:
     def test_main_end_to_end(self, tmp_path, capsys):
         d = str(tmp_path)
