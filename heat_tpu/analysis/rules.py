@@ -1166,6 +1166,155 @@ class UnledgeredDeviceBufferRule(Rule):
 
 
 # -------------------------------------------------------------------- #
+# HT112 — federation code must inherit the journal-before-mutation path
+# -------------------------------------------------------------------- #
+
+
+@register
+class FederationJournaledMutationRule(Rule):
+    """The scheduler's crash-durability rests on ONE ordering: the journal
+    append happens first, and a failed append propagates with nothing
+    mutated (``submit``/``_shed``/``_finish``/``drain`` all keep it).  The
+    federation layer (``parallel/federation.py``) sits above N schedulers
+    and inherits that contract — a federation mutation the federation
+    journal never saw is a phantom the zero-loss replay cannot requeue.
+
+    Flagged, in federation modules only:
+
+    - **reaching into a scheduler's privates** — mutating another
+      object's ``_queue`` / ``_jobs`` / ``_done_ids`` /
+      ``_tenant_inflight`` (``sched._queue.append(job)``).  Those belong
+      to the scheduler; its journaled entry points (``submit`` /
+      ``recover`` / ``drain``) are the only sanctioned doors.  Flagged
+      unconditionally.
+    - **unjournaled lifecycle writes** — mutating the federation's OWN
+      job containers, or writing ``<obj>.state`` on a job/world, from a
+      function that never appends to a journal.  A function whose body
+      lexically contains a ``<...>journal<...>.append(...)`` call is a
+      journaled path and exempt (``__init__`` constructing fresh empty
+      state is too — there is nothing to journal yet)."""
+
+    code = "HT112"
+    name = "federation-unjournaled-mutation"
+    description = "scheduler/job state mutated from federation code outside the journaled append path"
+
+    FEDERATION_MODULES = ("parallel/federation.py",)
+    PRIVATE_FIELDS = {"_queue", "_jobs", "_done_ids", "_tenant_inflight"}
+    MUTATORS = {"append", "pop", "clear", "add", "remove", "discard",
+                "update", "extend", "insert", "sort", "setdefault"}
+    STATE_ATTRS = {"state"}
+
+    def _function_journals(self, ctx: LintContext, node: ast.AST) -> bool:
+        """True when the enclosing function is a journaled path: its body
+        lexically appends to a journal (``self.journal.append(...)``), or
+        it is ``__init__`` building fresh empty state."""
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return False
+        if fn.name == "__init__":
+            return True
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call) or last_attr(sub) != "append":
+                continue
+            dn = call_name(sub)
+            if dn and any("journal" in part.lower() for part in dn.split(".")):
+                return True
+        return False
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if not module_matches(ctx.path, self.FEDERATION_MODULES):
+            return []
+        out = []
+        # mutating METHOD calls on job-state containers
+        for node in ctx.walk(ast.Call):
+            func = node.func
+            if not isinstance(func, ast.Attribute) or func.attr not in self.MUTATORS:
+                continue
+            recv = func.value
+            if not isinstance(recv, ast.Attribute) or recv.attr not in self.PRIVATE_FIELDS:
+                continue
+            owner_is_self = (
+                isinstance(recv.value, ast.Name) and recv.value.id == "self"
+            )
+            if not owner_is_self:
+                f = ctx.finding(
+                    self, node,
+                    f"federation code mutates another object's scheduler-"
+                    f"private `{recv.attr}` directly — the scheduler's "
+                    "journaled entry points (submit/recover/drain) are the "
+                    "only doors that keep the journal-before-mutation "
+                    "contract",
+                    detail=f"{recv.attr}.{func.attr}",
+                )
+                if f is not None:
+                    out.append(f)
+            elif not self._function_journals(ctx, node):
+                f = ctx.finding(
+                    self, node,
+                    f"federation state `self.{recv.attr}` mutated in a "
+                    "function that never appends to a journal — a crash "
+                    "here leaves a job the zero-loss replay cannot see; "
+                    "journal first, mutate second",
+                    detail=f"self.{recv.attr}.{func.attr}",
+                )
+                if f is not None:
+                    out.append(f)
+        # ASSIGNMENT-form mutations: obj.state = ..., self._jobs[id] = ...
+        for node in ctx.walk(ast.Assign, ast.AugAssign, ast.AnnAssign):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                # lifecycle write on a non-self object: job.state / w.state
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr in self.STATE_ATTRS
+                    and not (isinstance(t.value, ast.Name) and t.value.id == "self")
+                    and not self._function_journals(ctx, node)
+                ):
+                    f = ctx.finding(
+                        self, node,
+                        "lifecycle state written outside a journaled path — "
+                        "the transition exists only in memory and dies with "
+                        "the process; append the record first",
+                        detail=f"{t.attr} =",
+                    )
+                    if f is not None:
+                        out.append(f)
+                    continue
+                # container writes: <obj>._jobs[...] = / <obj>._queue = ...
+                base = t
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if not isinstance(base, ast.Attribute) or base.attr not in self.PRIVATE_FIELDS:
+                    continue
+                owner_is_self = (
+                    isinstance(base.value, ast.Name) and base.value.id == "self"
+                )
+                if not owner_is_self:
+                    f = ctx.finding(
+                        self, node,
+                        f"federation code writes another object's scheduler-"
+                        f"private `{base.attr}` — use the scheduler's "
+                        "journaled entry points",
+                        detail=f"{base.attr} =",
+                    )
+                    if f is not None:
+                        out.append(f)
+                elif not self._function_journals(ctx, node):
+                    f = ctx.finding(
+                        self, node,
+                        f"federation state `self.{base.attr}` written in a "
+                        "function that never appends to a journal — journal "
+                        "first, mutate second",
+                        detail=f"self.{base.attr} =",
+                    )
+                    if f is not None:
+                        out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
 # HT2xx — the interprocedural family (callgraph + summaries engine)
 # -------------------------------------------------------------------- #
 

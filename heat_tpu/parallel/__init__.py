@@ -20,6 +20,8 @@ from . import scheduler
 from .scheduler import Job, JobJournal, JobRejected, JournalSchemaError, Scheduler
 from . import serving
 from .serving import make_executor
+from . import federation
+from .federation import AdmissionPredictor, Federation, WorldHandle
 
 __all__ = [
     "Supervisor",
@@ -33,6 +35,10 @@ __all__ = [
     "scheduler",
     "serving",
     "make_executor",
+    "federation",
+    "Federation",
+    "WorldHandle",
+    "AdmissionPredictor",
     "pipeline_apply",
     "ring_map",
     "halo_exchange",

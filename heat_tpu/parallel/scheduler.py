@@ -480,6 +480,8 @@ def replay_journal(path: str) -> dict:
                     view["state"] = DONE
                     view["finish_t"] = rec.get("t")
                     view["exec_s"] = rec.get("exec_s")
+                    if "result" in rec:  # journaled answer (serving digest)
+                        view["result"] = rec.get("result")
                 elif kind == FAILED:
                     if view.get("state") != DONE:
                         view["state"] = FAILED
@@ -910,28 +912,47 @@ class Scheduler:
 
     def _finish(self, job: Job, state: str, reason: Optional[str] = None,
                 result: Any = None) -> None:
+        finish_t = self.clock()
+        # journal FIRST — the same no-phantom ordering as submit()/_shed():
+        # a failed append must propagate with the job's state, the tenant
+        # accounting and the outcome counters ALL untouched.  The reverse
+        # order (the pre-fix drain() bug) left a job FAILED in memory that
+        # the journal — and hence every crash recovery and the attestation
+        # line — never saw: a phantom terminal state.
+        if self.journal is not None:
+            if state == DONE:
+                rec = {
+                    "type": DONE, "id": job.job_id,
+                    "exec_s": round(finish_t - job.dispatch_t, 6)
+                    if job.dispatch_t else None,
+                    "tid": job.trace_id,
+                }
+                # the result rides the DONE record when it is JSON-able
+                # (the serving digests are) — a crash-surviving answer the
+                # federation ingress can serve from the replay alone
+                try:
+                    json.dumps(result)
+                except (TypeError, ValueError):
+                    pass
+                else:
+                    if result is not None:
+                        rec["result"] = result
+                self.journal.append(rec)
+            else:
+                self.journal.append({"type": FAILED, "id": job.job_id,
+                                     "reason": reason, "tid": job.trace_id})
         job.state = state
         job.reason = reason
         job.result = result
-        job.finish_t = self.clock()
+        job.finish_t = finish_t
         t = self._tenant_inflight.get(job.tenant, 0)
         self._tenant_inflight[job.tenant] = max(0, t - 1)
         if state == DONE:
             counter_inc("sched.done")
             self._done_ids.add(job.job_id)
-            if self.journal is not None:
-                self.journal.append({
-                    "type": DONE, "id": job.job_id,
-                    "exec_s": round(job.finish_t - job.dispatch_t, 6)
-                    if job.dispatch_t else None,
-                    "tid": job.trace_id,
-                })
         else:
             counter_inc("sched.failed")
             counter_inc(f"sched.failed.{reason}" if reason else "sched.failed.error")
-            if self.journal is not None:
-                self.journal.append({"type": FAILED, "id": job.job_id,
-                                     "reason": reason, "tid": job.trace_id})
         fr = _flightrec()
         if fr is not None:
             # the crash-durable side of the causal path: the terminal state
@@ -1192,13 +1213,21 @@ class Scheduler:
         priority order (the report then names the outcome of EVERY job the
         scheduler ever accepted — highest-priority victims listed first in
         the journal, so a post-hoc reader sees what was sacrificed in the
-        order it mattered)."""
+        order it mattered).
+
+        A journal-append failure mid-drain propagates LOUDLY with the
+        failing job (and everything behind it) still queued and still
+        SUBMITTED — ``_finish`` journals before mutating, and each job
+        leaves the queue only after its terminal record landed, so a
+        faulted drain can simply be retried: the already-failed prefix is
+        gone from the queue, and no job ever holds a terminal state the
+        journal never saw."""
         self._queue.sort(key=lambda j: (-j.priority, j._order))
         n = 0
-        for job in list(self._queue):
-            self._finish(job, FAILED, reason)
+        while self._queue:
+            self._finish(self._queue[0], FAILED, reason)
+            self._queue.pop(0)
             n += 1
-        self._queue.clear()
         return n
 
     # ------------------------------------------------------------------ #

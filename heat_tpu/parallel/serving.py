@@ -29,6 +29,7 @@ processes that never execute a job.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Callable, Dict, List
 
 from . import scheduler as _scheduler
@@ -156,18 +157,49 @@ def make_executor(comm=None) -> Callable[[List[Any]], List[Any]]:
 
     _single = {"matmul": _matmul, "solve": _solve, "kmeans": _kmeans}
 
-    def execute(jobs: List[Any]) -> List[Any]:
+    # federation admission feedback (ISSUE 17): with HEAT_TPU_FED_PEAKS
+    # set to a history path and the memledger armed, every executed batch
+    # is bracketed in a memledger.peak_window and its incremental peak is
+    # recorded per kind — the persisted history federation.
+    # AdmissionPredictor sheds mem_infeasible jobs against at the edge.
+    _predictor = None
+    _peaks_path = os.environ.get("HEAT_TPU_FED_PEAKS")
+    if _peaks_path:
+        from ..utils import memledger as _memledger
+
+        if _memledger.enabled():
+            from . import federation as _federation
+
+            _predictor = _federation.AdmissionPredictor(_peaks_path)
+
+    def _run(jobs: List[Any]) -> List[Any]:
         kind = jobs[0].kind
+        if kind == "nn_forward":
+            return _nn_forward_batch(jobs)
+        fn = _single.get(kind)
+        if fn is None:
+            raise ValueError(f"unknown job kind {kind!r} (serve {KINDS})")
+        # same-signature jobs re-enter the SAME cached programs (PR 1
+        # sharding-keyed cache): the batch shares compiled dispatches
+        # even though each job's data digest is computed separately
+        return [fn(job) for job in jobs]
+
+    def execute(jobs: List[Any]) -> List[Any]:
         try:
-            if kind == "nn_forward":
-                return _nn_forward_batch(jobs)
-            fn = _single.get(kind)
-            if fn is None:
-                raise ValueError(f"unknown job kind {kind!r} (serve {KINDS})")
-            # same-signature jobs re-enter the SAME cached programs (PR 1
-            # sharding-keyed cache): the batch shares compiled dispatches
-            # even though each job's data digest is computed separately
-            return [fn(job) for job in jobs]
+            if _predictor is not None:
+                from ..utils import memledger as _memledger
+
+                with _memledger.peak_window() as w:
+                    results = _run(jobs)
+                # per-JOB footprint: the batch's incremental peak split
+                # evenly — conservative enough for admission (the window
+                # maximum already over-counts concurrent neighbors)
+                delta = max(0, int(w["peak"]) - int(w["base"]))
+                if delta > 0:
+                    _predictor.observe(jobs[0].kind,
+                                       (delta + len(jobs) - 1) // len(jobs))
+                return results
+            return _run(jobs)
         except Exception as e:
             _raise_world_broken(e)  # transport death -> WorldBroken
             raise

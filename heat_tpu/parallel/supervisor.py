@@ -185,9 +185,19 @@ class Supervisor:
         telemetry_dir: Optional[str] = None,
         job_journal: Optional[str] = None,
         monitor_port: Optional[int] = None,
+        resize: Optional[Callable[[int], Optional[int]]] = None,
     ):
         self.spawn = spawn
         self.n_ranks = int(n_ranks)
+        # elastic capacity (ISSUE 17): `resize(current_n_ranks)` is
+        # consulted at each RELAUNCH boundary — the one point where the
+        # world is fully down and the checkpoint world-reshaping path
+        # (resume validates topology via the sidecar) owns state across a
+        # size change.  Returning a different positive rank count re-sizes
+        # the next generation; None / same / nonpositive keeps it.  The
+        # federation layer derives the target from journal-visible queue
+        # depth (federation.resize_target).
+        self.resize = resize
         self.heartbeat_dir = heartbeat_dir
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.restart_budget = int(restart_budget)
@@ -477,6 +487,25 @@ class Supervisor:
                 )
             epoch += 1
             self.counters["health.restarts"] += 1
+            if self.resize is not None:
+                try:
+                    want = self.resize(self.n_ranks)
+                except Exception:
+                    want = None  # a broken resize hook must not kill supervision
+                if want is not None and int(want) > 0 and int(want) != self.n_ranks:
+                    # beacons are cleared under the OLD count first: a
+                    # shrink would otherwise leave high-rank beacons behind
+                    # for the staleness monitor to convict
+                    self._clear_heartbeats()
+                    print(
+                        f"supervisor: resizing world {self.n_ranks} -> "
+                        f"{int(want)} rank(s) for epoch {epoch}",
+                        flush=True,
+                    )
+                    self.n_ranks = int(want)
+                    self.counters["health.resizes"] = (
+                        self.counters.get("health.resizes", 0) + 1
+                    )
             print(
                 f"supervisor: restarting the world (epoch {epoch} of "
                 f"<= {self.restart_budget}) on a fresh coordinator port",

@@ -96,6 +96,7 @@ __all__ = [
     "counters",
     "snapshot",
     "reset_peak",
+    "peak_window",
     "alloc_check",
     "is_oom",
     "note_oom",
@@ -130,6 +131,11 @@ _live = 0
 _peak = 0
 _live_cat: Dict[str, int] = {}
 _peak_cat: Dict[str, int] = {}
+# open peak_window() scopes: each dict tracks the max live bytes seen
+# while the window was open (updated under the lock on every live
+# increase — BEFORE the global-peak early return, since a window opened
+# below the all-time high must still see its own local maximum)
+_windows: List[dict] = []
 _registered_total = 0
 _oom_dumps = 0
 _last_ring_peak = 0
@@ -303,6 +309,9 @@ def _bump_peak_locked() -> None:
     emit a ``mem`` watermark record into the flight ring when the new peak
     clears the hysteresis threshold."""
     global _peak, _last_ring_peak
+    for w in _windows:
+        if _live > w["peak"]:
+            w["peak"] = _live
     if _live <= _peak:
         return
     _peak = _live
@@ -669,6 +678,34 @@ def reset_peak() -> None:
             if v > 0:
                 _peak_cat[c] = v
         _last_ring_peak = 0
+
+
+@contextlib.contextmanager
+def peak_window():
+    """Scoped incremental-peak measurement: yields a dict whose ``base``
+    is the live bytes at entry and whose ``peak`` tracks the maximum live
+    bytes observed while the block runs (updated on every registration,
+    independent of the GLOBAL high-water mark — a window opened below the
+    all-time peak still sees its own local maximum).  ``peak - base`` is
+    the block's incremental device-memory footprint — what the federation
+    admission predictor records per job kind (``serving.make_executor``
+    brackets each batch in one of these).  Nestable and thread-tolerant:
+    concurrent registrations from other threads inflate the window (an
+    honest over-estimate for admission — never an under-estimate of this
+    block alone... beyond what concurrency genuinely added)."""
+    with _lock:
+        _drain_locked()
+        w = {"base": _live, "peak": _live}
+        _windows.append(w)
+    try:
+        yield w
+    finally:
+        with _lock:
+            _drain_locked()
+            try:
+                _windows.remove(w)
+            except ValueError:
+                pass
 
 
 # ---------------------------------------------------------------------- #

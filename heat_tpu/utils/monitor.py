@@ -22,7 +22,22 @@ HTTP server (stdlib ``http.server``, daemon thread) exposing
 - ``GET /healthz`` — the worst-rank staleness verdict as JSON: 200 when
   every expected rank's beacon is fresher than ``stale_after`` seconds,
   503 naming the worst rank otherwise (the supervisor's staleness rule,
-  readable by a load balancer).
+  readable by a load balancer).  With a **federation source** armed
+  (:func:`set_federation_source`) the body also carries per-world rows
+  and the verdict tightens: 200 only when every world that is not
+  quarantined/retired is healthy — a draining world is a 503 a load
+  balancer acts on, a quarantined one is already-handled degradation.
+
+- **Ingress** (armed via :func:`set_ingress`, typically by the
+  federation layer): ``POST /submit`` admits a job through the backend's
+  journaled submit path and answers 200 with ``{"id", "trace_id"}``;
+  a structured shed (``JobRejected``) surfaces as HTTP **429** (or
+  **413** for an oversized body) with the machine-readable reason in the
+  JSON body, so load-shedding stays a synchronous backpressure signal on
+  the wire.  ``GET /status/<id>`` / ``GET /result/<id>`` read the job's
+  journal-backed view (404 for ids never accepted).  Trace ids are
+  minted at the edge — the same choke-point identity the journals and
+  flight rings correlate on.
 
 **Hot-path contract.**  Arming starts ONE daemon thread that blocks in
 ``accept()``; nothing is added to any dispatch/staging path — there is no
@@ -65,9 +80,14 @@ __all__ = [
     "address",
     "register_gauge_source",
     "unregister_gauge_source",
+    "set_ingress",
+    "clear_ingress",
+    "set_federation_source",
+    "clear_federation_source",
     "metrics_text",
     "healthz",
     "Monitor",
+    "MAX_BODY_BYTES",
 ]
 
 _METRIC_BAD = re.compile(r"[^a-zA-Z0-9_:]")
@@ -78,6 +98,71 @@ _gauge_sources: Dict[str, Callable[[], Optional[Dict[str, float]]]] = {}
 
 _MONITOR: Optional["Monitor"] = None
 _T0 = time.time()
+
+# ---------------------------------------------------------------------- #
+# ingress + federation wiring (armed by the federation layer)
+# ---------------------------------------------------------------------- #
+# Request bodies beyond this are refused 413 BEFORE being read — the
+# cheapest possible shed, and the cap that keeps an unauthenticated-LAN
+# endpoint from being a memory amplifier.
+MAX_BODY_BYTES = 1 << 20
+
+# The ingress backend: an object with ingress_submit(payload) -> dict,
+# ingress_status(id) -> dict|None, ingress_result(id) -> dict|None
+# (the federation.Federation protocol).  Duck-typed on purpose: a
+# standalone-loaded federation's JobRejected is a DIFFERENT class object
+# from the in-package one, so the handler matches sheds by their
+# ``reason`` attribute, never by isinstance.
+_INGRESS: Optional[object] = None
+
+# The federation health view: fn() -> report dict (federation.
+# Federation.health_report shape) | None when the federation is gone.
+_FED_SOURCE: Optional[Callable[[], Optional[dict]]] = None
+
+
+def set_ingress(backend: object) -> None:
+    """Arm the HTTP ingress: ``backend`` handles ``/submit``,
+    ``/status/<id>`` and ``/result/<id>`` (see :data:`_INGRESS` for the
+    protocol).  Re-arming replaces — a restarted federator wins."""
+    global _INGRESS
+    _INGRESS = backend
+
+
+def clear_ingress() -> None:
+    global _INGRESS
+    _INGRESS = None
+
+
+def set_federation_source(fn: Callable[[], Optional[dict]]) -> None:
+    """Arm the federation view: ``fn()`` returns a
+    ``Federation.health_report()`` dict (or None when the federation was
+    collected — the source is then pruned).  Feeds both the ``/healthz``
+    world rows/verdict and the ``fed_worlds_*`` ``/metrics`` gauges, so
+    the two surfaces reconcile by construction: same report, same
+    scrape."""
+    global _FED_SOURCE
+    _FED_SOURCE = fn
+
+
+def clear_federation_source() -> None:
+    global _FED_SOURCE
+    _FED_SOURCE = None
+
+
+def _federation_report() -> Optional[dict]:
+    """One scrape's federation view, pruning a collected source."""
+    global _FED_SOURCE
+    fn = _FED_SOURCE
+    if fn is None:
+        return None
+    try:
+        report = fn()
+    except Exception:
+        return None
+    if report is None:  # owner collected
+        _FED_SOURCE = None
+        return None
+    return report if isinstance(report, dict) else None
 
 
 def metric_name(name: str) -> str:
@@ -234,6 +319,15 @@ def metrics_text(
             mname = metric_name(name)
             lines.append(f"# TYPE {mname} gauge")
             lines.append(f"{mname} {value}")
+    # federation world-state census — same health_report() the /healthz
+    # rows render, so the gauges reconcile with the federator's view
+    fed = _federation_report()
+    if fed is not None:
+        for key in ("healthy", "draining", "quarantined", "retired"):
+            lines.append(f"# TYPE fed_worlds_{key} gauge")
+            lines.append(f"fed_worlds_{key} {int(fed.get(key, 0) or 0)}")
+        lines.append("# TYPE fed_queue_depth gauge")
+        lines.append(f"fed_queue_depth {int(fed.get('queue_depth', 0) or 0)}")
     lines.extend(_histogram_lines())
     # heartbeat staleness + flight-recorder seq lag per rank
     rows, _worst = _heartbeat_view(heartbeat_dir, stale_after)
@@ -277,28 +371,51 @@ def healthz(
     """The ``/healthz`` verdict: ``(ok, body)``.  With a heartbeat dir,
     ok ⇔ every rank's beacon is fresher than ``stale_after`` (the body
     names the worst rank either way); without one, ok attests only this
-    process's liveness."""
+    process's liveness.  With a federation source armed, the verdict
+    additionally requires every non-quarantined, non-retired world to be
+    healthy (a draining world → 503; a quarantined world is excluded —
+    degradation the federator already handled must not page)."""
     rows, worst = _heartbeat_view(heartbeat_dir, stale_after)
     body: dict = {"pid": os.getpid(), "uptime_s": round(time.time() - _T0, 3)}
-    if not rows:
+    fed = _federation_report()
+    if not rows and fed is None:
         body["ok"] = True
         body["detail"] = "no heartbeat dir configured; process is up"
         return True, body
-    stale = [r for r in rows if r["stale"]]
-    ok = not stale
+    details: List[str] = []
+    ok = True
+    if rows:
+        stale = [r for r in rows if r["stale"]]
+        ok = not stale
+        body["ranks"] = rows
+        body["worst_rank"] = {k: worst[k] for k in ("rank", "age_s", "stale")
+                              if k in worst}
+        body["stale_after_s"] = stale_after
+        details.append(
+            f"all {len(rows)} rank(s) fresh (worst: rank {worst['rank']} at "
+            f"{worst['age_s']}s)"
+            if ok
+            else f"rank(s) {[r['rank'] for r in stale]} stale "
+                 f"(> {stale_after}s); worst: rank {worst['rank']} at "
+                 f"{worst['age_s']}s"
+        )
+    if fed is not None:
+        fed_ok = bool(fed.get("ok", True))
+        body["federation"] = fed
+        unhealthy = [
+            w.get("world")
+            for w in fed.get("worlds", [])
+            if w.get("state") not in ("healthy", "quarantined", "retired")
+        ]
+        details.append(
+            f"federation: {fed.get('healthy', 0)} healthy / "
+            f"{fed.get('draining', 0)} draining / "
+            f"{fed.get('quarantined', 0)} quarantined"
+            + (f"; gating world(s) {unhealthy}" if not fed_ok else "")
+        )
+        ok = ok and fed_ok
     body["ok"] = ok
-    body["ranks"] = rows
-    body["worst_rank"] = {k: worst[k] for k in ("rank", "age_s", "stale")
-                          if k in worst}
-    body["stale_after_s"] = stale_after
-    body["detail"] = (
-        f"all {len(rows)} rank(s) fresh (worst: rank {worst['rank']} at "
-        f"{worst['age_s']}s)"
-        if ok
-        else f"rank(s) {[r['rank'] for r in stale]} stale "
-             f"(> {stale_after}s); worst: rank {worst['rank']} at "
-             f"{worst['age_s']}s"
-    )
+    body["detail"] = "; ".join(details)
     return ok, body
 
 
@@ -334,6 +451,10 @@ class Monitor:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, code: int, body: dict) -> None:
+                self._send(code, (json.dumps(body, indent=1) + "\n").encode(),
+                           "application/json")
+
             def do_GET(self):  # noqa: N802 — http.server API
                 path = self.path.split("?", 1)[0]
                 try:
@@ -347,15 +468,90 @@ class Monitor:
                         )
                     elif path == "/healthz":
                         ok, body = healthz(mon.heartbeat_dir, mon.stale_after)
-                        self._send(
-                            200 if ok else 503,
-                            (json.dumps(body, indent=1) + "\n").encode(),
-                            "application/json",
-                        )
+                        self._send_json(200 if ok else 503, body)
+                    elif path.startswith(("/status/", "/result/")):
+                        self._ingress_get(path)
                     else:
                         self._send(404, b"try /metrics or /healthz\n",
                                    "text/plain")
                 except BrokenPipeError:  # scraper hung up mid-write
+                    pass
+
+            def _ingress_get(self, path: str) -> None:
+                backend = _INGRESS
+                if backend is None:
+                    self._send_json(503, {"error": "no_ingress",
+                                          "detail": "no ingress backend armed"})
+                    return
+                verb, job_id = path[1:].split("/", 1)
+                reader = getattr(backend, f"ingress_{verb}")
+                try:
+                    view = reader(job_id)
+                except Exception as exc:
+                    self._send_json(500, {"error": "ingress_error",
+                                          "detail": str(exc)})
+                    return
+                if view is None:
+                    self._send_json(404, {"error": "unknown_job",
+                                          "id": job_id})
+                    return
+                self._send_json(200, view)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path != "/submit":
+                        self._send(404, b"POST /submit\n", "text/plain")
+                        return
+                    backend = _INGRESS
+                    if backend is None:
+                        self._send_json(503, {"error": "no_ingress",
+                                              "detail": "no ingress backend armed"})
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length", 0) or 0)
+                    except ValueError:
+                        length = 0
+                    if length > MAX_BODY_BYTES:
+                        # refused BEFORE the body is read — 413 is the
+                        # structured "payload too large" shed at the edge
+                        self._send_json(413, {
+                            "error": "payload_too_large",
+                            "detail": f"body {length} B exceeds the "
+                                      f"{MAX_BODY_BYTES} B ingress cap",
+                        })
+                        return
+                    try:
+                        payload = json.loads(self.rfile.read(length) or b"{}")
+                    except ValueError:
+                        self._send_json(400, {"error": "bad_request",
+                                              "detail": "body is not JSON"})
+                        return
+                    try:
+                        out = backend.ingress_submit(payload)
+                    except ValueError as exc:
+                        self._send_json(400, {"error": "bad_request",
+                                              "detail": str(exc)})
+                        return
+                    except Exception as exc:
+                        # a structured shed (JobRejected — matched by its
+                        # reason attribute, never isinstance: a standalone-
+                        # loaded federation raises a different class object)
+                        reason = getattr(exc, "reason", None)
+                        if reason is None:
+                            self._send_json(500, {"error": "ingress_error",
+                                                  "detail": str(exc)})
+                            return
+                        code = 413 if reason == "payload_too_large" else 429
+                        self._send_json(code, {
+                            "error": str(reason),
+                            "id": getattr(exc, "job_id", None),
+                            "tenant": getattr(exc, "tenant", None),
+                            "detail": getattr(exc, "detail", "") or str(exc),
+                        })
+                        return
+                    self._send_json(200, out)
+                except BrokenPipeError:  # client hung up mid-write
                     pass
 
         self._server = ThreadingHTTPServer((addr, int(port)), Handler)

@@ -45,6 +45,16 @@ launcher prints the journal-derived attestation
 per-tenant SLO table, and the supervisor report carries the per-generation
 ``jobs`` section.
 
+``MPDRYRUN_MODE=fed`` runs the federated multi-world scenario (ISSUE 17):
+the launcher stands up an HTTP ingress (``utils/monitor.py`` + a
+standalone-loaded ``parallel/federation.py`` — still no jax in this
+process), POSTs ``MPDRYRUN_JOBS`` jobs to ``/submit`` (plus one job shed
+``mem_infeasible`` at the edge, HTTP 429), dispatches them across TWO
+supervised worlds, SIGKILLs every rank of world w1 mid-queue
+(``sched.dispatch:exit=2``, restart budget 0), quarantines it, steals its
+unfinished jobs onto a resized w0, and proves zero loss with the
+journal-derived ``FED worlds=2 lost=0`` attestation.
+
 Run:  python scripts/multiprocess_dryrun.py                    (launcher, 2×4)
       MPDRYRUN_NPROC=4 MPDRYRUN_DEVS=2 python scripts/multiprocess_dryrun.py
       python scripts/multiprocess_dryrun.py WORKER_ID          (internal)
@@ -897,6 +907,89 @@ def serve_worker(pid: int, port: int, tmpdir: str) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# fed worker (MPDRYRUN_MODE=fed): one rank of one federated WORLD — runs
+# the scheduler over the job slice the federator assigned to this world
+# ---------------------------------------------------------------------- #
+FED_SERVE_MARKER = "FEDSERVE-OK"
+
+
+def fed_serve_worker(pid: int, port: int, tmpdir: str) -> None:
+    """One rank of one federated world (ISSUE 17).
+
+    Like :func:`serve_worker`, but the job list comes from the federator's
+    assignment file (``MPDRYRUN_FED_JOBS``: the submit records
+    ``Federation.assign`` journaled — trace ids already minted at the HTTP
+    edge) and the scheduler journals into this WORLD's own journal
+    (``MPDRYRUN_FED_JOURNAL``), which the federator later reconciles back
+    into the federation journal.  ``HEAT_TPU_FED_PEAKS`` (set by the
+    launcher) makes ``serving.make_executor`` record each batch's
+    memledger peak per kind — the admission predictor's history."""
+    import faulthandler
+    import json as _json
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+    faulthandler.dump_traceback_later(
+        float(os.environ.get("MPDRYRUN_WATCHDOG", "450")), exit=True
+    )
+    n_proc = int(os.environ.get("MPDRYRUN_NPROC", "1"))
+    devs = int(os.environ.get("MPDRYRUN_DEVS", "2"))
+    os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
+    world = os.environ.get("MPDRYRUN_FED_WORLD", "w?")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=n_proc, process_id=pid
+    )
+    sys.path.insert(0, REPO)
+
+    import heat_tpu as ht
+
+    ht.core.bootstrap.init_distributed(num_processes=n_proc, process_id=pid)
+    from heat_tpu.utils import telemetry
+
+    telemetry.enable()
+    comm = ht.communication.get_comm()
+    hb = _make_heartbeat(pid)
+    hb.beat(step=0, status="bring-up")
+
+    from heat_tpu.parallel import scheduler as sched_mod
+    from heat_tpu.parallel import serving
+
+    with open(os.environ["MPDRYRUN_FED_JOBS"]) as fh:
+        records = _json.load(fh)
+    journal_path = os.environ["MPDRYRUN_FED_JOURNAL"]
+    sch = sched_mod.Scheduler(
+        serving.make_executor(comm),
+        max_queue=max(len(records) + 2, 8),
+        max_batch=4,
+        # one journal per WORLD, written by its rank 0 (SPMD lockstep: one
+        # rank's record stream is the world's truth)
+        journal=sched_mod.JobJournal(journal_path) if pid == 0 else None,
+        batch_key=serving.batch_key,
+    )
+    for rec in records:
+        # from_record keeps the edge-minted trace id: the fed journal, this
+        # world's journal and the flight rings correlate on the SAME id
+        sch.submit(sched_mod.Job.from_record(rec))
+    hb.beat(status="serving")
+    rep = sch.run(beat=hb.beat)
+    done = rep["by_state"].get(sched_mod.DONE, 0)
+    failed = rep["by_state"].get(sched_mod.FAILED, 0)
+    print(
+        f"[{pid}] {FED_SERVE_MARKER} world={world} jobs={len(rep['jobs'])} "
+        f"done={done} failed={failed}",
+        flush=True,
+    )
+    telemetry.flush(os.path.join(tmpdir, "telemetry"))
+    print(f"[{pid}] {MARKER}", flush=True)
+    faulthandler.cancel_dump_traceback_later()
+    ht.core.bootstrap.finalize_distributed()
+
+
+# ---------------------------------------------------------------------- #
 # train worker (MPDRYRUN_MODE=train): the kill-and-resume chaos scenario
 # ---------------------------------------------------------------------- #
 def train_worker(pid: int, port: int, tmpdir: str) -> None:
@@ -997,6 +1090,342 @@ def train_worker(pid: int, port: int, tmpdir: str) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# fed launcher (MPDRYRUN_MODE=fed): the federated multi-world scenario —
+# HTTP ingress, two supervised worlds, a SIGKILLed world mid-queue, work
+# stealing, elastic resize, and the journal-derived FED lost=0 attestation
+# ---------------------------------------------------------------------- #
+def _http(method: str, url: str, payload: dict = None, timeout: float = 15.0):
+    """(status, parsed-JSON body) — errors like 429/503 are ANSWERS here
+    (the structured-backpressure contract under test), not exceptions."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    data = _json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read().decode()
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode()
+        code = e.code
+    try:
+        return code, _json.loads(raw or "{}")
+    except ValueError:
+        return code, raw  # /metrics is Prometheus text, not JSON
+
+
+def fed_main() -> int:
+    """Two supervised worlds behind one HTTP ingress (ISSUE 17).
+
+    Phase 1: 12 jobs POSTed to ``/submit`` (plus one ``giant`` job shed
+    ``mem_infeasible`` at the edge — HTTP 429, structured), assigned
+    across both worlds; world w1 is armed with ``sched.dispatch:exit=2``
+    and ``restart_budget=0``, so every one of its ranks dies by SIGKILL
+    mid-queue and the supervisor gives up — a whole WORLD lost.
+    Phase 2: the federator reconciles both world journals, quarantines
+    w1, steals its unfinished jobs, resizes w0 up, and re-serves them.
+    The run passes iff the journal-replayed attestation reads
+    ``FED worlds=2 lost=0`` and ``/result/<id>`` serves a stolen job's
+    digest over HTTP."""
+    import json as _json
+    import tempfile
+    import threading
+
+    ok = True
+    tmpdir = tempfile.mkdtemp(prefix="mpdryrun_fed_")
+    n_jobs = int(os.environ.get("MPDRYRUN_JOBS", "12"))
+    gen_deadline = float(os.environ.get("MPDRYRUN_DEADLINE", "420"))
+    fed_dir = os.path.join(tmpdir, "fed")
+    os.makedirs(fed_dir, exist_ok=True)
+    peaks_path = os.path.join(fed_dir, "peaks.json")
+    # seed the per-kind peak history: `giant` is KNOWN (recorded by a
+    # previous serving generation, here pre-seeded) to peak at ~1 TiB —
+    # no 8 GiB world can fit it, so admission must shed it at the edge
+    with open(peaks_path, "w") as fh:
+        _json.dump({"giant": 1 << 40}, fh)
+
+    fed_mod = _load_standalone("heat_federation", "heat_tpu/parallel/federation.py")
+    mon_mod = _load_standalone("heat_monitor", "heat_tpu/utils/monitor.py")
+    sup_mod = _supervisor_mod()
+
+    worlds = {}
+    for name in ("w0", "w1"):
+        wdir = os.path.join(tmpdir, name)
+        worlds[name] = {
+            "dir": wdir,
+            "hb": os.path.join(wdir, "heartbeats"),
+            "fr": os.path.join(wdir, "flightrec"),
+            "journal": os.path.join(wdir, "telemetry", "sched_journal.jsonl"),
+        }
+        os.makedirs(worlds[name]["hb"], exist_ok=True)
+
+    fed = fed_mod.Federation(
+        os.path.join(fed_dir, "fed_journal.jsonl"),
+        max_queue=max(32, n_jobs + 4),
+        predictor=fed_mod.AdmissionPredictor(peaks_path),
+    )
+    for name, w in worlds.items():
+        fed.add_world(
+            name,
+            n_ranks=1,
+            capacity_bytes=8 << 30,
+            heartbeat_dir=w["hb"],
+            journal_path=w["journal"],
+        )
+
+    # the ingress: the monitor's HTTP server with the federation armed
+    # behind it — submits journal at the edge, sheds answer synchronously
+    mon = mon_mod.Monitor(port=0)
+    mon_mod.set_ingress(fed)
+    mon_mod.set_federation_source(fed.health_report)
+    url = mon.url
+    kinds = ("matmul", "solve", "kmeans", "nn_forward")
+    tenants = ("acme", "globex", "initech")
+    payloads = {
+        "matmul": lambda i: {"n": 16, "seed": i},
+        "solve": lambda i: {"n": 8},
+        "kmeans": lambda i: {"n": 32, "k": 2, "seed": i % 3},
+        "nn_forward": lambda i: {"batch": 4, "features": 8, "seed": i},
+    }
+    submitted = 0
+    for i in range(n_jobs):
+        kind = kinds[i % len(kinds)]
+        code, body = _http("POST", f"{url}/submit", {
+            "id": f"job{i:03d}",
+            "kind": kind,
+            "tenant": tenants[i % len(tenants)],
+            "priority": i % 3,
+            "deadline_s": 600,
+            "retry_budget": 1,
+            "payload": payloads[kind](i),
+        })
+        if code != 200 or not body.get("trace_id"):
+            print(f"fed: POST /submit job{i:03d} -> {code} {body}")
+            ok = False
+        else:
+            submitted += 1
+    print(f"FED-INGRESS url={url} submitted={submitted}", flush=True)
+    # the memory-infeasible job: shed at the edge, 429, structured reason
+    code, body = _http("POST", f"{url}/submit", {
+        "id": "giant", "kind": "giant", "tenant": "acme", "payload": {},
+    })
+    if code == 429 and body.get("error") == "mem_infeasible":
+        print(f"FED-SHED id=giant reason={body['error']} http={code}", flush=True)
+    else:
+        print(f"fed: giant job expected 429 mem_infeasible, got {code} {body}")
+        ok = False
+    code, body = _http("GET", f"{url}/status/job000")
+    if code != 200 or body.get("state") != "submitted":
+        print(f"fed: GET /status/job000 -> {code} {body}")
+        ok = False
+    code, body = _http("GET", f"{url}/healthz")
+    print(f"FED-HEALTHZ http={code} detail={body.get('detail', '')!r}", flush=True)
+    ok = ok and code == 200
+
+    assignment = fed.assign()
+    log_paths = []
+    open_logs = []
+
+    def make_spawn(name: str, jobs_file: str, armed: bool, tag: str = "p1"):
+        w = worlds[name]
+
+        def spawn(rank: int, epoch: int, port: int):
+            env = dict(os.environ)
+            env["MPDRYRUN_PORT"] = str(port)
+            env["MPDRYRUN_TMP"] = w["dir"]
+            env["MPDRYRUN_HB"] = w["hb"]
+            env["MPDRYRUN_NPROC"] = str(fed.worlds[name].n_ranks)
+            env["MPDRYRUN_FED_WORLD"] = name
+            env["MPDRYRUN_FED_JOBS"] = jobs_file
+            env["MPDRYRUN_FED_JOURNAL"] = w["journal"]
+            env["HEAT_TPU_FLIGHTREC_DIR"] = w["fr"]
+            env["HEAT_TPU_FLIGHTREC_RANK"] = str(rank)
+            env["HEAT_TPU_MEMLEDGER"] = "1"
+            env["HEAT_TPU_FED_PEAKS"] = peaks_path
+            env["HEAT_TPU_RESTART_EPOCH"] = str(epoch)
+            env["PYTHONUNBUFFERED"] = "1"
+            env.pop("PYTHONPATH", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            if armed and epoch == 0:
+                env["HEAT_TPU_FAULTS"] = "sched.dispatch:exit=2"
+            else:
+                env.pop("HEAT_TPU_FAULTS", None)
+            path = os.path.join(w["dir"], f"{tag}_epoch{epoch}_rank{rank}.log")
+            log = open(path, "wb")
+            log_paths.append((f"{name} {tag}", epoch, rank, path))
+            open_logs.append(log)
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), str(rank)],
+                env=env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+
+        return spawn
+
+    def write_jobs(name: str, tag: str) -> str:
+        path = os.path.join(fed_dir, f"{name}_jobs_{tag}.json")
+        with open(path, "w") as fh:
+            _json.dump([j.to_submit_record() for j in assignment.get(name, [])], fh)
+        return path
+
+    def run_world(name: str, armed: bool, results: dict):
+        w = worlds[name]
+        sup = sup_mod.Supervisor(
+            make_spawn(name, write_jobs(name, "p1"), armed),
+            fed.worlds[name].n_ranks,
+            heartbeat_dir=w["hb"],
+            heartbeat_timeout=float(os.environ.get("MPDRYRUN_HB_TIMEOUT", "120")),
+            # w1 is the chaos victim: zero restart budget, so its SIGKILLed
+            # generation is the world's LAST — the federation must absorb it
+            restart_budget=0 if armed else 1,
+            generation_deadline=gen_deadline,
+            flightrec_dir=w["fr"],
+            telemetry_dir=os.path.join(w["dir"], "telemetry"),
+        )
+        results[name] = sup.run()
+
+    results: dict = {}
+    threads = [
+        threading.Thread(target=run_world, args=("w0", False, results)),
+        threading.Thread(target=run_world, args=("w1", True, results)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for log in open_logs:
+        try:
+            log.close()
+        except OSError:
+            pass
+
+    res0, res1 = results.get("w0"), results.get("w1")
+    if res0 is None or not res0.ok:
+        print("fed: world w0 (the healthy world) failed its generation")
+        ok = False
+    if res1 is None or res1.ok:
+        print("fed: world w1 was armed to die and didn't")
+        ok = False
+
+    # fold both world journals up into the federation journal, then feed
+    # the victim's postmortem verdicts and its death into the health model
+    for name in ("w0", "w1"):
+        r = fed.reconcile_world_journal(name)
+        print(f"FED-RECONCILED world={name} done={r['done']} failed={r['failed']}",
+              flush=True)
+    if res1 is not None:
+        for pm in res1.postmortems:
+            fed.note_verdict("w1", pm)
+    stolen = fed.world_lost("w1", "supervisor gave up: every rank SIGKILLed")
+    print(f"FED-QUARANTINED world=w1 stolen={stolen}", flush=True)
+    if stolen < 1:
+        print("fed: the killed world held no unfinished jobs to steal — "
+              "the kill landed after its queue drained; nothing was proven")
+        ok = False
+
+    # the quarantined world must NOT gate /healthz (handled degradation),
+    # and the fed gauges must reconcile with the federator's census
+    code, body = _http("GET", f"{url}/healthz")
+    fedrep = body.get("federation", {})
+    print(
+        f"FED-HEALTHZ-DEGRADED http={code} healthy={fedrep.get('healthy')} "
+        f"quarantined={fedrep.get('quarantined')}",
+        flush=True,
+    )
+    ok = ok and code == 200 and fedrep.get("quarantined") == 1
+    code, metrics = _http("GET", f"{url}/metrics")
+    metrics = metrics if isinstance(metrics, str) else ""
+    if "fed_worlds_healthy 1" not in metrics or "fed_worlds_quarantined 1" not in metrics:
+        print(f"fed: /metrics fed_worlds_* gauges missing: {metrics[:400]}")
+        ok = False
+
+    # elastic resize: capacity follows the journal-derived queue depth —
+    # the stolen jobs land on a GROWN w0 (applied between generations,
+    # where the checkpoint world-reshaping path owns state)
+    plan = fed.resize_plan(jobs_per_rank=1, max_ranks=2)
+    new_ranks = plan.get("w0", 1)
+    print(f"FED-RESIZE world=w0 ranks={fed.worlds['w0'].n_ranks}->{new_ranks} "
+          f"queue={len(fed._queue)}", flush=True)
+    fed.worlds["w0"].n_ranks = new_ranks
+    assignment = fed.assign()
+    if assignment.get("w1"):
+        print("fed: assign() handed jobs to the quarantined world")
+        ok = False
+    results2: dict = {}
+    run2 = threading.Thread(
+        target=lambda: results2.update(
+            {"w0": sup_mod.Supervisor(
+                make_spawn("w0", write_jobs("w0", "p2"), False, tag="p2"),
+                new_ranks,
+                heartbeat_dir=worlds["w0"]["hb"],
+                restart_budget=1,
+                generation_deadline=gen_deadline,
+                flightrec_dir=worlds["w0"]["fr"],
+                telemetry_dir=os.path.join(worlds["w0"]["dir"], "telemetry"),
+            ).run()}
+        )
+    )
+    run2.start()
+    run2.join()
+    if not results2.get("w0") or not results2["w0"].ok:
+        print("fed: resized w0 failed to serve the stolen jobs")
+        ok = False
+    r = fed.reconcile_world_journal("w0")
+    print(f"FED-RECONCILED world=w0 done={r['done']} failed={r['failed']}",
+          flush=True)
+
+    # a stolen job's answer must now be servable OVER HTTP from the
+    # journaled DONE record — the crash-surviving result path
+    stolen_ids = sorted(
+        rec["id"] for rec in fed_mod.replay_federation(fed.journal.path)["records"]
+        if rec.get("type") == "requeue"
+    )
+    if stolen_ids:
+        code, body = _http("GET", f"{url}/result/{stolen_ids[0]}")
+        digest = (body.get("result") or {}).get("digest")
+        print(f"FED-RESULT id={stolen_ids[0]} http={code} digest={digest}",
+              flush=True)
+        if code != 200 or digest is None:
+            ok = False
+    code, body = _http("GET", f"{url}/result/never-submitted")
+    if code != 404:
+        print(f"fed: unknown id served {code} {body}")
+        ok = False
+
+    # replay every world's logs (post-hoc diagnosability, same as main())
+    for name, epoch, rank, path in log_paths:
+        try:
+            with open(path, "rb") as fh:
+                text = fh.read().decode(errors="replace")
+        except OSError:
+            text = ""
+        sys.stdout.write(f"---- {name} epoch {epoch} rank {rank} ----\n{text}")
+
+    # the zero-loss attestation, derived from the federation journal alone
+    line = fed.attestation()
+    print(line, flush=True)
+    summary = fed_mod.fed_summary(fed_mod.replay_federation(fed.journal.path))
+    if summary["lost"] != 0:
+        print("fed: accepted job(s) lost across the federation — the "
+              "zero-loss contract is broken")
+        ok = False
+    if summary["worlds"] != 2 or summary["jobs"] != n_jobs + 1 \
+            or summary["shed"] != 1:
+        print(f"fed: attestation accounting off: {summary}")
+        ok = False
+    mon_mod.clear_ingress()
+    mon_mod.clear_federation_source()
+    mon.close()
+    print("MULTIPROCESS DRYRUN:", "PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------- #
 # launcher — a Supervisor owns the world: liveness + heartbeat staleness
 # monitoring, stack-dump teardown, restart budget, resume epochs
 # ---------------------------------------------------------------------- #
@@ -1005,6 +1434,8 @@ def main() -> int:
 
     n_proc = int(os.environ.get("MPDRYRUN_NPROC", N_PROC))
     mode = os.environ.get("MPDRYRUN_MODE", "dryrun")
+    if mode == "fed":
+        return fed_main()  # the federated multi-world scenario (ISSUE 17)
     tmpdir = tempfile.mkdtemp(prefix="mpdryrun_")
     hb_dir = os.path.join(tmpdir, "heartbeats")
     fr_dir = os.path.join(tmpdir, "flightrec")
@@ -1262,6 +1693,7 @@ if __name__ == "__main__":
             "train": train_worker,
             "postmortem": postmortem_worker,
             "serve": serve_worker,
+            "fed": fed_serve_worker,
         }.get(_mode, worker)
         _target(
             int(sys.argv[1]),

@@ -412,6 +412,48 @@ class TestKillAndResume:
             r"STEP-OVERLAP kind=daso\.step steps=\d+ overlap=\d\.\d+", out
         ), out[-3000:]
 
+    def test_world_kill_loses_zero_jobs(self):
+        """Acceptance (ISSUE 17): SIGKILL an ENTIRE world (world 1 of 2)
+        mid-queue → the federation steals its non-terminal jobs, the
+        survivor resizes and serves them, and the journal-derived
+        attestation proves ``FED worlds=2 lost=0``.  The ``mem_infeasible``
+        shed is asserted through the real HTTP ingress (429, structured),
+        not an in-process call."""
+        n_jobs = 12
+        proc = mpd.launch(
+            timeout=700,
+            n_proc=2,
+            devs_per_proc=2,
+            mode="fed",
+            extra_env={"MPDRYRUN_JOBS": n_jobs},
+        )
+        out = proc.stdout
+        assert proc.returncode == 0, (proc.stderr or out)[-3000:]
+        assert mpd.PASS_MARKER in out
+        # ingress: all jobs entered through POST /submit at the edge
+        assert f"submitted={n_jobs}" in out, out[-3000:]
+        # memory-aware admission: the infeasible job shed synchronously
+        # at the HTTP edge with the structured 429
+        assert "FED-SHED id=giant reason=mem_infeasible http=429" in out
+        # the armed world really died and was quarantined; its in-flight
+        # jobs were stolen back into the federation queue
+        assert "FED-QUARANTINED world=w1 stolen=" in out, out[-3000:]
+        m = re.search(r"FED-QUARANTINED world=w1 stolen=(\d+)", out)
+        assert m and int(m.group(1)) >= 1, out[-3000:]
+        # handled degradation: /healthz still 200 with one world down
+        assert re.search(
+            r"FED-HEALTHZ-DEGRADED http=200 healthy=1 quarantined=1", out
+        ), out[-3000:]
+        # elastic resize: the survivor grew to absorb the stolen queue
+        assert re.search(r"FED-RESIZE world=w0 ranks=1->\d+ queue=\d+", out)
+        # a STOLEN job's answer is served end-to-end from the survivor
+        assert re.search(r"FED-RESULT id=\S+ http=200 digest=", out), out[-3000:]
+        # the zero-loss proof, derived from the federation journal alone
+        m = re.search(r"FED worlds=(\d+) lost=(\d+) jobs=(\d+)", out)
+        assert m, out[-3000:]
+        assert m.group(1) == "2" and m.group(2) == "0", m.group(0)
+        assert int(m.group(3)) == n_jobs + 1  # the shed giant is accounted too
+
     def test_supervised_dryrun_restart_budget_give_up(self):
         """A rank that dies on EVERY generation exhausts the restart budget
         and the launcher prints the merged diagnostic report instead of

@@ -1,0 +1,761 @@
+"""Federated multi-world serving (ISSUE 17 tentpole).
+
+Covers the four federation capabilities plus their satellites:
+
+- **Memory-aware admission**: :class:`AdmissionPredictor` unit tests
+  against recorded per-kind peak history (the acceptance criterion), the
+  full admission matrix (no predictor / unobserved kind / no healthy
+  world / uncapped world / infeasible shed), and the shed surfacing as a
+  synchronous structured ``JobRejected``.
+- **Journal-before-mutation**: a failed federation-journal append
+  propagates with NOTHING mutated (the HT112 contract, fault-injected).
+- **Health state machine + work stealing**: verdict-driven transitions
+  (forward-only), world loss requeueing every non-terminal job.
+- **Deterministic recovery** (satellite): two replicas replaying the
+  same federation journal derive identical requeue sets under the
+  epoch-scoped anchor discipline.
+- **Elastic resize**: the pure :func:`resize_target` formula and the
+  Supervisor's relaunch-boundary resize hook.
+- **HTTP ingress** (tentpole edge): POST /submit + GET /status|/result
+  over a real localhost socket — 200/400/404/413/429/503 paths, the
+  /healthz federation gate and the ``fed_worlds_*`` gauges.
+- **Standalone-load contract**: federation.py serves a full federate →
+  steal → recover cycle with jax AND numpy imports blocked.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heat_tpu.parallel import federation as F
+from heat_tpu.parallel import scheduler as S
+from heat_tpu.utils import faults, monitor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    monitor.clear_ingress()
+    monitor.clear_federation_source()
+    F.reset_counters()
+    yield
+    monitor.clear_ingress()
+    monitor.clear_federation_source()
+    monitor.disable()
+    F.reset_counters()
+
+
+def _job(jid="a", kind="matmul", **kw):
+    return F.Job(jid, kind, **kw)
+
+
+def _fed(tmp_path, name="fed.jsonl", **kw):
+    return F.Federation(str(tmp_path / name), **kw)
+
+
+def _req(url, payload=None, timeout=10):
+    """HTTP helper that treats error statuses as answers: returns
+    (status, parsed-JSON body or raw text)."""
+    data = None
+    if payload is not None:
+        data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            status, raw = resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        status, raw = e.code, e.read().decode()
+    try:
+        return status, json.loads(raw)
+    except ValueError:
+        return status, raw
+
+
+# ---------------------------------------------------------------------- #
+# AdmissionPredictor: per-kind peak history → footprint prediction
+# ---------------------------------------------------------------------- #
+class TestAdmissionPredictor:
+    def test_predict_from_recorded_peak_history(self, tmp_path):
+        p = F.AdmissionPredictor(str(tmp_path / "peaks.json"), safety=1.5)
+        p.observe("matmul", 1000)
+        p.observe("matmul", 400)  # smaller: the per-kind MAX is kept
+        p.observe("solve", 200)
+        assert p.predict("matmul") == 1500  # ceil(1000 * 1.5)
+        assert p.predict("solve") == 300
+
+    def test_unobserved_kind_predicts_none(self):
+        assert F.AdmissionPredictor(safety=2.0).predict("kmeans") is None
+
+    def test_history_persists_and_reloads(self, tmp_path):
+        path = str(tmp_path / "peaks.json")
+        F.AdmissionPredictor(path).observe("nn_forward", 4096)
+        reloaded = F.AdmissionPredictor(path, safety=1.0)
+        assert reloaded.predict("nn_forward") == 4096
+
+    def test_torn_history_is_empty_history(self, tmp_path):
+        path = tmp_path / "peaks.json"
+        path.write_text('{"matmul": 10')  # torn mid-write
+        assert F.AdmissionPredictor(str(path)).predict("matmul") is None
+
+    def test_non_numeric_entries_dropped_on_load(self, tmp_path):
+        path = tmp_path / "peaks.json"
+        path.write_text('{"matmul": "big", "solve": 64, "bad": -3}')
+        p = F.AdmissionPredictor(str(path), safety=1.0)
+        assert p.predict("matmul") is None
+        assert p.predict("solve") == 64
+        assert p.predict("bad") is None
+
+    def test_negative_observation_ignored(self, tmp_path):
+        p = F.AdmissionPredictor(str(tmp_path / "peaks.json"))
+        p.observe("matmul", -5)
+        assert p.predict("matmul") is None
+
+
+# ---------------------------------------------------------------------- #
+# memory-aware admission: the shed matrix
+# ---------------------------------------------------------------------- #
+class TestMemAdmission:
+    def _predictor(self, peak=1 << 30, safety=1.0):
+        p = F.AdmissionPredictor(safety=safety)
+        p.observe("matmul", peak)
+        return p
+
+    def test_no_predictor_admits(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0", capacity_bytes=1)
+        assert fed.submit(_job()) == "a"
+
+    def test_unobserved_kind_admits_optimistically(self, tmp_path):
+        fed = _fed(tmp_path, predictor=self._predictor())
+        fed.add_world("w0", capacity_bytes=1)
+        fed.submit(_job(kind="kmeans"))  # no history for kmeans
+
+    def test_no_healthy_world_admits_and_queues(self, tmp_path):
+        # admission must not shed against an EMPTY roster: the queue
+        # holds until worlds join (deadline sheds later, never silently)
+        fed = _fed(tmp_path, predictor=self._predictor())
+        assert fed.submit(_job()) == "a"
+
+    def test_uncapped_world_fits_anything(self, tmp_path):
+        fed = _fed(tmp_path, predictor=self._predictor())
+        fed.add_world("w0")  # no capacity configured → unbounded
+        fed.add_world("w1", capacity_bytes=1)
+        fed.submit(_job())
+
+    def test_infeasible_job_shed_at_the_edge(self, tmp_path):
+        fed = _fed(tmp_path, predictor=self._predictor(peak=1 << 30))
+        fed.add_world("w0", capacity_bytes=1 << 20)
+        with pytest.raises(F.JobRejected) as ei:
+            fed.submit(_job())
+        assert ei.value.reason == F.MEM_INFEASIBLE
+        assert ei.value.job_id == "a" and "headroom" in ei.value.detail
+        # the shed is terminal state, journaled, and ingress-visible
+        assert fed._jobs["a"].state == F.SHED
+        assert fed.ingress_status("a")["reason"] == F.MEM_INFEASIBLE
+        summary = F.fed_summary(F.replay_federation(fed.journal.path))
+        assert summary["shed"] == 1 and summary["lost"] == 0
+
+    def test_quarantined_world_headroom_does_not_admit(self, tmp_path):
+        fed = _fed(tmp_path, predictor=self._predictor(peak=1 << 30))
+        fed.add_world("big", capacity_bytes=1 << 40)
+        fed.add_world("small", capacity_bytes=1 << 20)
+        fed.world_lost("big", "killed")
+        with pytest.raises(F.JobRejected):
+            fed.submit(_job())
+
+    def test_beacon_live_bytes_shrink_headroom(self, tmp_path):
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        (hb / "rank0.json").write_text(json.dumps({"seq": 3, "mem_live": 900}))
+        (hb / "rank1.json").write_text(json.dumps({"seq": 3, "mem_live": 50}))
+        w = F.WorldHandle("w0", capacity_bytes=1000, heartbeat_dir=str(hb))
+        assert w.live_bytes() == 950
+        assert w.headroom_bytes() == 50
+        fed = _fed(tmp_path, predictor=self._predictor(peak=100))
+        fed.worlds["w0"] = w
+        with pytest.raises(F.JobRejected) as ei:
+            fed.submit(_job())
+        assert ei.value.reason == F.MEM_INFEASIBLE
+
+    def test_queue_full_sheds_before_mem_check(self, tmp_path):
+        fed = _fed(tmp_path, max_queue=1)
+        fed.submit(_job("a"))
+        with pytest.raises(F.JobRejected) as ei:
+            fed.submit(_job("b"))
+        assert ei.value.reason == F.QUEUE_FULL
+
+
+# ---------------------------------------------------------------------- #
+# journal-before-mutation (the HT112 contract, fault-injected)
+# ---------------------------------------------------------------------- #
+class TestJournalFirst:
+    def test_failed_append_leaves_submit_unmutated(self, tmp_path):
+        fed = _fed(tmp_path)
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(OSError):
+                fed.submit(_job())
+        # NOTHING mutated: no phantom job the journal never saw
+        assert fed._jobs == {} and fed._queue == []
+        # the retry admits cleanly — no duplicate-id complaint
+        assert fed.submit(_job()) == "a"
+        summary = F.fed_summary(F.replay_federation(fed.journal.path))
+        assert summary["jobs"] == 1
+
+    def test_failed_append_leaves_shed_unmutated(self, tmp_path):
+        p = F.AdmissionPredictor()
+        p.observe("matmul", 1 << 30)
+        fed = _fed(tmp_path, predictor=p)
+        fed.add_world("w0", capacity_bytes=1)
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(OSError):
+                fed.submit(_job())
+        assert fed._jobs == {}  # the shed itself was never recorded → not taken
+
+    def test_failed_append_aborts_world_transition(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(OSError):
+                fed.world_lost("w0", "killed")
+        assert fed.worlds["w0"].state == F.HEALTHY
+
+
+# ---------------------------------------------------------------------- #
+# world health state machine
+# ---------------------------------------------------------------------- #
+class TestWorldStateMachine:
+    def test_one_straggler_verdict_keeps_world_healthy(self, tmp_path):
+        fed = _fed(tmp_path, straggler_drain_after=2)
+        fed.add_world("w0")
+        assert fed.note_verdict("w0", "straggler") == F.HEALTHY
+
+    def test_repeated_straggler_drains(self, tmp_path):
+        fed = _fed(tmp_path, straggler_drain_after=2)
+        fed.add_world("w0")
+        fed.note_verdict("w0", "straggler")
+        assert fed.note_verdict("w0", {"verdict": "straggler"}) == F.DRAINING
+        assert "straggler" in fed.worlds["w0"].state_reason
+
+    def test_interleaved_verdicts_reset_the_streak(self, tmp_path):
+        fed = _fed(tmp_path, straggler_drain_after=2)
+        fed.add_world("w0")
+        fed.note_verdict("w0", "straggler")
+        fed.note_verdict("w0", "inconclusive")
+        assert fed.note_verdict("w0", "straggler") == F.HEALTHY
+
+    def test_oom_quarantines_and_steals(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.submit(_job())
+        fed.assign()
+        assert fed.note_verdict("w0", {"verdict": "oom"}) == F.QUARANTINED
+        assert fed._jobs["a"].state == F.SUBMITTED  # stolen back
+        assert fed.worlds["w0"].assigned == set()
+
+    def test_transitions_only_move_forward(self, tmp_path):
+        fed = _fed(tmp_path, straggler_drain_after=1)
+        fed.add_world("w0")
+        fed.note_verdict("w0", "oom")
+        # a later straggler streak cannot demote quarantined → draining
+        fed.note_verdict("w0", "straggler")
+        assert fed.worlds["w0"].state == F.QUARANTINED
+
+    def test_retire_steals_leftovers(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.submit(_job())
+        fed.assign()
+        fed.retire("w0")
+        assert fed.worlds["w0"].state == F.RETIRED
+        assert fed._jobs["a"].state == F.SUBMITTED
+
+    def test_duplicate_world_rejected(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        with pytest.raises(ValueError, match="duplicate world"):
+            fed.add_world("w0")
+
+
+# ---------------------------------------------------------------------- #
+# work-stealing dispatch + zero-loss world loss
+# ---------------------------------------------------------------------- #
+class TestDispatchAndStealing:
+    def test_least_loaded_world_steals_next_job(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0", n_ranks=1)
+        fed.add_world("w1", n_ranks=1)
+        for i in range(4):
+            fed.submit(_job(f"j{i}"))
+        out = fed.assign()
+        assert sorted(len(v) for v in out.values()) == [2, 2]
+
+    def test_rank_weighted_load(self, tmp_path):
+        # a 3-rank world absorbs 3× the jobs of a 1-rank world
+        fed = _fed(tmp_path)
+        fed.add_world("big", n_ranks=3)
+        fed.add_world("small", n_ranks=1)
+        for i in range(8):
+            fed.submit(_job(f"j{i}"))
+        out = fed.assign()
+        assert len(out["big"]) == 6 and len(out["small"]) == 2
+
+    def test_priority_orders_assignment(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.submit(_job("low", priority=0))
+        fed.submit(_job("high", priority=5))
+        out = fed.assign()
+        assert [j.job_id for j in out["w0"]] == ["high", "low"]
+
+    def test_draining_world_gets_nothing_new(self, tmp_path):
+        fed = _fed(tmp_path, straggler_drain_after=1)
+        fed.add_world("w0")
+        fed.add_world("w1")
+        fed.note_verdict("w1", "straggler")
+        fed.submit(_job())
+        out = fed.assign()
+        assert list(out) == ["w0"]
+
+    def test_no_healthy_world_holds_the_queue(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.world_lost("w0")
+        fed.submit(_job())
+        assert fed.assign() == {}
+        assert len(fed._queue) == 1  # held, not dropped
+
+    def test_world_lost_requeues_then_reassigns(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.add_world("w1")
+        for i in range(4):
+            fed.submit(_job(f"j{i}"))
+        fed.assign()
+        stolen = fed.world_lost("w1", "SIGKILL")
+        assert stolen == 2
+        out = fed.assign()
+        assert list(out) == ["w0"] and len(out["w0"]) == 2
+        summary = F.fed_summary(F.replay_federation(fed.journal.path))
+        assert summary["stolen"] == 2 and summary["lost"] == 4  # none terminal yet
+
+    def test_in_process_submit_hook_receives_jobs(self, tmp_path):
+        got = []
+        fed = _fed(tmp_path)
+        fed.add_world("w0", submit=got.append)
+        fed.submit(_job())
+        fed.assign()
+        assert [j.job_id for j in got] == ["a"]
+
+    def test_in_process_world_does_not_alias_federation_state(self, tmp_path):
+        # an in-process Scheduler mutates the Job it was handed; if that
+        # were the federation's own object, state would flip to DONE with
+        # no federation journal record and replay would count it lost
+        fed = _fed(tmp_path)
+        wj = str(tmp_path / "w0.jsonl")
+        sch = S.Scheduler(
+            lambda jobs: [{"digest": 7.0} for _ in jobs], journal=wj, max_queue=4
+        )
+        fed.add_world("w0", journal_path=wj, submit=sch.submit)
+        fed.submit(_job())
+        fed.assign()
+        sch.run()
+        assert fed._jobs["a"].state == F.ASSIGNED  # not mutated by aliasing
+        assert fed.reconcile_world_journal("w0") == {"done": 1, "failed": 0}
+        assert fed.ingress_result("a")["result"] == {"digest": 7.0}
+        summary = F.fed_summary(F.replay_federation(fed.journal.path))
+        assert summary["done"] == 1 and summary["lost"] == 0
+
+    def test_reconcile_folds_world_journal_up(self, tmp_path):
+        # a world scheduler runs the assigned job; reconciliation folds
+        # its DONE record (with result) into the federation journal
+        fed = _fed(tmp_path)
+        wj = str(tmp_path / "w0.jsonl")
+        fed.add_world("w0", journal_path=wj)
+        fed.submit(_job())
+        fed.assign()
+        sch = S.Scheduler(
+            lambda jobs: [{"digest": 7.0} for _ in jobs], journal=wj, max_queue=4
+        )
+        sch.submit(_job())
+        sch.run()
+        got = fed.reconcile_world_journal("w0")
+        assert got == {"done": 1, "failed": 0}
+        assert fed._jobs["a"].state == F.DONE
+        assert fed.ingress_result("a")["result"] == {"digest": 7.0}
+        summary = F.fed_summary(F.replay_federation(fed.journal.path))
+        assert summary["done"] == 1 and summary["lost"] == 0
+
+
+# ---------------------------------------------------------------------- #
+# deterministic recovery (satellite: two replicas, identical requeues)
+# ---------------------------------------------------------------------- #
+class TestDeterministicRecovery:
+    def _crashed_fed_journal(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.add_world("w1")
+        fed.submit(_job("slow", priority=0, deadline_s=100.0))
+        fed.submit(_job("urgent", priority=9, deadline_s=50.0))
+        fed.submit(_job("mid", priority=3))
+        fed.assign()
+        # one job finished before the crash; the rest are in flight
+        fed.journal.append({"type": F.DONE, "id": "mid", "world": "w0",
+                            "exec_s": 0.1, "result": {"digest": 1.0}})
+        return fed.journal.path
+
+    def test_two_replicas_derive_identical_requeue_sets(self, tmp_path):
+        path = self._crashed_fed_journal(tmp_path)
+        replays = [F.replay_federation(path) for _ in range(2)]
+        sets = [F.requeue_set(r, epoch=1) for r in replays]
+        assert sets[0] == sets[1]
+        assert [v["id"] for v in sets[0]] == ["urgent", "slow"]  # priority desc
+        assert all("deadline_remaining" in v for v in sets[0])
+
+    def test_two_federations_recover_identically(self, tmp_path):
+        path = self._crashed_fed_journal(tmp_path)
+        feds = [
+            F.Federation(str(tmp_path / f"replica{i}.jsonl")) for i in range(2)
+        ]
+        ns = [f.recover(path, epoch=1) for f in feds]
+        assert ns == [2, 2]
+        q0, q1 = ([j.job_id for j in f._queue] for f in feds)
+        assert q0 == q1 == ["urgent", "slow"]
+        d0, d1 = ([j.deadline_s for j in f._queue] for f in feds)
+        assert d0 == d1
+        # the DONE job is visible (result served), never requeued
+        for f in feds:
+            assert f.ingress_result("mid")["result"] == {"digest": 1.0}
+
+    def test_epoch_anchor_scopes_deadline_charging(self, tmp_path):
+        path = self._crashed_fed_journal(tmp_path)
+        replay = F.replay_federation(path)
+        # epoch 0: no records are strictly-before → no anchor → uncharged
+        uncharged = F.requeue_set(replay, epoch=0)
+        assert [v["deadline_remaining"] for v in uncharged] == [50.0, 100.0]
+        charged = F.requeue_set(replay, epoch=1)
+        for v in charged:
+            assert v["deadline_remaining"] <= {"urgent": 50.0, "slow": 100.0}[v["id"]]
+
+    def test_recover_restores_ingress_seq(self, tmp_path):
+        fed = _fed(tmp_path)
+        jid = fed.ingress_submit({"kind": "matmul"})["id"]
+        assert jid == "req000001"
+        fed2 = F.Federation(str(tmp_path / "r2.jsonl"))
+        fed2.recover(fed.journal.path, epoch=1)
+        assert fed2.ingress_submit({"kind": "matmul"})["id"] == "req000002"
+
+
+# ---------------------------------------------------------------------- #
+# replay / summary / attestation
+# ---------------------------------------------------------------------- #
+class TestReplayAndAttestation:
+    def test_headerless_journal_refused(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        path.write_text('{"type": "submitted", "id": "a"}\n')
+        with pytest.raises(S.JournalSchemaError, match="before any"):
+            F.replay_federation(str(path))
+
+    def test_newer_schema_refused(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        path.write_text(json.dumps({"type": "meta", "schema": 99}) + "\n")
+        with pytest.raises(S.JournalSchemaError, match="schema 99"):
+            F.replay_federation(str(path))
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.submit(_job())
+        with open(fed.journal.path, "a") as fh:
+            fh.write('{"type": "done", "id": "a", "wor')  # torn mid-crash
+        replay = F.replay_federation(fed.journal.path)
+        assert replay["torn"] == 1
+        assert replay["jobs"]["a"]["state"] == F.SUBMITTED  # torn DONE never lands
+
+    def test_attestation_line_shape(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.submit(_job())
+        line = fed.attestation()
+        assert line == ("FED worlds=1 lost=1 jobs=1 done=0 failed=0 "
+                        "shed=0 stolen=0 quarantined=0")
+
+    def test_world_roster_derivable_from_journal_alone(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0", n_ranks=2)
+        fed.add_world("w1")
+        fed.world_lost("w1", "killed")
+        replay = F.replay_federation(fed.journal.path)
+        assert set(replay["worlds"]) == {"w0", "w1"}
+        assert replay["worlds"]["w0"]["ranks"] == 2
+        assert replay["worlds"]["w1"]["state"] == F.QUARANTINED
+        summary = F.fed_summary(replay)
+        assert summary["worlds"] == 2 and summary["quarantined"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# elastic capacity: the resize formula + the Supervisor hook
+# ---------------------------------------------------------------------- #
+class TestElasticResize:
+    def test_resize_target_formula(self):
+        assert F.resize_target(0, 4) == 1  # empty queue shrinks to the floor
+        assert F.resize_target(4, 1, jobs_per_rank=4) == 1
+        assert F.resize_target(5, 1, jobs_per_rank=4) == 2
+        assert F.resize_target(10, 1, jobs_per_rank=4, max_ranks=2) == 2
+        assert F.resize_target(3, 1, jobs_per_rank=1, min_ranks=2) == 3
+        assert F.resize_target(-7, 1) == 1  # garbage depth clamps
+
+    def test_resize_plan_splits_queue_across_healthy_worlds(self, tmp_path):
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.add_world("dead")
+        fed.world_lost("dead")
+        for i in range(8):
+            fed.submit(_job(f"j{i}"))
+        plan = fed.resize_plan(jobs_per_rank=2, max_ranks=8)
+        assert plan == {"w0": 4}  # 8 queued / 1 healthy world / 2 per rank
+
+    def test_supervisor_applies_resize_between_generations(self, tmp_path):
+        sup_mod = __import__("heat_tpu.parallel.supervisor",
+                             fromlist=["Supervisor"])
+
+        def spawn(rank, epoch, port):
+            code = "import sys; sys.exit(1)" if epoch == 0 else "pass"
+            return subprocess.Popen([sys.executable, "-c", code])
+
+        sup = sup_mod.Supervisor(
+            spawn, 1, heartbeat_dir=str(tmp_path / "hb"),
+            restart_budget=1, poll_interval=0.05, grace=1.0,
+            resize=lambda cur: cur + 1,
+        )
+        res = sup.run()
+        assert res.ok
+        assert sup.n_ranks == 2
+        assert sup.counters["health.resizes"] == 1
+
+    def test_broken_resize_hook_does_not_kill_supervision(self, tmp_path):
+        sup_mod = __import__("heat_tpu.parallel.supervisor",
+                             fromlist=["Supervisor"])
+
+        def spawn(rank, epoch, port):
+            code = "import sys; sys.exit(1)" if epoch == 0 else "pass"
+            return subprocess.Popen([sys.executable, "-c", code])
+
+        def resize(cur):
+            raise RuntimeError("resize oracle crashed")
+
+        sup = sup_mod.Supervisor(
+            spawn, 1, heartbeat_dir=str(tmp_path / "hb"),
+            restart_budget=1, poll_interval=0.05, grace=1.0, resize=resize,
+        )
+        res = sup.run()
+        assert res.ok and sup.n_ranks == 1
+
+
+# ---------------------------------------------------------------------- #
+# HTTP ingress: the monitor edge over a real localhost socket
+# ---------------------------------------------------------------------- #
+class TestIngressHTTP:
+    def _armed(self, tmp_path, **fed_kw):
+        fed = _fed(tmp_path, **fed_kw)
+        mon = monitor.Monitor(port=0, heartbeat_dir=str(tmp_path / "hb"))
+        monitor.set_ingress(fed)
+        host, port = mon.addr
+        return fed, mon, f"http://{host}:{port}"
+
+    def test_submit_status_result_roundtrip(self, tmp_path):
+        fed, mon, base = self._armed(tmp_path)
+        try:
+            fed.add_world("w0")
+            status, out = _req(f"{base}/submit",
+                               {"kind": "matmul", "tenant": "acme",
+                                "payload": {"n": 8}})
+            assert status == 200
+            jid, tid = out["id"], out["trace_id"]
+            assert out["state"] == F.SUBMITTED and len(tid) == 16
+            status, view = _req(f"{base}/status/{jid}")
+            assert status == 200
+            assert view["state"] == F.SUBMITTED and view["trace_id"] == tid
+            status, res = _req(f"{base}/result/{jid}")
+            assert status == 200 and "detail" in res  # pending, not terminal
+        finally:
+            mon.close()
+
+    def test_mem_infeasible_shed_is_synchronous_429(self, tmp_path):
+        p = F.AdmissionPredictor()
+        p.observe("giant", 1 << 40)
+        fed, mon, base = self._armed(tmp_path, predictor=p)
+        try:
+            fed.add_world("w0", capacity_bytes=1 << 20)
+            status, body = _req(f"{base}/submit",
+                                {"id": "g1", "kind": "giant", "tenant": "acme"})
+            assert status == 429
+            assert body["error"] == F.MEM_INFEASIBLE
+            assert body["id"] == "g1" and body["tenant"] == "acme"
+            assert "headroom" in body["detail"]
+            # the shed is journaled: the attestation counts it, loses nothing
+            assert "shed=1" in fed.attestation()
+        finally:
+            mon.close()
+
+    def test_queue_full_is_429(self, tmp_path):
+        fed, mon, base = self._armed(tmp_path, max_queue=1)
+        try:
+            assert _req(f"{base}/submit", {"kind": "matmul"})[0] == 200
+            status, body = _req(f"{base}/submit", {"kind": "matmul"})
+            assert status == 429 and body["error"] == F.QUEUE_FULL
+        finally:
+            mon.close()
+
+    def test_malformed_bodies_are_400(self, tmp_path):
+        fed, mon, base = self._armed(tmp_path)
+        try:
+            assert _req(f"{base}/submit", b"not json{")[0] == 400
+            status, body = _req(f"{base}/submit", {"tenant": "acme"})
+            assert status == 400 and "kind" in body["detail"]
+            assert _req(f"{base}/submit", {"kind": "matmul",
+                                           "payload": [1, 2]})[0] == 400
+        finally:
+            mon.close()
+
+    def test_oversized_body_413_before_read(self, tmp_path):
+        fed, mon, base = self._armed(tmp_path)
+        try:
+            req = urllib.request.Request(
+                f"{base}/submit", data=b"{}",
+                headers={"Content-Length": str(monitor.MAX_BODY_BYTES + 1)},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    status = resp.status
+            except urllib.error.HTTPError as e:
+                status, body = e.code, json.loads(e.read().decode())
+                assert body["error"] == "payload_too_large"
+            assert status == 413
+        finally:
+            mon.close()
+
+    def test_unknown_job_404_and_unarmed_503(self, tmp_path):
+        fed, mon, base = self._armed(tmp_path)
+        try:
+            status, body = _req(f"{base}/result/nope")
+            assert status == 404 and body["error"] == "unknown_job"
+            monitor.clear_ingress()
+            assert _req(f"{base}/status/x")[0] == 503
+            assert _req(f"{base}/submit", {"kind": "matmul"})[0] == 503
+        finally:
+            mon.close()
+
+    def test_healthz_federation_gate_and_gauges(self, tmp_path):
+        fed, mon, base = self._armed(tmp_path, straggler_drain_after=1)
+        monitor.set_federation_source(fed.health_report)
+        try:
+            fed.add_world("w0")
+            fed.add_world("w1")
+            status, body = _req(f"{base}/healthz")
+            assert status == 200 and body["federation"]["healthy"] == 2
+            # a quarantined world is HANDLED degradation: still 200
+            fed.world_lost("w1", "killed")
+            status, body = _req(f"{base}/healthz")
+            assert status == 200
+            assert body["federation"]["quarantined"] == 1
+            metrics = _req(f"{base}/metrics")[1]
+            assert "fed_worlds_healthy 1" in metrics
+            assert "fed_worlds_quarantined 1" in metrics
+            assert "fed_queue_depth 0" in metrics
+            # a DRAINING world is not ok: every non-quarantined world
+            # must be healthy for the federation gate to pass
+            fed.note_verdict("w0", "straggler")
+            status, body = _req(f"{base}/healthz")
+            assert status == 503 and body["ok"] is False
+        finally:
+            mon.close()
+
+    def test_federation_registers_itself_when_monitor_loaded(self, tmp_path):
+        # Federation.__init__ wires the weakref source without any caller
+        # plumbing — and a discarded federation prunes at the next scrape
+        fed, mon, base = self._armed(tmp_path)
+        try:
+            fed.add_world("w0")
+            status, body = _req(f"{base}/healthz")
+            assert body.get("federation", {}).get("healthy") == 1
+            monitor.clear_ingress()
+            del fed
+            status, body = _req(f"{base}/healthz")
+            assert "federation" not in body
+        finally:
+            mon.close()
+
+
+# ---------------------------------------------------------------------- #
+# standalone-load contract (stdlib-only, jax+numpy blocked)
+# ---------------------------------------------------------------------- #
+class TestStandaloneLoad:
+    def test_federates_with_jax_and_numpy_blocked(self, tmp_path):
+        """federation.py must spec-load and run a submit → assign →
+        world-lost → steal → recover cycle in a process where importing
+        jax or numpy raises — the federating launcher's requirement
+        (same bar as supervisor.py / scheduler.py / monitor.py)."""
+        code = f"""
+import importlib.util, sys
+
+class _Block:
+    def find_module(self, name, path=None):
+        if name in ("jax", "jaxlib", "numpy", "heat_tpu"):
+            raise ImportError(f"import of {{name}} is blocked in this test")
+sys.meta_path.insert(0, _Block())
+
+spec = importlib.util.spec_from_file_location(
+    "heat_federation",
+    {os.path.join(REPO, "heat_tpu", "parallel", "federation.py")!r},
+)
+F = importlib.util.module_from_spec(spec)
+sys.modules[spec.name] = F
+spec.loader.exec_module(F)
+
+fed = F.Federation({str(tmp_path / "fed.jsonl")!r})
+fed.add_world("w0")
+fed.add_world("w1")
+for i in range(4):
+    fed.submit(F.Job(f"j{{i}}", "matmul", tenant="t"))
+fed.assign()
+stolen = fed.world_lost("w1", "SIGKILL")
+assert stolen == 2, stolen
+fed.assign()
+
+fed2 = F.Federation({str(tmp_path / "replica.jsonl")!r})
+n = fed2.recover({str(tmp_path / "fed.jsonl")!r}, epoch=1)
+assert n == 4, n
+
+print(fed.attestation())
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert proc.stdout.strip() == (
+            "FED worlds=2 lost=4 jobs=4 done=0 failed=0 "
+            "shed=0 stolen=2 quarantined=1"
+        )
+
+    def test_package_exports(self):
+        import heat_tpu
+
+        assert heat_tpu.parallel.Federation is F.Federation
+        assert heat_tpu.parallel.WorldHandle is F.WorldHandle
+        assert heat_tpu.parallel.AdmissionPredictor is F.AdmissionPredictor
+
+    def test_counters_mirror_into_profiler(self, tmp_path):
+        from heat_tpu.utils import profiler
+
+        fed = _fed(tmp_path)
+        fed.submit(_job())
+        try:
+            assert profiler.counters().get("fed.accepted") == 1
+        finally:
+            F.reset_counters()

@@ -24,6 +24,7 @@ from heat_tpu.analysis import (
 )
 from heat_tpu.analysis.rules import (
     CollectiveAccountingRule,
+    FederationJournaledMutationRule,
     HostSyncRule,
     MetadataMutationRule,
     NakedBlockingWaitRule,
@@ -819,6 +820,94 @@ class TestHT111:
 
 
 # ---------------------------------------------------------------------- #
+# HT112 — federation mutations outside the journaled append path
+# ---------------------------------------------------------------------- #
+FED_PATH = "heat_tpu/parallel/federation.py"
+
+
+class TestHT112:
+    def test_foreign_scheduler_private_mutation_flagged(self):
+        # reaching into another scheduler's _queue bypasses ITS journal —
+        # flagged even from a function that journals federation-side
+        fs = run_rule(FederationJournaledMutationRule(), """
+            class Federation:
+                def steal(self, sched, job):
+                    self.journal.append({"type": "requeue"})
+                    sched._queue.append(job)
+        """, path=FED_PATH)
+        assert [f.detail for f in fs] == ["_queue.append"]
+        assert fs[0].rule == "HT112"
+
+    def test_unjournaled_self_queue_mutation_flagged(self):
+        fs = run_rule(FederationJournaledMutationRule(), """
+            class Federation:
+                def fast_path(self, job):
+                    self._queue.append(job)
+        """, path=FED_PATH)
+        assert [f.detail for f in fs] == ["self._queue.append"]
+
+    def test_unjournaled_subscript_store_flagged(self):
+        fs = run_rule(FederationJournaledMutationRule(), """
+            class Federation:
+                def stash(self, job):
+                    self._jobs[job.job_id] = job
+        """, path=FED_PATH)
+        assert [f.detail for f in fs] == ["self._jobs ="]
+
+    def test_unjournaled_state_write_flagged(self):
+        fs = run_rule(FederationJournaledMutationRule(), """
+            def mark_failed(job):
+                job.state = "failed"
+        """, path=FED_PATH)
+        assert [f.detail for f in fs] == ["state ="]
+
+    def test_journaled_function_not_flagged(self):
+        # the sanctioned shape: append the record FIRST, then mutate —
+        # submit/_shed/_steal/_transition in federation.py all look like this
+        fs = run_rule(FederationJournaledMutationRule(), """
+            class Federation:
+                def submit(self, job):
+                    self.journal.append({"type": "submitted"})
+                    self._jobs[job.job_id] = job
+                    self._queue.append(job)
+                    job.state = "submitted"
+        """, path=FED_PATH)
+        assert fs == []
+
+    def test_init_constructing_fresh_state_not_flagged(self):
+        fs = run_rule(FederationJournaledMutationRule(), """
+            class Federation:
+                def __init__(self):
+                    self._jobs = {}
+                    self._queue = []
+        """, path=FED_PATH)
+        assert fs == []
+
+    def test_non_federation_module_not_flagged(self):
+        # the rule scopes to federation code; the scheduler mutating its
+        # OWN privates is governed by its journal-first convention, not HT112
+        fs = run_rule(FederationJournaledMutationRule(), """
+            def steal(sched, job):
+                sched._queue.append(job)
+        """, path="heat_tpu/parallel/scheduler.py")
+        assert fs == []
+
+    def test_suppression_works(self):
+        fs = run_rule(FederationJournaledMutationRule(), """
+            class Federation:
+                def steal(self, sched, job):
+                    sched._queue.append(job)  # heatlint: disable=HT112 recovery shim
+        """, path=FED_PATH)
+        assert fs == []
+
+    def test_real_federation_module_clean(self):
+        # the shipped federation layer must satisfy its own contract
+        src = open(os.path.join(REPO, "heat_tpu", "parallel", "federation.py")).read()
+        ctx = LintContext("heat_tpu/parallel/federation.py", src)
+        assert list(FederationJournaledMutationRule().check(ctx)) == []
+
+
+# ---------------------------------------------------------------------- #
 # HT109 — trace identity owned by the tracing choke points
 # ---------------------------------------------------------------------- #
 class TestHT109:
@@ -942,7 +1031,8 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
-            "HT108", "HT109", "HT110", "HT111", "HT201", "HT202", "HT203",
+            "HT108", "HT109", "HT110", "HT111", "HT112", "HT201", "HT202",
+            "HT203",
             "HT204", "HT301", "HT302", "HT303", "HT304",
         ]
 

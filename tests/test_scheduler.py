@@ -718,6 +718,68 @@ class TestDrainAndReport:
                  if json.loads(l).get("type") == S.FAILED]
         assert order == ["hi", "mid", "lo"]
 
+    def test_drain_journal_fault_leaves_no_phantom(self, tmp_path):
+        """Regression (ISSUE 17 satellite): a journal-append failure
+        mid-drain must propagate with the failing job (and everything
+        behind it) still queued and still SUBMITTED — the pre-fix drain
+        iterated a snapshot and cleared the queue afterward, so a fault
+        mid-loop left jobs FAILED in memory that the journal (and every
+        recovery replaying it) never saw: phantom terminal states, and a
+        retried drain double-finished the already-failed prefix."""
+        path = str(tmp_path / "j.jsonl")
+        s = S.Scheduler(_stub_executor(), journal=path)
+        s.submit(S.Job("hi", "matmul", priority=9, tenant="acme"))
+        s.submit(S.Job("mid", "matmul", priority=5, tenant="acme"))
+        s.submit(S.Job("lo", "matmul", priority=0, tenant="globex"))
+        # first append (the "hi" failure record) faults
+        with faults.inject("sched.journal.write", fail=1):
+            with pytest.raises(OSError):
+                s.drain()
+        # NOTHING mutated: all three still queued, SUBMITTED, accounted
+        assert s.pending() == 3
+        for jid in ("hi", "mid", "lo"):
+            assert s.outcome(jid)["state"] == S.SUBMITTED
+        assert s._tenant_inflight == {"acme": 2, "globex": 1}
+        c = s.report()["counters"]
+        assert c.get("sched.failed", 0) == 0
+        # journal agrees: no FAILED record ever landed
+        recs = [json.loads(l) for l in open(path)]
+        assert not any(r.get("type") == S.FAILED for r in recs)
+        # the retry drains cleanly — each job fails exactly once
+        assert s.drain() == 3 and s.pending() == 0
+        recs = [json.loads(l) for l in open(path)]
+        failed = [r["id"] for r in recs if r.get("type") == S.FAILED]
+        assert failed == ["hi", "mid", "lo"]  # priority order, no duplicates
+        summ = S.jobs_summary(S.replay_journal(path))
+        assert summ["failed"] == 3 and summ["lost"] == 0
+
+    def test_drain_journal_fault_midway_keeps_remainder_queued(self, tmp_path):
+        """The partial-progress shape: with the fault armed for the SECOND
+        append, the first victim is terminally failed (journal + memory
+        agree) and the rest stay queued for the retry."""
+        path = str(tmp_path / "j.jsonl")
+        s = S.Scheduler(_stub_executor(), journal=path)
+        s.submit(S.Job("hi", "matmul", priority=9))
+        s.submit(S.Job("lo", "matmul", priority=0))
+        # fail the SECOND append of the drain (the "lo" failure record)
+        orig, calls = s.journal.append, iter([False, True])
+        s.journal.append = lambda rec: (
+            (_ for _ in ()).throw(OSError("disk full")) if next(calls)
+            else orig(rec)
+        )
+        try:
+            with pytest.raises(OSError):
+                s.drain()
+        finally:
+            s.journal.append = orig
+        assert s.outcome("hi")["state"] == S.FAILED
+        assert s.outcome("lo")["state"] == S.SUBMITTED
+        assert s.pending() == 1
+        assert s.drain() == 1
+        failed = [json.loads(l)["id"] for l in open(path)
+                  if json.loads(l).get("type") == S.FAILED]
+        assert failed == ["hi", "lo"]
+
     def test_counters_reconcile_accepted_done_failed_shed(self):
         """Acceptance: sched.* counters reconcile — offered = accepted +
         shed, accepted = done + failed once the queue is empty."""
