@@ -35,6 +35,10 @@ Two halves, one contract set:
   ``HEAT_TPU_CHECKS=1``): a metadata-only validator at the dispatch tails
   and factory/resplit boundaries — the dynamic complement for what the
   static rules cannot see.
+- **timeline** (:mod:`.timeline`): the post-hoc cross-rank timeline
+  assembler — telemetry JSONL + flight rings + journals merged into one
+  clock-aligned Chrome-trace/Perfetto export with critical-path blame
+  (CLI: ``scripts/traceviz.py``).
 
 See doc/source/design.md "Static contracts".
 """
@@ -61,6 +65,7 @@ from . import absint  # noqa: F401
 from . import rules  # noqa: F401  — registers the built-in rules on import
 from . import fixes  # noqa: F401  — registers the built-in fixers on import
 from . import splitmig  # noqa: F401
+from . import timeline  # noqa: F401
 
 __all__ = [
     "Finding",
@@ -82,5 +87,6 @@ __all__ = [
     "split_by_baseline",
     "splitmig",
     "summaries",
+    "timeline",
     "write_baseline",
 ]

@@ -81,6 +81,8 @@ __all__ = [
     "sync",
     "read_ring",
     "find_ring_files",
+    "counters",
+    "slots_skipped_total",
     "RING_MAGIC",
     "RING_VERSION",
     "DEFAULT_SLOTS",
@@ -519,12 +521,36 @@ def sync() -> None:
 # reader — used by scripts/postmortem.py and scripts/telemetry_report.py
 # (loaded standalone); tolerant of torn slots and foreign garbage
 # ---------------------------------------------------------------------- #
+# torn/unparseable slots seen inside written ring regions by THIS
+# process's read_ring calls — the reader-side honesty counter (the writer
+# path stays untouched: zero new hot-path cost).  Rides /metrics via
+# monitor._runtime_counters when nonzero.
+_SLOTS_SKIPPED = 0
+
+
+def slots_skipped_total() -> int:
+    """Torn/unparseable written slots skipped by reads in this process."""
+    return _SLOTS_SKIPPED
+
+
+def counters() -> Dict[str, int]:
+    """Monitor-facing counters (empty while nothing was skipped, keeping
+    /metrics noise-free — like ``telemetry.ring.dropped``)."""
+    if _SLOTS_SKIPPED:
+        return {"flightrec.slots.skipped": _SLOTS_SKIPPED}
+    return {}
+
+
 def read_ring(path: str) -> Dict[str, Any]:
     """Parse one ring file: header fields + records sorted by event index.
 
     Unparseable slots (torn writes, zeroed tails) are skipped — the black
     box must be readable after ANY crash, so a bad slot costs one record,
-    never the file."""
+    never the file.  Skips inside the *written* region are COUNTED
+    (``slots_skipped`` in the result, accumulated into
+    ``flightrec.slots.skipped``): a lossy ring must never read as a
+    complete one.  Slots the writer never reached (``ev_count`` short of a
+    full ring) are simply empty, not torn, and are not counted."""
     with open(path, "rb") as fh:
         head = fh.read(_HEADER_SIZE)
         if len(head) < _HEADER_SIZE:
@@ -535,19 +561,34 @@ def read_ring(path: str) -> Dict[str, Any]:
         if magic != RING_MAGIC:
             raise ValueError(f"{path}: not a flight-recorder ring (magic {magic!r})")
         records: List[dict] = []
+        skipped = 0
+        # slots the writer reached: the whole ring once it has wrapped,
+        # else the first ev_count.  (A torn ev_count merely shifts this
+        # boundary by the one in-flight record; it cannot hide a torn slot
+        # deep inside the written region.)
+        written = n_slots if ev_count >= n_slots else ev_count
         for i in range(n_slots):
             slot = fh.read(slot_size)
             if len(slot) < _LEN_SIZE:
                 break
             (n,) = struct.unpack_from(_LEN_FMT, slot)
             if n == 0 or n > slot_size - _LEN_SIZE:
+                if i < written:
+                    skipped += 1
                 continue
             try:
                 rec = json.loads(slot[_LEN_SIZE : _LEN_SIZE + n])
             except ValueError:
+                if i < written:
+                    skipped += 1
                 continue
             if isinstance(rec, dict) and "e" in rec:
                 records.append(rec)
+            elif i < written:
+                skipped += 1
+    if skipped:
+        global _SLOTS_SKIPPED
+        _SLOTS_SKIPPED += skipped
     records.sort(key=lambda r: r.get("e", 0))
     return {
         "path": path,
@@ -558,6 +599,7 @@ def read_ring(path: str) -> Dict[str, Any]:
         "ev_count": ev_count,
         "n_slots": n_slots,
         "slot_size": slot_size,
+        "slots_skipped": skipped,
         "records": records,
     }
 

@@ -86,6 +86,7 @@ __all__ = [
     "clear_federation_source",
     "metrics_text",
     "healthz",
+    "timeline_json",
     "Monitor",
     "MAX_BODY_BYTES",
 ]
@@ -204,6 +205,7 @@ def _runtime_counters() -> Dict[str, float]:
         ("heat_tpu.parallel.scheduler", "counters"),
         ("heat_tpu.utils.faults", "counters"),
         ("heat_tpu.utils.memledger", "counters"),  # mem_live/peak gauges
+        ("heat_tpu.utils.flightrec", "counters"),  # torn slots seen by reads
         ("heat_tpu.utils.profiler", "counters"),  # last: the merged superset
     ):
         mod = sys.modules.get(modname)
@@ -419,6 +421,64 @@ def healthz(
     return ok, body
 
 
+def timeline_json(trace_id: str) -> dict:
+    """``GET /timeline/<trace_id>``: ONE trace's causal timeline assembled
+    from the LIVE registries — the telemetry span ring and the armed
+    flight recorder's ring file — via ``sys.modules`` only, so the route
+    works on a standalone-loaded monitor (a supervisor that never
+    imported jax simply serves whatever registries exist: none → an empty
+    event list → 404 at the route).  The post-hoc twin of this view is
+    ``telemetry_report.py --trace`` over the exported artifacts; this one
+    answers while the process is still alive.  Pure snapshot — callable
+    without a server."""
+    trace_id = str(trace_id)
+    events: List[dict] = []
+    sources = {"spans": 0, "flightrec": 0}
+    tel = sys.modules.get("heat_tpu.utils.telemetry")
+    if tel is not None:
+        try:
+            ring = list(tel._ring)
+        except Exception:
+            ring = []
+        for rec in ring:
+            try:
+                name, ts, dur_s, self_s, depth, attrs = rec
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(attrs, dict) or attrs.get("trace_id") != trace_id:
+                continue
+            events.append({
+                "source": "span", "t": ts, "dur_s": dur_s, "name": name,
+                "depth": depth,
+                "span_id": attrs.get("span_id"),
+                "parent_id": attrs.get("parent_id"),
+            })
+            sources["spans"] += 1
+    fr = sys.modules.get("heat_tpu.utils.flightrec")
+    if fr is not None:
+        try:
+            rec_obj = fr.recorder()
+            if rec_obj is not None:
+                fr.sync()  # pending dispatch window + msync before the read
+                ring = fr.read_ring(rec_obj.path)
+            else:
+                ring = None
+        except Exception:
+            ring = None
+        if ring is not None:
+            for rec in ring.get("records", []):
+                if rec.get("tid") != trace_id:
+                    continue
+                events.append({
+                    "source": "flightrec", "t": rec.get("t"),
+                    "kind": rec.get("k"), "name": rec.get("op"),
+                    "seq": rec.get("seq"), "wire": rec.get("wire"),
+                })
+                sources["flightrec"] += 1
+    events.sort(key=lambda e: e.get("t") or 0.0)
+    return {"trace_id": trace_id, "events": events, "sources": sources}
+
+
 # ---------------------------------------------------------------------- #
 # the server
 # ---------------------------------------------------------------------- #
@@ -471,6 +531,15 @@ class Monitor:
                         self._send_json(200 if ok else 503, body)
                     elif path.startswith(("/status/", "/result/")):
                         self._ingress_get(path)
+                    elif path.startswith("/timeline/"):
+                        tid = path[len("/timeline/"):]
+                        body = timeline_json(tid)
+                        if body["events"]:
+                            self._send_json(200, body)
+                        else:
+                            self._send_json(
+                                404, {"error": "unknown_trace", "trace_id": tid}
+                            )
                     else:
                         self._send(404, b"try /metrics or /healthz\n",
                                    "text/plain")

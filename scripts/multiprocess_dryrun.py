@@ -1682,6 +1682,43 @@ def main() -> int:
 
         print("SUPERVISOR GAVE UP; diagnostic report:", flush=True)
         print(_json.dumps(res.report(), indent=2), flush=True)
+    # cross-rank timeline + critical-path attribution (ISSUE 18): align
+    # every rank's clock on the shared collective-stamp anchors, name the
+    # gating rank/op/seq, and export the Chrome trace artifact — on
+    # FAILED runs too: assemble() folds in the supervisor's harvested
+    # epoch<N>/ ring dirs, so the chaos lane's verdict ("rank 1 hung at
+    # seq N") is corroborated by a CRITICAL-PATH line naming the same
+    # rank, seq and op from the timeline side
+    try:
+        import json as _json
+
+        tl = _load_standalone("heat_timeline", "heat_tpu/analysis/timeline.py")
+        bundle = tl.assemble([tdir, fr_dir])
+        clock = tl.clock_report(bundle)
+        if clock:
+            print(clock, flush=True)
+        cp_report = tl.critical_path_report(bundle)
+        if cp_report:
+            print(cp_report, flush=True)
+        trace = tl.to_chrome_trace(bundle)
+        problems = tl.validate_chrome_trace(trace)
+        trace_out = os.environ.get("MPDRYRUN_TRACE_OUT") or os.path.join(
+            tmpdir, "trace.json"
+        )
+        with open(trace_out, "w") as fh:
+            _json.dump(trace, fh)
+        print(
+            f"TRACE-EXPORT events={len(trace['traceEvents'])} "
+            f"ranks={len(bundle['ranks'])} out={trace_out}",
+            flush=True,
+        )
+        if problems:
+            for p in problems:
+                print(f"launcher: trace INVALID: {p}")
+            ok = False
+    except Exception as e:
+        print(f"launcher: timeline export failed: {e!r}")
+        ok = False
     print("MULTIPROCESS DRYRUN:", "PASS" if ok else "FAIL", flush=True)
     return 0 if ok else 1
 

@@ -227,6 +227,17 @@ def analyze(
             f" for rank(s) {missing}" if missing else ""
         )
         return verdict
+    # torn/unparseable slots the reader skipped (flightrec.read_ring
+    # counts them per ring): on every verdict path, so a lossy ring can
+    # never pass for a complete stream — a "clean" verdict over a ring
+    # with holes is not clean evidence
+    skipped = {
+        str(r): rings[r].get("slots_skipped", 0)
+        for r in sorted(rings)
+        if rings[r].get("slots_skipped")
+    }
+    if skipped:
+        verdict["slots_skipped"] = skipped
     colls = {r: _coll_by_seq(ring) for r, ring in rings.items()}
     with_colls = [r for r in sorted(colls) if colls[r]]
     coll_less = [r for r in sorted(colls) if not colls[r]]
@@ -518,6 +529,15 @@ def render(verdict: dict, rings: Optional[Dict[int, dict]] = None) -> str:
     # verdict dicts key ranks by str() (JSON round-trip safety): sort the
     # report numerically or rank 10 renders before rank 2 at pod scale
     by_rank = lambda kv: int(kv[0])  # noqa: E731
+    if verdict.get("slots_skipped"):
+        out.append(
+            "torn/unparseable ring slot(s) skipped — the stream(s) below "
+            "have holes: "
+            + ", ".join(
+                f"rank {r}: {n}"
+                for r, n in sorted(verdict["slots_skipped"].items(), key=by_rank)
+            )
+        )
     if verdict.get("last_seq"):
         out.append(
             "last staged seq per rank: "

@@ -53,6 +53,7 @@ from __future__ import annotations
 import argparse
 import glob
 import json
+import math
 import os
 import sys
 from typing import Dict, List, Optional, Tuple
@@ -254,6 +255,41 @@ def overlap_delta(rows: List[dict]) -> Dict[str, Dict[str, float]]:
     return out
 
 
+def _pctl(values: List[float], q: float) -> float:
+    """Exact upper percentile of a small sample (step counts are
+    human-scale; same rule as telemetry_report's SLO table)."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(math.ceil(q * len(vs))) - 1))
+    return vs[idx]
+
+
+def distribution(rows: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Per-step-kind p50/p99 of the window, exposed comm-wait and overlap
+    fraction ACROSS step cycles — the aggregate STEP-OVERLAP line hides a
+    straggling cycle inside the mean; the tail percentiles don't."""
+    by: Dict[str, List[dict]] = {}
+    for r in rows:
+        by.setdefault(r["step"], []).append(r)
+    out: Dict[str, Dict[str, float]] = {}
+    for kind in sorted(by):
+        rs = by[kind]
+        totals = [r["total_s"] for r in rs]
+        waits = [r["comm_wait_s"] for r in rs]
+        overlaps = [r["overlap_fraction"] for r in rs]
+        out[kind] = {
+            "n": len(rs),
+            "total_s_p50": _pctl(totals, 0.5),
+            "total_s_p99": _pctl(totals, 0.99),
+            "comm_wait_s_p50": _pctl(waits, 0.5),
+            "comm_wait_s_p99": _pctl(waits, 0.99),
+            "overlap_p50": _pctl(overlaps, 0.5),
+            "overlap_p99": _pctl(overlaps, 0.99),
+        }
+    return out
+
+
 def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
     widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
@@ -287,6 +323,18 @@ def render(rows: List[dict], per_step: int = 0) -> str:
             f"overlap={a['overlap_fraction']:.3f} "
             f"comm_wait_ms={a['comm_wait_s'] * 1e3:.1f} "
             f"total_ms={a['total_s'] * 1e3:.1f}"
+        )
+    # per-cycle tail distribution beside each aggregate line (the
+    # STEP-OVERLAP format above is pinned by test and stays untouched)
+    for kind, d in distribution(rows).items():
+        out.append(
+            f"STEP-DIST kind={kind} n={d['n']} "
+            f"total_ms_p50={d['total_s_p50'] * 1e3:.1f} "
+            f"total_ms_p99={d['total_s_p99'] * 1e3:.1f} "
+            f"comm_wait_ms_p50={d['comm_wait_s_p50'] * 1e3:.1f} "
+            f"comm_wait_ms_p99={d['comm_wait_s_p99'] * 1e3:.1f} "
+            f"overlap_p50={d['overlap_p50']:.3f} "
+            f"overlap_p99={d['overlap_p99']:.3f}"
         )
     # monolithic-vs-bucketed delta, when both labeled runs share this merge
     # dir (the CI-greppable improvement line; the STEP-OVERLAP format above
@@ -354,7 +402,8 @@ def main(argv=None) -> int:
     print(render(rows, per_step=args.per_step))
     if args.json:
         with open(args.json, "w") as fh:
-            json.dump({"steps": rows, "aggregate": aggregate(rows)}, fh, indent=1)
+            json.dump({"steps": rows, "aggregate": aggregate(rows),
+                       "distribution": distribution(rows)}, fh, indent=1)
         print(f"\nper-step JSON written to {args.json}")
     return 0
 

@@ -433,6 +433,69 @@ def overlap_section(spans: List[dict]) -> str:
     return sp.overlap_section(spans)
 
 
+_tl = None
+
+
+def _timeline_mod():
+    """``heat_tpu/analysis/timeline.py`` loaded standalone (stdlib-only)
+    — the ONE implementation of clock alignment, Chrome-trace export and
+    critical-path blame.  None when missing (a stripped install)."""
+    mod = sys.modules.get("heat_tpu.analysis.timeline")
+    if mod is not None:
+        return mod
+    global _tl
+    if _tl is None:
+        import importlib.util
+
+        path = os.path.normpath(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir, "heat_tpu", "analysis", "timeline.py",
+        ))
+        if not os.path.exists(path):
+            return None
+        spec = importlib.util.spec_from_file_location("telemetry_report_timeline", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _tl = mod
+    return _tl
+
+
+def critical_path_section(targets: List[str], trace_out: Optional[str] = None) -> str:
+    """CLOCK-ALIGN + CRITICAL-PATH attribution over the target dirs'
+    merged artifacts (``heat_tpu/analysis/timeline.py``); '' when nothing
+    is attributable.  With ``trace_out``, additionally writes the
+    schema-checked Chrome trace-event JSON there."""
+    tl = _timeline_mod()
+    if tl is None:
+        return ""
+    dirs = [t for t in targets if os.path.isdir(t)]
+    if not dirs:
+        return ""
+    bundle = tl.assemble(dirs)
+    if not bundle["ranks"]:
+        return ""
+    out = []
+    clock = tl.clock_report(bundle)
+    if clock:
+        out.append(clock)
+    report = tl.critical_path_report(bundle)
+    if report:
+        out.append(report)
+    if trace_out:
+        trace = tl.to_chrome_trace(bundle)
+        problems = tl.validate_chrome_trace(trace)
+        with open(trace_out, "w") as fh:
+            json.dump(trace, fh)
+        out.append(
+            f"TRACE-EXPORT events={len(trace['traceEvents'])} "
+            f"ranks={len(bundle['ranks'])} out={trace_out}"
+        )
+        for p in problems:
+            out.append(f"INVALID: {p}")
+    return "\n".join(out)
+
+
 def trace_section(targets: List[str], trace_id: str,
                   spans: Optional[List[dict]] = None) -> str:
     """The assembled causal timeline of ONE trace id across every artifact
@@ -702,6 +765,10 @@ def main(argv=None) -> int:
                     help="render the assembled causal timeline of ONE trace "
                          "id across spans, scheduler journals and flight "
                          "rings, instead of the full report")
+    ap.add_argument("--trace-out", default=None, metavar="TRACE_JSON",
+                    help="also export the cross-rank Chrome trace-event "
+                         "JSON (clock-aligned; scripts/traceviz.py is the "
+                         "standalone form)")
     args = ap.parse_args(argv)
 
     paths = []
@@ -741,6 +808,13 @@ def main(argv=None) -> int:
                 print(mem)
             if slo:
                 print(slo)
+            # rings alone still align and attribute (the harvested
+            # epoch-dir case: collective stamps are the anchors)
+            cp = critical_path_section(
+                list(args.targets), trace_out=args.trace_out
+            )
+            if cp:
+                print(cp)
             return 0
         print(
             f"no rank*.jsonl files (nor flight_rank*.ring / "
@@ -758,6 +832,12 @@ def main(argv=None) -> int:
     overlap = overlap_section(merged["timeline"])
     if overlap:
         print(overlap)
+    # cross-rank clock alignment + critical-path blame (and optionally
+    # the Chrome trace artifact) — which rank/op/seq gated each step,
+    # not just how much time each class took
+    cp = critical_path_section(list(args.targets), trace_out=args.trace_out)
+    if cp:
+        print(cp)
     if args.json:
         # the timeline can be huge; the JSON artifact keeps it whole (the
         # text rendering is the bounded view)

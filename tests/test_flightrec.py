@@ -137,8 +137,27 @@ class TestRing:
             off = flightrec._HEADER_SIZE + 1 * r.slot_size + flightrec._LEN_SIZE
             fh.seek(off)
             fh.write(b"\xff" * 16)
+        before = flightrec.slots_skipped_total()
         ring = flightrec.read_ring(p)
         assert [rec["seq"] for rec in ring["records"]] == [1, 3]
+        # the hole is COUNTED, not just skipped: per-read in the ring
+        # dict, cumulatively in the process counter, and surfaced as a
+        # monitor gauge via counters()
+        assert ring["slots_skipped"] == 1
+        assert flightrec.slots_skipped_total() == before + 1
+        assert flightrec.counters()["flightrec.slots.skipped"] >= 1
+
+    def test_partial_ring_unwritten_slots_not_counted(self, tmp_path):
+        # a fresh ring with 3 of 8 slots written: the 5 empty slots are
+        # unwritten, not torn — they must not inflate the skip counter
+        p = str(tmp_path / "flight_rank0.ring")
+        r = flightrec.FlightRecorder(p, slots=8, rank=0)
+        for i in range(3):
+            r.record("coll", seq=i + 1, op="Allreduce", wire=4)
+        r.close()
+        ring = flightrec.read_ring(p)
+        assert ring["slots_skipped"] == 0
+        assert len(ring["records"]) == 3
 
     def test_garbage_file_raises(self, tmp_path):
         p = str(tmp_path / "flight_rank0.ring")
@@ -512,6 +531,25 @@ class TestAnalyzer:
         _mkring(d, 1, [full, bad])
         v = pm.analyze_dir(d)
         assert v["verdict"] == "desync" and v["first_divergent_seq"] == 2
+
+    def test_torn_slots_surface_in_verdict_and_render(self, tmp_path):
+        # a lossy ring must never pass for a complete stream: the skip
+        # count rides every verdict (and therefore the --json output)
+        d = str(tmp_path)
+        p0 = _mkring(d, 0, [("Allreduce", 100)] * 3, shutdown=True)
+        _mkring(d, 1, [("Allreduce", 100)] * 3, shutdown=True)
+        with open(p0, "r+b") as fh:
+            off = (flightrec._HEADER_SIZE + 1 * flightrec.DEFAULT_SLOT_SIZE
+                   + flightrec._LEN_SIZE)
+            fh.seek(off)
+            fh.write(b"\xff" * 16)
+        v = pm.analyze_dir(d)
+        assert v["slots_skipped"] == {"0": 1}
+        assert "torn/unparseable" in pm.render(v)
+        clean = pm.analyze(
+            {1: {"rank": 1, "records": [], "slots_skipped": 0}}
+        )
+        assert "slots_skipped" not in clean  # intact rings stay silent
 
     def test_render_orders_ranks_numerically(self, tmp_path):
         # last_seq/heartbeats are str-keyed (JSON round-trip): the report

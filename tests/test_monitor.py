@@ -192,6 +192,59 @@ class TestHTTPServer:
         assert status == 200
 
 
+class TestTimelineRoute:
+    """ISSUE 18 satellite: ``GET /timeline/<trace_id>`` serves one
+    trace's assembled timeline from the LIVE registries (span ring +
+    armed flight recorder), localhost-bind posture unchanged."""
+
+    def test_live_trace_assembled_from_both_registries(self, tmp_path):
+        from heat_tpu.utils import flightrec
+        telemetry.enable(directory=str(tmp_path))
+        flightrec.enable(str(tmp_path), rank=0)
+        try:
+            with telemetry.tracing(name="probe") as tid:
+                with telemetry.span("sched.job", xprof=False):
+                    pass
+                flightrec.record_collective("Allreduce", 1024)
+            host, port = monitor.enable()
+            status, body = _get(f"http://{host}:{port}/timeline/{tid}")
+            payload = json.loads(body)
+            assert status == 200 and payload["trace_id"] == tid
+            assert payload["sources"]["spans"] >= 1
+            assert payload["sources"]["flightrec"] >= 1
+            names = [e.get("name") for e in payload["events"]]
+            assert "sched.job" in names
+            ts = [e["t"] for e in payload["events"]]
+            assert ts == sorted(ts)  # time-ordered
+        finally:
+            flightrec.disable()
+            telemetry.disable()
+
+    def test_unknown_trace_404(self):
+        host, port = monitor.enable()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://{host}:{port}/timeline/deadbeef00000000")
+        assert ei.value.code == 404
+        assert json.loads(ei.value.read().decode())["error"] == "unknown_trace"
+
+    def test_torn_slot_counter_rides_metrics(self, tmp_path):
+        from heat_tpu.utils import flightrec
+        p = os.path.join(str(tmp_path), "flight_rank0.ring")
+        r = flightrec.FlightRecorder(p, slots=8, rank=0)
+        for i in range(3):
+            r.record("coll", seq=i + 1, op="Allreduce", wire=4)
+        r.close()
+        with open(p, "r+b") as fh:
+            fh.seek(flightrec._HEADER_SIZE + flightrec.DEFAULT_SLOT_SIZE
+                    + flightrec._LEN_SIZE)
+            fh.write(b"\xff" * 16)
+        flightrec.read_ring(p)
+        text = monitor.metrics_text()
+        line = next(l for l in text.splitlines()
+                    if l.startswith("flightrec_slots_skipped"))
+        assert int(line.split()[-1]) >= 1
+
+
 class TestStandaloneLoad:
     def test_loads_and_serves_with_jax_import_blocked(self, tmp_path):
         """The supervisor-hosted contract: monitor.py must load via

@@ -89,6 +89,16 @@ def test_n_process_spmd_tier(n_proc, devs):
     # ...and the green run's rings read CLEAN end to end (ISSUE 7: every
     # rank's stream identical AND terminated by a shutdown record)
     assert "POSTMORTEM verdict=clean" in out, out[-2000:]
+    # ...and the cross-rank timeline exporter (ISSUE 18) aligned every
+    # rank's clock from the shared collective stamps, named the gating
+    # rank of the collective stream, and wrote a schema-valid Chrome
+    # trace artifact (validated in-process before the PASS verdict)
+    assert re.search(r"CLOCK-ALIGN rank=\d+ offset_ms=", out), out[-3000:]
+    assert re.search(
+        r"CRITICAL-PATH kind=collective rank=\d+ op=\S+ seq=\d+ share=", out
+    ), out[-3000:]
+    assert re.search(r"TRACE-EXPORT events=\d+ ranks=\d+ out=", out), out[-3000:]
+    assert "trace INVALID" not in out, out[-3000:]
 
 
 @pytest.mark.heavy
@@ -130,6 +140,14 @@ def test_postmortem_names_hung_rank_and_seq():
     # the heartbeat beacons carried the flight recorder's seq, so the
     # supervisor's staleness line shows SEMANTIC progress, not just mtime
     assert re.search(r"heartbeat stale .*stuck at seq \d+ resplit", out), out[-3000:]
+    # critical-path attribution (ISSUE 18) agrees with the post-mortem:
+    # the injected hang rank is the NAMED gating rank, blamed at its last
+    # stamped (seq, op) — the very collective it wedged on
+    assert (
+        f"CRITICAL-PATH kind=collective rank=1 op=resplit seq={expect_seq}"
+        in out
+    ), out[-3000:]
+    assert re.search(r"TRACE-EXPORT events=\d+ ranks=\d+ out=", out), out[-3000:]
 
 
 @pytest.mark.heavy
@@ -208,6 +226,12 @@ def test_serve_mode_green_all_jobs_accounted():
     # step-time breakdown over the sched.job spans reports an overlap number
     assert re.search(r"STEP-OVERLAP kind=sched\.job steps=\d+", out), out[-3000:]
     assert "POSTMORTEM verdict=clean" in out, out[-3000:]
+    # ISSUE 18: the timeline exporter attributes the serving lane's
+    # critical path per step kind (sched.job windows) and per-step
+    # latency distribution rides beside the pinned aggregate
+    assert re.search(r"CRITICAL-PATH kind=sched\.job rank=\d+", out), out[-3000:]
+    assert re.search(r"STEP-DIST kind=sched\.job n=\d+", out), out[-3000:]
+    assert re.search(r"TRACE-EXPORT events=\d+ ranks=\d+ out=", out), out[-3000:]
 
 
 @pytest.mark.heavy

@@ -110,6 +110,48 @@ class TestBreakdown:
         assert sp.step_breakdown([], ()) == []
 
 
+class TestStepDistribution:
+    """ISSUE 18 satellite: per-step p50/p99 distribution lines beside the
+    aggregate — whose STEP-OVERLAP format stays pinned unchanged."""
+
+    def test_percentiles_exact_upper_rule(self):
+        rows = sp.step_breakdown(TestBreakdown.SPANS, ("daso.step",))
+        d = sp.distribution(rows)["daso.step"]
+        # totals [0.05, 0.20]: p50 = lower, p99 = upper (exact rule,
+        # same as telemetry_report's histogram quantiles)
+        assert d["n"] == 2
+        assert abs(d["total_s_p50"] - 0.05) < 1e-9
+        assert abs(d["total_s_p99"] - 0.20) < 1e-9
+        assert abs(d["comm_wait_s_p99"] - 0.06) < 1e-9
+        assert d["overlap_p50"] == 0.7 and d["overlap_p99"] == 1.0
+
+    def test_dist_line_beside_pinned_aggregate(self):
+        rows = sp.step_breakdown(TestBreakdown.SPANS, ("daso.step",))
+        text = sp.render(rows)
+        # the pre-existing marker is untouched...
+        assert "STEP-OVERLAP kind=daso.step steps=2 overlap=" in text
+        # ...and the distribution rides beside it
+        assert (
+            "STEP-DIST kind=daso.step n=2 total_ms_p50=50.0 "
+            "total_ms_p99=200.0 comm_wait_ms_p50=0.0 comm_wait_ms_p99=60.0 "
+            "overlap_p50=0.700 overlap_p99=1.000" in text
+        )
+
+    def test_dist_rides_cli_json(self, tmp_path, capsys):
+        d = str(tmp_path)
+        with open(os.path.join(d, "rank0.jsonl"), "w") as fh:
+            for rec in TestBreakdown.SPANS:
+                fh.write(json.dumps(rec) + "\n")
+        out_json = str(tmp_path / "steps.json")
+        assert sp.main([d, "--json", out_json]) == 0
+        assert "STEP-DIST kind=daso.step" in capsys.readouterr().out
+        payload = json.load(open(out_json))
+        assert payload["distribution"]["daso.step"]["n"] == 2
+
+    def test_no_rows_no_dist(self):
+        assert sp.distribution([]) == {}
+
+
 class TestOverlapDelta:
     """ISSUE 16: a merge dir holding BOTH sync labels yields the
     STEP-OVERLAP-DELTA comparison line; the existing STEP-OVERLAP format

@@ -1,0 +1,357 @@
+"""Cross-rank timeline export + critical-path attribution (ISSUE 18).
+
+``heat_tpu/analysis/timeline.py`` merges per-rank telemetry JSONL,
+flight-recorder rings and scheduler journals into one Chrome-trace /
+Perfetto timeline; ``scripts/traceviz.py`` is the stdlib-only CLI.
+Exercised here against synthetic artifacts:
+
+- **clock alignment**: injected skew recovered from shared collective
+  anchors within the asserted residual; a rank with telemetry but no
+  ring is *named* unaligned, never silently merged;
+- **exporter tolerance**: torn rings, empty dirs, single-rank dirs —
+  the exporter degrades, it never dies;
+- **trace schema**: the export passes the stdlib validator; the
+  validator rejects garbage; flow events join both ranks' stamps for
+  every shared collective seq;
+- **critical path**: the short-stream straggler is the named gating
+  rank at its last stamped ``(seq, op)`` — the same convention the
+  post-mortem uses — and step windows blame the dominant comm wait;
+- **CLI**: export + validate round trip, ``--validate-only``, empty
+  and missing inputs exit 0/1 per contract.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACEVIZ = os.path.join(REPO, "scripts", "traceviz.py")
+
+
+def _load(name, relpath):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+tl = _load("timeline_under_test", "heat_tpu/analysis/timeline.py")
+fr = tl._flightrec_mod()
+
+BASE = 1000.0
+
+
+def _mkring(d, rank, last_seq, skew=0.0, jitter=0.0, slots=64):
+    """Collective stamps seq 1..last_seq at BASE + seq*0.01 + skew."""
+    r = fr.FlightRecorder(
+        os.path.join(d, f"flight_rank{rank}.ring"), slots=slots, rank=rank
+    )
+    for s in range(1, last_seq + 1):
+        op = "resplit" if s % 3 == 0 else "Allreduce"
+        r.record("coll", seq=s, op=op, wire=1024,
+                 t=BASE + s * 0.01 + skew + jitter * (1 - s % 2))
+    r.close()
+    return os.path.join(d, f"flight_rank{rank}.ring")
+
+
+def _span(name, ts, dur, rank=0, depth=0, attrs=None):
+    rec = {"type": "span", "rank": rank, "name": name, "ts": ts,
+           "dur_s": dur, "self_s": dur, "depth": depth}
+    if attrs is not None:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _write_jsonl(d, rank, records, pid=None):
+    with open(os.path.join(d, f"rank{rank}.jsonl"), "w") as fh:
+        if pid is not None:
+            fh.write(json.dumps(
+                {"type": "meta", "rank": rank, "pid": pid,
+                 "wall_time": BASE}) + "\n")
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+
+
+def _standard_dir(d):
+    """Two ring ranks (rank1 skewed +5s, straggling at seq 12), rank0
+    telemetry with comm-dominated steps, ring-less rank2 telemetry, and
+    a scheduler journal sharing rank0's pid."""
+    _mkring(d, 0, 20)
+    _mkring(d, 1, 12, skew=5.0)
+    spans = []
+    for i in range(3):
+        t0 = BASE + i * 0.1
+        spans.append(_span("daso.step", t0, 0.09,
+                           attrs={"trace_id": "tr1"}))
+        spans.append(_span("comm.allreduce.wait", t0 + 0.02, 0.05,
+                           depth=1, attrs={"trace_id": "tr1"}))
+    _write_jsonl(d, 0, spans, pid=1234)
+    _write_jsonl(d, 2, [_span("io.load", BASE, 0.01, rank=2, attrs={})])
+    with open(os.path.join(d, "sched_journal.jsonl"), "w") as fh:
+        fh.write(json.dumps({"type": "meta", "pid": 1234, "epoch": 0,
+                             "t": BASE}) + "\n")
+        fh.write(json.dumps({"type": "submitted", "id": "j1", "tid": "tr1",
+                             "t": BASE + 0.01}) + "\n")
+        fh.write(json.dumps({"type": "done", "id": "j1", "tid": "tr1",
+                             "t": BASE + 0.3}) + "\n")
+    return d
+
+
+# ---------------------------------------------------------------------- #
+# clock alignment
+# ---------------------------------------------------------------------- #
+class TestClockAlignment:
+    def test_injected_skew_recovered_within_residual(self, tmp_path):
+        d = str(tmp_path)
+        _mkring(d, 0, 16)
+        _mkring(d, 1, 16, skew=5.0001)
+        align = tl.estimate_clock_offsets(tl.load_rings([d]))
+        assert align["ref"] == 0
+        assert align["offsets"][0] == 0.0
+        assert abs(align["offsets"][1] - 5.0001) < 1e-6
+        assert align["per_rank"][1]["anchors"] == 16
+        assert align["per_rank"][1]["max_residual_s"] < 1e-6
+
+    def test_jittered_skew_uses_robust_median(self, tmp_path):
+        # even seqs (10 of 21) land 3ms late on rank1: the median still
+        # nails the bulk offset; the residual reports the jitter honestly
+        d = str(tmp_path)
+        _mkring(d, 0, 21)
+        _mkring(d, 1, 21, skew=2.0, jitter=0.003)
+        align = tl.estimate_clock_offsets(tl.load_rings([d]))
+        off = align["offsets"][1]
+        assert abs(off - 2.0) < 2e-3
+        assert 1e-3 < align["per_rank"][1]["max_residual_s"] < 5e-3
+
+    def test_rank_with_telemetry_but_no_ring_named_unaligned(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        bundle = tl.assemble([d])
+        un = {u["rank"]: u["reason"] for u in bundle["align"]["unaligned"]}
+        assert un.get(2) == "no-ring"
+        # and it is NOT silently given an offset
+        assert 2 not in bundle["align"]["offsets"]
+
+    def test_clock_report_lines(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        rep = tl.clock_report(tl.assemble([d]))
+        assert "CLOCK-ALIGN rank=1 offset_ms=+5000.0" in rep
+        assert "anchors=12" in rep
+        assert "CLOCK-ALIGN rank=2 UNALIGNED reason=no-ring" in rep
+
+    def test_disjoint_seq_ranges_not_aligned(self, tmp_path):
+        d = str(tmp_path)
+        r0 = fr.FlightRecorder(
+            os.path.join(d, "flight_rank0.ring"), slots=8, rank=0)
+        r0.record("coll", seq=1, op="Allreduce", wire=8, t=BASE)
+        r0.close()
+        r1 = fr.FlightRecorder(
+            os.path.join(d, "flight_rank1.ring"), slots=8, rank=1)
+        r1.record("coll", seq=99, op="Allreduce", wire=8, t=BASE)
+        r1.close()
+        align = tl.estimate_clock_offsets(tl.load_rings([d]))
+        assert any(u["rank"] == 1 and u["reason"] == "no-shared-anchors"
+                   for u in align["unaligned"])
+
+
+# ---------------------------------------------------------------------- #
+# trace export + schema validation
+# ---------------------------------------------------------------------- #
+class TestChromeTrace:
+    def test_export_is_schema_valid(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        trace = tl.to_chrome_trace(tl.assemble([d]))
+        assert tl.validate_chrome_trace(trace) == []
+
+    def test_one_pid_per_rank_with_metadata(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        evs = tl.to_chrome_trace(tl.assemble([d]))["traceEvents"]
+        names = {e["pid"]: e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert names[0] == "rank0" and names[1] == "rank1"
+        assert names[tl.SCHED_PID] == "scheduler (journal)"
+
+    def test_flow_events_join_ranks_for_every_shared_seq(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        evs = tl.to_chrome_trace(tl.assemble([d]))["traceEvents"]
+        flows = [e for e in evs
+                 if e["ph"] in "stf" and e.get("cat") == "collective"]
+        # rank1 stamped seqs 1..12; every one of them has a start on one
+        # rank and a finish on the other
+        assert {e["id"] for e in flows} == set(range(1, 13))
+        by_seq = {}
+        for e in flows:
+            by_seq.setdefault(e["id"], set()).add((e["ph"], e["pid"]))
+        for seq, members in by_seq.items():
+            phs = {ph for ph, _ in members}
+            pids = {pid for _, pid in members}
+            assert "s" in phs and "f" in phs, (seq, members)
+            assert pids == {0, 1}, (seq, members)
+
+    def test_trace_id_flows_cross_scheduler(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        evs = tl.to_chrome_trace(tl.assemble([d]))["traceEvents"]
+        tr = [e for e in evs if e.get("cat") == "trace"]
+        assert tr and any(e["pid"] == tl.SCHED_PID for e in tr)
+        assert all(e["id"] == "tr-tr1" for e in tr)
+
+    def test_validator_rejects_garbage(self):
+        assert tl.validate_chrome_trace([]) != []
+        assert tl.validate_chrome_trace({"traceEvents": "nope"}) != []
+        bad_ph = {"traceEvents": [
+            {"ph": "Z", "pid": 0, "tid": 0, "ts": 0, "name": "x"}]}
+        assert any("ph" in p for p in tl.validate_chrome_trace(bad_ph))
+        no_dur = {"traceEvents": [
+            {"ph": "X", "pid": 0, "tid": 0, "ts": 0, "name": "x"}]}
+        assert any("dur" in p for p in tl.validate_chrome_trace(no_dur))
+        neg = {"traceEvents": [
+            {"ph": "X", "pid": 0, "tid": 0, "ts": 0, "dur": -1, "name": "x"}]}
+        assert tl.validate_chrome_trace(neg) != []
+
+    def test_torn_ring_still_exports_valid_trace(self, tmp_path):
+        d = str(tmp_path)
+        p0 = _mkring(d, 0, 6, slots=8)
+        _mkring(d, 1, 6, slots=8)
+        with open(p0, "r+b") as fh:
+            fh.seek(fr._HEADER_SIZE + 2 * fr.DEFAULT_SLOT_SIZE + fr._LEN_SIZE)
+            fh.write(b"\xff" * 16)
+        bundle = tl.assemble([d])
+        assert bundle["rings"][0]["slots_skipped"] == 1
+        trace = tl.to_chrome_trace(bundle)
+        assert tl.validate_chrome_trace(trace) == []
+        # the surviving anchors still align the pair
+        assert 1 in bundle["align"]["offsets"]
+
+    def test_ring_only_ranks_get_reconstructed_slices(self, tmp_path):
+        # chaos path: workers SIGKILLed before flushing telemetry — the
+        # ring's span/span_end pairs become the lane slices
+        d = str(tmp_path)
+        r = fr.FlightRecorder(
+            os.path.join(d, "flight_rank0.ring"), slots=16, rank=0)
+        r.record("span", name="daso.step", t=BASE)
+        r.record("coll", seq=1, op="Allreduce", wire=8, t=BASE + 0.01)
+        r.record("span_end", name="daso.step", t=BASE + 0.05)
+        r.close()
+        evs = tl.to_chrome_trace(tl.assemble([d]))["traceEvents"]
+        slices = [e for e in evs if e["ph"] == "X"
+                  and e["name"] == "daso.step"]
+        assert len(slices) == 1 and abs(slices[0]["dur"] - 50000) < 1
+
+
+# ---------------------------------------------------------------------- #
+# critical path
+# ---------------------------------------------------------------------- #
+class TestCriticalPath:
+    def test_step_kind_blames_dominant_comm_wait(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        cp = tl.critical_path(tl.assemble([d]))
+        step_lines = [l for l in cp["lines"] if "kind=daso.step" in l]
+        assert len(step_lines) == 1
+        assert "rank=0 op=comm.allreduce.wait" in step_lines[0]
+        assert "share=" in step_lines[0]
+
+    def test_short_stream_straggler_is_the_gating_rank(self, tmp_path):
+        # rank1 stops stamping at seq 12 (op=resplit) — the post-mortem
+        # convention: blame lands at the straggler's LAST stamped (seq, op)
+        d = _standard_dir(str(tmp_path))
+        cp = tl.critical_path(tl.assemble([d]))
+        coll = [l for l in cp["lines"] if "kind=collective" in l]
+        assert any("rank=1 op=resplit seq=12 share=" in l for l in coll), coll
+
+    def test_blame_table_shares_sum_to_one(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        blame = tl.critical_path(tl.assemble([d]))["blame"]
+        assert blame["total_s"] > 0
+        assert abs(sum(v["share"] for v in blame["by_rank"].values())
+                   - 1.0) < 1e-6
+        assert abs(sum(v["share"] for v in blame["by_op"].values())
+                   - 1.0) < 1e-6
+
+    def test_greppable_line_format(self, tmp_path):
+        import re
+        d = _standard_dir(str(tmp_path))
+        pat = re.compile(
+            r"^CRITICAL-PATH kind=\S+ rank=\d+ op=\S+ seq=(\d+|-) "
+            r"share=\d\.\d{3}$")
+        for line in tl.critical_path(tl.assemble([d]))["lines"]:
+            assert pat.match(line), line
+
+    def test_no_artifacts_no_lines(self, tmp_path):
+        bundle = tl.assemble([str(tmp_path)])
+        assert tl.critical_path(bundle)["lines"] == []
+        assert tl.critical_path_report(bundle) == ""
+
+
+# ---------------------------------------------------------------------- #
+# CLI
+# ---------------------------------------------------------------------- #
+class TestTracevizCLI:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, TRACEVIZ, *argv],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_export_validate_round_trip(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        out = os.path.join(d, "trace.json")
+        r = self._run(d, "--out", out)
+        assert r.returncode == 0, r.stderr
+        assert "TRACE-EXPORT events=" in r.stdout
+        assert "CLOCK-ALIGN rank=1" in r.stdout
+        assert "CRITICAL-PATH kind=collective" in r.stdout
+        r2 = self._run("--validate-only", out)
+        assert r2.returncode == 0 and "TRACE-VALID events=" in r2.stdout
+
+    def test_json_sidecar(self, tmp_path):
+        d = _standard_dir(str(tmp_path))
+        sidecar = os.path.join(d, "cp.json")
+        r = self._run(d, "--out", os.path.join(d, "t.json"),
+                      "--json", sidecar)
+        assert r.returncode == 0, r.stderr
+        payload = json.load(open(sidecar))
+        assert payload["align"]["offsets"] and payload["critical_path"]
+
+    def test_empty_dir_exits_0(self, tmp_path):
+        r = self._run(str(tmp_path))
+        assert r.returncode == 0, r.stderr
+
+    def test_single_rank_dir_exits_0(self, tmp_path):
+        d = str(tmp_path)
+        _mkring(d, 0, 4)
+        r = self._run(d, "--out", os.path.join(d, "t.json"))
+        assert r.returncode == 0, r.stderr
+        assert "TRACE-EXPORT events=" in r.stdout
+
+    def test_no_targets_exits_1(self):
+        assert self._run().returncode == 1
+
+    def test_validate_only_rejects_garbage(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"traceEvents": [{"ph": "Z"}]}))
+        r = self._run("--validate-only", str(bad))
+        assert r.returncode == 1 and "INVALID" in r.stderr
+
+
+# ---------------------------------------------------------------------- #
+# report integration
+# ---------------------------------------------------------------------- #
+class TestReportIntegration:
+    def test_critical_path_rides_telemetry_report(self, tmp_path, capsys):
+        trep = _load("trep_for_timeline", "scripts/telemetry_report.py")
+        d = _standard_dir(str(tmp_path))
+        trace_out = os.path.join(d, "merged_trace.json")
+        assert trep.main([d, "--timeline", "0",
+                          "--trace-out", trace_out]) == 0
+        out = capsys.readouterr().out
+        assert "CLOCK-ALIGN rank=" in out
+        assert "CRITICAL-PATH kind=" in out
+        assert "TRACE-EXPORT events=" in out
+        trace = json.load(open(trace_out))
+        assert tl.validate_chrome_trace(trace) == []
