@@ -1315,6 +1315,95 @@ class FederationJournaledMutationRule(Rule):
 
 
 # -------------------------------------------------------------------- #
+# HT113 — fault-site literals must be catalog members
+# -------------------------------------------------------------------- #
+
+
+@register
+class UnknownFaultSiteRule(Rule):
+    """Every fault site the runtime can arm or fire is registered in
+    ``faults.catalog()`` — the chaos engine enumerates the fault space
+    from that registry.  A string literal at an arming/firing call site
+    (``faults.fire("io.wrte")``, ``faults.inject("io.wrte", fail=1)``)
+    that is NOT a catalog member arms or fires *nothing*: the injection
+    silently tests a healthy world, the trip counter never moves, and the
+    chaos campaign's coverage claim quietly becomes a lie.  The runtime
+    twin (``schedule.validate_schedule`` and the dryrun launcher's
+    arming-time check) catches env-borne typos; this rule catches the
+    source-borne ones before anything runs.
+
+    Only literal first arguments of ``fire``/``inject``/``trip_count``
+    and literal ``FaultSpec(...)`` sites are checked — a variable site is
+    someone's abstraction and stays out of lexical scope (the
+    ``call_with_retries`` site parameter names retry *counters*, not
+    armed fault sites, so it is exempt by design: the chaos harness
+    deliberately uses pseudo-sites like ``chaos.submit`` there)."""
+
+    code = "HT113"
+    name = "unknown-fault-site"
+    description = "fault-site string literal not registered in faults.catalog()"
+
+    SITE_ARG0 = {"fire", "inject", "trip_count", "FaultSpec"}
+
+    _catalog_sites: Optional[frozenset] = None
+
+    @classmethod
+    def _sites(cls) -> frozenset:
+        """The catalog, loaded once per process from faults.py by path —
+        the analysis package is loaded standalone (scripts/heatlint.py
+        synthesizes it), so a relative package import cannot reach
+        utils.faults; the path load shares heatlint's no-jax guarantee
+        because faults.py is stdlib-only."""
+        if cls._catalog_sites is None:
+            import importlib.util as _ilu
+            import os as _os
+            import sys as _sys
+
+            name = "_heatlint_faults"
+            if name in _sys.modules:
+                mod = _sys.modules[name]
+            else:
+                path = _os.path.join(
+                    _os.path.dirname(_os.path.abspath(__file__)),
+                    "..", "utils", "faults.py",
+                )
+                spec = _ilu.spec_from_file_location(name, _os.path.normpath(path))
+                mod = _ilu.module_from_spec(spec)
+                _sys.modules[name] = mod
+                spec.loader.exec_module(mod)
+            cls._catalog_sites = frozenset(mod.catalog_sites())
+        return cls._catalog_sites
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        out = []
+        sites = None
+        for node in ctx.walk(ast.Call):
+            fname = last_attr(node) or call_name(node)
+            if fname not in self.SITE_ARG0 or not node.args:
+                continue
+            arg = node.args[0]
+            if not isinstance(arg, ast.Constant) or not isinstance(
+                arg.value, str
+            ):
+                continue  # a variable site is out of lexical scope
+            if sites is None:
+                sites = self._sites()
+            if arg.value in sites:
+                continue
+            f = ctx.finding(
+                self, node,
+                f"fault site {arg.value!r} is not in faults.catalog() — "
+                f"this {fname}() arms/fires nothing and the injection "
+                "silently tests a healthy world; register the site or fix "
+                "the typo",
+                detail=f"{fname}({arg.value!r})",
+            )
+            if f is not None:
+                out.append(f)
+        return out
+
+
+# -------------------------------------------------------------------- #
 # HT2xx — the interprocedural family (callgraph + summaries engine)
 # -------------------------------------------------------------------- #
 

@@ -1,0 +1,24 @@
+"""Deterministic chaos campaign engine (stdlib-only, jax-free).
+
+Sweeps pseudo-random fault schedules drawn from ``faults.catalog()``
+against real supervised multi-process workloads, judges every run with
+invariant oracles, and auto-shrinks failing schedules to minimal
+``CHAOS-REPRO`` reproducers.  See design.md "Chaos engineering".
+
+Layout:
+
+- ``schedule``   — seeded fault-schedule generation, tokens, repro lines
+- ``worker``     — the fast-tier supervised harness workload
+- ``oracles``    — the invariant suite judging a finished run
+- ``engine``     — Supervisor-driven runner, campaign journal, verdicts
+- ``shrink``     — greedy delta-debugging to a re-confirmed minimum
+- ``scenarios``  — the five legacy full-tier scenarios as declarative specs
+
+Every submodule is also standalone-loadable by path (the
+``scripts/chaoscamp.py`` / supervisor-host discipline: no package import
+may pull in jax).
+"""
+
+from . import engine, oracles, scenarios, schedule, shrink  # noqa: F401
+
+__all__ = ["engine", "oracles", "scenarios", "schedule", "shrink"]

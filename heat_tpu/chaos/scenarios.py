@@ -1,0 +1,277 @@
+"""The legacy chaos scenarios as declarative specs.
+
+Each of the five full-tier chaos scenarios that used to live as
+imperative test bodies (tests/test_chaos.py, tests/test_multiprocess.py)
+is expressed here as DATA: the dryrun launch shape (mode, world size,
+env) plus the attestation contract the run must print.  The engine
+replays a spec through the real ``scripts/multiprocess_dryrun.py``
+launcher — the same subprocess worlds, the same markers — and the
+original tests now drive these specs through :func:`run_scenario` /
+:func:`check_scenario` instead of duplicating the env dicts inline.
+
+Why data, not code: a declarative spec is diffable (the whole
+fault-injection surface of a scenario is visible in one dict), greppable
+(CI logs name the spec), and replayable from the command line
+(``scripts/chaoscamp.py --scenario kill-resume-train``).
+
+The contract language:
+
+- ``expect_rc``      — ``"zero"`` or ``"nonzero"``
+- ``expect``         — literal substrings that must appear in stdout
+- ``expect_re``      — regexes that must match stdout
+- ``derived``        — two-stage assertions ``[capture_re, template]``:
+  the capture's group(1) is substituted into the template (as ``{0}``)
+  and the result must appear literally.  This is how the hang/desync
+  scenarios assert the post-mortem names the EXACT seq the victim
+  announced (``PM-HANG expect_seq=N`` → ``verdict=straggler … seq=N``).
+- ``forbid``         — substrings that must NOT appear
+
+Stdlib-only and standalone-loadable; the launcher module is spec-loaded
+so this file never imports jax either.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+__all__ = ["SCENARIOS", "scenario", "run_scenario", "check_scenario"]
+
+_REPO = os.path.normpath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+
+def _mpd():
+    for name in ("multiprocess_dryrun_chaos", "heat_chaos_mpd"):
+        if name in sys.modules:
+            return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        "heat_chaos_mpd", os.path.join(_REPO, "scripts", "multiprocess_dryrun.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------- #
+# the five scenarios
+# ---------------------------------------------------------------------- #
+SCENARIOS: Dict[str, dict] = {
+    # ISSUE 5 acceptance: SIGKILL one rank mid-DASO-training; the
+    # supervisor restarts the world and training resumes from the newest
+    # verified checkpoint (killed at step 5, checkpoint every 3 -> both
+    # ranks resume at step 3 and reach the target).
+    "kill-resume-train": {
+        "mode": "train",
+        "n_proc": 2,
+        "devs_per_proc": 4,
+        "timeout": 700,
+        "flake_retry": True,  # documented gloo op.preamble.length victim
+        "extra_env": {
+            "MPDRYRUN_TARGET_STEPS": 12,
+            "MPDRYRUN_CKPT_EVERY": 3,
+            "MPDRYRUN_FAULT_RANK": 1,
+            "MPDRYRUN_FAULT_SPEC": "proc.exit:exit=5",
+            "MPDRYRUN_STEP_DELAY": 0.1,
+            "MPDRYRUN_RESTARTS": 2,
+        },
+        "expect_rc": "zero",
+        "expect": [
+            "rank 1 died with exit code -9",
+            "SUPERVISOR restarts=1 generations=2",
+            "[0] RESUMED epoch=1 step=3 ok=True",
+            "[1] RESUMED epoch=1 step=3 ok=True",
+            "[0] TRAIN-OK steps=12",
+            "[1] TRAIN-OK steps=12",
+            "watchdog.kills",
+            "TELEMETRY-MERGED ranks=2",
+        ],
+        "expect_re": [
+            r"STEP-OVERLAP kind=daso\.step steps=\d+ overlap=\d\.\d+",
+        ],
+    },
+    # ISSUE 10 acceptance: SIGKILL one serving rank mid-queue; journal
+    # replay requeues the in-flight jobs exactly once and the attestation
+    # proves zero lost and unbroken trace chains across the restart.
+    "serve-sigkill-mid-queue": {
+        "mode": "serve",
+        "n_proc": 2,
+        "devs_per_proc": 4,
+        "timeout": 700,
+        "extra_env": {
+            "MPDRYRUN_FAULT_RANK": 1,
+            "MPDRYRUN_FAULT_SPEC": "sched.dispatch:exit=4",
+            "MPDRYRUN_RESTARTS": 2,
+        },
+        "expect_rc": "zero",
+        "expect": [
+            "rank 1 died with exit code -9",
+            "SUPERVISOR restarts=1 generations=2",
+            "[0] SERVE-OK",
+            "[1] SERVE-OK",
+            "TELEMETRY-MERGED ranks=2",
+            "SCHED-TRACE-CONTINUITY jobs=20 ok=True",
+            "causal timeline for trace",
+        ],
+        "expect_re": [
+            r"SCHED jobs=20 done=18 requeued=[1-9]\d* shed=2 failed=0 lost=0",
+        ],
+        # SPMD lockstep recovery: every rank requeued the SAME set the
+        # journal attestation counted (a divergent requeue would desync)
+        "derived": [
+            [
+                r"SCHED jobs=20 done=18 requeued=(\d+)",
+                "[0] SCHED-RECOVERED epoch=1 requeued={0}",
+            ],
+            [
+                r"SCHED jobs=20 done=18 requeued=(\d+)",
+                "[1] SCHED-RECOVERED epoch=1 requeued={0}",
+            ],
+        ],
+    },
+    # ISSUE 7 acceptance: one rank wedges on an injected collective hang;
+    # the supervisor's heartbeat staleness converts the wedge into
+    # teardown and the ring post-mortem names the straggler at the exact
+    # seq the victim announced before hanging.
+    "hang-straggler-verdict": {
+        "mode": "postmortem",
+        "n_proc": 2,
+        "devs_per_proc": 4,
+        "timeout": 700,
+        "extra_env": {
+            "MPDRYRUN_HANG_RANK": 1,
+            "MPDRYRUN_CHAOS_AT": 3,
+            "MPDRYRUN_HB_TIMEOUT": 25,
+        },
+        "expect_rc": "nonzero",  # a wedged world is a FAILED run
+        "expect": ["SUPERVISOR GAVE UP"],
+        "expect_re": [
+            r"heartbeat stale .*stuck at seq \d+ resplit",
+            r"TRACE-EXPORT events=\d+ ranks=\d+ out=",
+        ],
+        "derived": [
+            [
+                r"\[1\] PM-HANG expect_seq=(\d+)",
+                "POSTMORTEM epoch=0 verdict=straggler rank=1 seq={0} op=resplit",
+            ],
+            [
+                r"\[1\] PM-HANG expect_seq=(\d+)",
+                "CRITICAL-PATH kind=collective rank=1 op=resplit seq={0}",
+            ],
+        ],
+    },
+    # ISSUE 7 acceptance: one of three ranks stages a rank-conditional
+    # EXTRA collective; the analyzer names the first divergent seq and
+    # convicts the minority fingerprint by majority vote.
+    "desync-minority-verdict": {
+        "mode": "postmortem",
+        "n_proc": 3,
+        "devs_per_proc": 2,
+        "timeout": 700,
+        "extra_env": {
+            "MPDRYRUN_DESYNC_RANK": 1,
+            "MPDRYRUN_CHAOS_AT": 3,
+            "MPDRYRUN_HB_TIMEOUT": 25,
+        },
+        "expect_rc": "nonzero",
+        "expect": ["SUPERVISOR GAVE UP"],
+        "derived": [
+            [
+                r"\[1\] PM-DESYNC expect_seq=(\d+)",
+                "POSTMORTEM epoch=0 verdict=desync seq={0} ranks=1",
+            ],
+        ],
+    },
+    # ISSUE 17 acceptance: SIGKILL an entire world of a two-world
+    # federation mid-queue; the survivor absorbs the stolen jobs and the
+    # journal-derived attestation proves zero loss (12 jobs + the shed
+    # giant accounted).
+    "fed-world-kill": {
+        "mode": "fed",
+        "n_proc": 2,
+        "devs_per_proc": 2,
+        "timeout": 700,
+        "extra_env": {"MPDRYRUN_JOBS": 12},
+        "expect_rc": "zero",
+        "expect": [
+            "submitted=12",
+            "FED-SHED id=giant reason=mem_infeasible http=429",
+            "FED worlds=2 lost=0 jobs=13",
+        ],
+        "expect_re": [
+            r"FED-QUARANTINED world=w1 stolen=[1-9]\d*",
+            r"FED-HEALTHZ-DEGRADED http=200 healthy=1 quarantined=1",
+            r"FED-RESIZE world=w0 ranks=1->\d+ queue=\d+",
+            r"FED-RESULT id=\S+ http=200 digest=",
+        ],
+    },
+}
+
+
+def scenario(name: str) -> dict:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})"
+        )
+
+
+def run_scenario(name: str, *, timeout: Optional[float] = None):
+    """Launch one spec through the real dryrun harness.  Returns the
+    ``CompletedProcess``; judge it with :func:`check_scenario`."""
+    spec = scenario(name)
+    mpd = _mpd()
+    launch = (
+        mpd.launch_retrying_known_flake if spec.get("flake_retry")
+        else mpd.launch
+    )
+    return launch(
+        timeout=timeout if timeout is not None else spec["timeout"],
+        n_proc=spec["n_proc"],
+        devs_per_proc=spec["devs_per_proc"],
+        mode=spec["mode"],
+        extra_env=dict(spec["extra_env"]),
+    )
+
+
+def check_scenario(name: str, proc) -> List[str]:
+    """Evaluate a finished run against its spec's attestation contract.
+    Returns the list of violated clauses — empty means the scenario
+    reproduced; tests assert ``check_scenario(...) == []`` so a failure
+    names every broken clause at once."""
+    spec = scenario(name)
+    out = proc.stdout
+    bad: List[str] = []
+    rc = proc.returncode
+    if spec["expect_rc"] == "zero":
+        if rc != 0:
+            bad.append(f"expected rc==0, got {rc}")
+        mpd = _mpd()
+        if mpd.PASS_MARKER not in out:
+            bad.append(f"missing pass marker {mpd.PASS_MARKER!r}")
+    elif rc == 0:
+        bad.append("expected nonzero rc, got 0")
+    for lit in spec.get("expect", ()):
+        if lit not in out:
+            bad.append(f"missing literal {lit!r}")
+    for pat in spec.get("expect_re", ()):
+        if not re.search(pat, out):
+            bad.append(f"no match for /{pat}/")
+    for capture, template in spec.get("derived", ()):
+        m = re.search(capture, out)
+        if not m:
+            bad.append(f"derived capture /{capture}/ never matched")
+            continue
+        want = template.format(m.group(1))
+        if want not in out:
+            bad.append(f"derived assertion missing: {want!r}")
+    for lit in spec.get("forbid", ()):
+        if lit in out:
+            bad.append(f"forbidden output present: {lit!r}")
+    return bad

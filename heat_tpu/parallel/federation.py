@@ -667,6 +667,15 @@ class Federation:
         self._queue.sort(key=lambda j: (-j.priority, j._order))
         remaining: List[Job] = []
         for job in self._queue:
+            # queue hygiene making assign() idempotent under recovery and
+            # retry: a job folded up terminal by reconcile_world_journal
+            # AFTER recover() requeued it must never dispatch again, and a
+            # job a journal-faulted partial pass already assigned (state
+            # flipped, still in the queue) must not be handed out twice —
+            # it is tracked in its world's `assigned` set; both just leave
+            # the queue
+            if job.state in (DONE, FAILED, SHED, ASSIGNED):
+                continue
             healthy = [w for w in self.worlds.values() if w.state == HEALTHY]
             if not healthy:
                 remaining.append(job)

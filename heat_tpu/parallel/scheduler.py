@@ -552,6 +552,34 @@ def jobs_summary(replay: dict) -> dict:
     }
 
 
+def execution_witness(replay: dict) -> dict:
+    """Per-job execution accountability over a :func:`replay_journal`
+    view: the generations that journaled a DISPATCHED record for each job
+    and the first generation that journaled it DONE.
+
+    This is the exactly-once contract rendered as data — an execution a
+    worker witnessed is legitimate iff its generation appears in
+    ``dispatch_epochs``, and NO legitimate execution can postdate
+    ``first_done_epoch`` (recovery registers DONE jobs in ``_done_ids``
+    precisely so they never dispatch again).  The chaos exactly-once
+    oracle audits worker-side execution logs against this view."""
+    out: Dict[str, dict] = {}
+    for rec in replay.get("records", ()):
+        jid = rec.get("id")
+        if jid is None:
+            continue
+        w = out.setdefault(
+            str(jid), {"dispatch_epochs": [], "first_done_epoch": None}
+        )
+        epoch = int(rec.get("epoch", 0) or 0)
+        t = rec.get("type")
+        if t == DISPATCHED:
+            w["dispatch_epochs"].append(epoch)
+        elif t == DONE and w["first_done_epoch"] is None:
+            w["first_done_epoch"] = epoch
+    return out
+
+
 def attestation_line(summary: dict) -> str:
     """The launcher's one-line job accounting (tests assert on it)."""
     return (

@@ -51,6 +51,7 @@ from __future__ import annotations
 import importlib.util
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -107,6 +108,36 @@ def dump_stacks_then_kill(procs, grace: float = 3.0) -> Dict[str, int]:
             p.kill()
             kills += 1
     return {"dumps": len(hung), "kills": kills}
+
+
+# the two failure shapes _check_failure emits, with the "epoch N:" prefix
+# run() stamps on; kept as ONE module-level pattern so post-hoc consumers
+# (the chaos blame oracle, log scrapers) parse failures structurally
+# instead of each growing its own regex of these strings
+_FAILURE_RE = re.compile(
+    r"^epoch (?P<epoch>\d+): rank (?P<rank>\d+) "
+    r"(?:died with exit code (?P<code>-?\d+)"
+    r"|heartbeat stale \((?P<age>[\d.]+)s)"
+)
+
+
+def parse_failure(failure: str) -> Optional[dict]:
+    """Parse one ``SupervisorResult.failures`` string into its facts:
+    ``{"epoch", "rank", "kind": "died"|"stale", "code"|"age"}`` — None
+    for shapes that name no rank (e.g. a generation-deadline overrun).
+    This is the read-side contract of the failure strings: a wording
+    change here must keep this parser (and its tests) honest."""
+    m = _FAILURE_RE.match(failure)
+    if not m:
+        return None
+    out = {"epoch": int(m.group("epoch")), "rank": int(m.group("rank"))}
+    if m.group("code") is not None:
+        out["kind"] = "died"
+        out["code"] = int(m.group("code"))
+    else:
+        out["kind"] = "stale"
+        out["age"] = float(m.group("age"))
+    return out
 
 
 @dataclass

@@ -79,6 +79,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import hashlib
 import os
 import time
 from typing import Callable, Dict, Iterator, Optional, Tuple
@@ -91,10 +92,120 @@ __all__ = [
     "fire",
     "trip_count",
     "reset_trips",
+    "trips",
     "parse_spec",
+    "render_spec",
+    "catalog",
+    "catalog_sites",
+    "MODES",
+    "jitter_unit",
     "backoff_schedule",
     "call_with_retries",
 ]
+
+# every mode ``parse_spec`` accepts, in the order ``fire`` applies them
+MODES = ("delay", "hang", "corrupt", "exit", "fail")
+
+# ---------------------------------------------------------------------- #
+# the machine-readable fault catalog
+# ---------------------------------------------------------------------- #
+# One entry per registered site: where it fires from (the layer owning the
+# ``fire(...)`` call) and which modes are *meaningful* there — every mode
+# mechanically works at every site, but e.g. ``corrupt`` needs a firing
+# that passes ``path=`` and ``hang`` is only survivable under a watchdog.
+# This tuple is the single source of truth the chaos schedule generator
+# enumerates, the coverage test greps the repo against, and heatlint HT113
+# checks fire/inject literals against — a typo'd site can no longer
+# silently never fire.
+_CATALOG = (
+    {
+        "site": "io.write",
+        "modes": ("fail", "delay", "corrupt", "exit"),
+        "layer": "core/io.py",
+        "fires": "every durable checkpoint file write (chunk files, "
+                 "meta.json, LATEST tmp, pytree .npz); fired with path=",
+    },
+    {
+        "site": "io.read",
+        "modes": ("fail", "delay", "corrupt"),
+        "layer": "core/io.py",
+        "fires": "checkpoint verification/assembly reads; fired with path=",
+    },
+    {
+        "site": "io.fsync",
+        "modes": ("fail", "delay", "corrupt"),
+        "layer": "core/io.py",
+        "fires": "every fsync of a checkpoint file or directory; "
+                 "fired with path=",
+    },
+    {
+        "site": "comm.host_fetch",
+        "modes": ("fail", "delay"),
+        "layer": "core/communication.py",
+        "fires": "Communication.host_fetch (device→host fetches)",
+    },
+    {
+        "site": "comm.collective",
+        "modes": ("fail", "delay", "hang", "exit"),
+        "layer": "core/communication.py",
+        "fires": "every collective staging point (_account) and the "
+                 "blocking waits (Wait/Barrier) — hang models a dead peer, "
+                 "the case the comm.deadline watchdog exists for",
+    },
+    {
+        "site": "proc.exit",
+        "modes": ("exit", "delay"),
+        "layer": "optim/dp_optimizer.py",
+        "fires": "once per training step (DASO.step) and per dryrun-worker "
+                 "section — exit=N is the deterministic rank death the "
+                 "supervisor lane recovers from",
+    },
+    {
+        "site": "dist.init",
+        "modes": ("fail", "delay"),
+        "layer": "core/bootstrap.py",
+        "fires": "each jax.distributed.initialize attempt in "
+                 "bootstrap.init_distributed",
+    },
+    {
+        "site": "sched.dispatch",
+        "modes": ("fail", "delay", "hang", "exit"),
+        "layer": "parallel/scheduler.py",
+        "fires": "every scheduler dispatch attempt, inside the armed "
+                 "per-job deadline — fail/delay exercise retries, hang "
+                 "proves a wedged dispatch fails the job not the queue, "
+                 "exit SIGKILLs a serving rank mid-queue",
+    },
+    {
+        "site": "sched.journal.write",
+        "modes": ("fail", "delay"),
+        "layer": "parallel/scheduler.py",
+        "fires": "every append to a crash-durable job journal (scheduler "
+                 "and federation share the format); fired with path=",
+    },
+    {
+        "site": "mem.alloc",
+        "modes": ("fail", "delay"),
+        "layer": "utils/memledger.py",
+        "fires": "every ledger-registered device allocation — fail models "
+                 "a deterministic OOM at the registration choke point",
+    },
+)
+
+
+def catalog() -> Tuple[Dict[str, object], ...]:
+    """The machine-readable fault-site registry: one dict per site with
+    ``site`` (the string ``fire`` is called with), ``modes`` (the modes
+    that are meaningful there), ``layer`` (the module owning the firing)
+    and ``fires`` (prose: which operations trip it).  Returns fresh copies
+    — mutating the result never poisons the registry."""
+    return tuple(dict(e) for e in _CATALOG)
+
+
+def catalog_sites() -> frozenset:
+    """Just the registered site names (membership checks: HT113, the
+    schedule generator's validation, the coverage test)."""
+    return frozenset(e["site"] for e in _CATALOG)
 
 
 class InjectedFault(Exception):
@@ -159,6 +270,24 @@ def parse_spec(text: str) -> Dict[str, FaultSpec]:
             kw[k] = float(v) if k == "delay" else int(v)
         specs[site] = FaultSpec(site, **kw)
     return specs
+
+
+def render_spec(specs: Dict[str, FaultSpec]) -> str:
+    """Inverse of :func:`parse_spec`: render armed specs back into the
+    ``HEAT_TPU_FAULTS`` grammar (sorted by site for a stable string — the
+    chaos engine puts the result in reproducer lines, which must compare
+    equal across runs).  Round-trips: ``parse_spec(render_spec(s))``
+    arms identically."""
+    parts = []
+    for site in sorted(specs):
+        s = specs[site]
+        kvs = []
+        for mode in MODES:
+            v = getattr(s, mode)
+            if v:
+                kvs.append(f"{mode}={v:g}" if mode == "delay" else f"{mode}={v}")
+        parts.append(f"{site}:{','.join(kvs)}" if kvs else site)
+    return ";".join(parts)
 
 
 # env-armed specs (subprocess chaos tests) parsed once at import; in-process
@@ -250,9 +379,31 @@ def reset_trips() -> None:
     _trips.clear()
 
 
+def trips() -> Dict[str, int]:
+    """Every site's firing count since :func:`reset_trips` — the chaos
+    engine's *injection evidence*: an armed site whose count stays zero
+    means the schedule never actually tested what it claims (the runtime
+    twin of the HT113 static check)."""
+    return dict(_trips)
+
+
 # ---------------------------------------------------------------------- #
 # bounded retry with jittered exponential backoff
 # ---------------------------------------------------------------------- #
+def jitter_unit(site: str, attempt: int) -> float:
+    """A uniform draw in [0, 1) derived *deterministically* from
+    ``(site, attempt)`` — the backoff jitter source.  Process entropy here
+    would make lockstep SPMD ranks sleep differently after the same
+    transient fault (the HT105 rationale: divergent sleeps skew the
+    collective timing the flight recorder fingerprints), and would make a
+    replayed chaos schedule time differently than the run it reproduces.
+    sha256 is stable across processes, platforms and PYTHONHASHSEED;
+    distinct sites and attempts still decorrelate (the reason jitter
+    exists) because they hash apart."""
+    digest = hashlib.sha256(f"backoff|{site}|{int(attempt)}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0**64
+
+
 def backoff_schedule(
     retries: int,
     base_delay: float = 0.05,
@@ -260,17 +411,18 @@ def backoff_schedule(
     max_delay: float = 2.0,
     jitter: float = 0.5,
     rand: Optional[Callable[[], float]] = None,
+    site: str = "",
 ) -> Iterator[float]:
     """The delays slept between attempts: ``min(max_delay, base*factor**i)``
     stretched by up to ``jitter``× a uniform draw (decorrelates the retry
-    storms of many writers hitting one flaky store).  ``rand`` is injectable
+    storms of many writers hitting one flaky store).  The draw is seeded
+    per ``(site, attempt)`` (:func:`jitter_unit`) — deterministic, so two
+    replayed ranks derive identical sleep sequences; distinct *sites*
+    retrying concurrently still spread out.  ``rand`` remains injectable
     so tests pin the schedule without sleeping."""
-    if rand is None:
-        import random
-
-        rand = random.random
     for i in range(retries):
-        yield min(max_delay, base_delay * factor**i) * (1.0 + jitter * rand())
+        u = rand() if rand is not None else jitter_unit(site, i)
+        yield min(max_delay, base_delay * factor**i) * (1.0 + jitter * u)
 
 
 def call_with_retries(
@@ -313,20 +465,33 @@ def call_with_retries(
         except retry_on as e:
             if retry_if is not None and not retry_if(e):
                 raise
-            from . import profiler
+            # profiler pulls in jax; a standalone-loaded consumer (the
+            # supervisor's tools, the chaos harness worker) keeps the
+            # bounded retry and merely loses the retry.<site> counters
+            try:
+                from . import profiler
+            except ImportError:
+                profiler = None
+
+            def _count(name: str) -> None:
+                if profiler is not None:
+                    profiler.counter_inc(name)
 
             if attempt >= retries:
-                profiler.counter_inc(f"retry.{site}.exhausted")
+                _count(f"retry.{site}.exhausted")
                 raise
             if delays is None:
                 delays = list(
-                    backoff_schedule(retries, base_delay, factor, max_delay, jitter, rand)
+                    backoff_schedule(
+                        retries, base_delay, factor, max_delay, jitter, rand,
+                        site=site,
+                    )
                 )
             if deadline is not None:
                 elapsed = clock() - t0
                 if elapsed + delays[attempt] >= deadline:
-                    profiler.counter_inc(f"retry.{site}.exhausted")
+                    _count(f"retry.{site}.exhausted")
                     raise
-            profiler.counter_inc(f"retry.{site}")
+            _count(f"retry.{site}")
             sleep(delays[attempt])
             attempt += 1

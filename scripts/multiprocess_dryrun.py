@@ -1454,6 +1454,21 @@ def main() -> int:
     hb_timeout = float(os.environ.get("MPDRYRUN_HB_TIMEOUT", "120"))
     fault_rank = int(os.environ.get("MPDRYRUN_FAULT_RANK", "-1"))
     fault_spec = os.environ.get("MPDRYRUN_FAULT_SPEC", "")
+    if fault_spec:
+        # arming-time catalog check (the HT113 contract, enforced at the
+        # runtime boundary too): a typo'd site would arm NOTHING and the
+        # chaos scenario would silently test a healthy world — fail the
+        # launch loudly instead.  faults.py is stdlib-only, so the
+        # launcher stays jax-free.
+        flt = _load_standalone("heat_faults", "heat_tpu/utils/faults.py")
+        known = set(flt.catalog_sites())
+        armed = flt.parse_spec(fault_spec)
+        unknown = sorted(set(armed) - known)
+        if unknown:
+            raise SystemExit(
+                f"MPDRYRUN_FAULT_SPEC names unknown fault site(s) "
+                f"{unknown}; catalog: {sorted(known)}"
+            )
     # default: the injected fault models ONE crash (disarmed on restart);
     # =1 keeps it armed every generation — a persistently bad node, the
     # scenario that must exhaust the restart budget and produce the

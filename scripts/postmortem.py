@@ -449,6 +449,26 @@ def analyze_dir(
     )
 
 
+def verdict_rank(verdict: dict) -> Optional[int]:
+    """The single rank a verdict convicts, or None when it names none
+    (inconclusive) or cannot narrow to one (a multi-rank desync tie).
+    The chaos blame oracle cross-checks this against the schedule's
+    injected victim: a verdict naming the WRONG rank is a diagnosis
+    failure even when the run otherwise recovered."""
+    kind = verdict.get("verdict")
+    if kind == "straggler":
+        r = (verdict.get("straggler") or {}).get("rank")
+        return int(r) if r is not None else None
+    if kind == "oom":
+        r = (verdict.get("oom") or {}).get("rank")
+        return int(r) if r is not None else None
+    if kind == "desync":
+        ranks = verdict.get("deviating_ranks") or []
+        if len(ranks) == 1:
+            return int(ranks[0])
+    return None
+
+
 def summary_line(verdict: dict, epoch: Optional[int] = None) -> str:
     """The one-line form launchers print (``POSTMORTEM verdict=…``)."""
     parts = ["POSTMORTEM"]

@@ -16,8 +16,11 @@ Covers, WITHOUT subprocesses or real sleeps:
 The SIGKILL crash-recovery test lives in tests/test_chaos.py (chaos lane).
 """
 
+import importlib.util
 import json
 import os
+import re
+import sys
 import zlib
 
 import numpy as np
@@ -125,6 +128,138 @@ class TestFaultModes:
         with faults.inject("sched.journal.write", hang=0, fail=1):
             with pytest.raises(faults.TransientFault):
                 faults.fire("sched.journal.write")
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a fault-site literal at an arming/firing call: fire("..."), _fire("..."),
+# inject("..."), trip_count("...") — the textual surface HT113 also checks
+_SITE_CALL = re.compile(r"""(?:fire|inject|trip_count)\(\s*(['"])([^'"]+)\1""")
+
+
+def _fresh_faults(name):
+    """An independently spec-loaded twin of utils/faults.py — what a
+    standalone chaos-campaign host or a replayed rank actually gets."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "heat_tpu", "utils", "faults.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCatalog:
+    def test_catalog_shape(self):
+        cat = faults.catalog()
+        sites = faults.catalog_sites()
+        assert len(cat) == len(sites) >= 10
+        for entry in cat:
+            assert set(entry) >= {"site", "modes", "layer", "fires"}
+            assert entry["modes"], f"{entry['site']}: no meaningful modes"
+            for m in entry["modes"]:
+                assert m in faults.MODES
+
+    def test_catalog_returns_copies(self):
+        faults.catalog()[0]["site"] = "mutated"
+        assert "mutated" not in faults.catalog_sites()
+
+    def test_every_fault_site_literal_in_repo_is_cataloged(self):
+        """ISSUE 20 satellite: grep the whole repo for fault-site string
+        literals at arming/firing sites — every one must be a catalog
+        member (a typo'd site silently never fires), and every catalog
+        member must actually be armed or fired somewhere (a dead entry
+        would let the campaign claim coverage it cannot have)."""
+        known = faults.catalog_sites()
+        found = {}
+        for root in ("heat_tpu", "scripts", "tests", "benchmarks",
+                     "tutorials"):
+            base = os.path.join(REPO, root)
+            if not os.path.isdir(base):
+                continue
+            for dirpath, _, files in os.walk(base):
+                for fname in files:
+                    if not fname.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, fname)
+                    with open(path, encoding="utf-8") as fh:
+                        src = fh.read()
+                    for m in _SITE_CALL.finditer(src):
+                        found.setdefault(m.group(2), set()).add(
+                            os.path.relpath(path, REPO)
+                        )
+        # placeholder prose ("...") and the deliberately-misspelled
+        # examples HT113's docs and fixtures demonstrate the bug with
+        bogus = {"...", "io.wrte", "bogus.site"}
+        unknown = {
+            s: sorted(ps) for s, ps in found.items()
+            if s not in known and s not in bogus
+        }
+        assert not unknown, (
+            f"fault-site literals not in faults.catalog(): {unknown}"
+        )
+        dead = known - set(found)
+        assert not dead, f"catalog sites never fired or armed anywhere: {dead}"
+
+    def test_render_spec_round_trip(self):
+        text = "io.write:delay=0.25,fail=2;sched.dispatch:exit=4"
+        specs = faults.parse_spec(text)
+        rendered = faults.render_spec(specs)
+        again = faults.parse_spec(rendered)
+        assert faults.render_spec(again) == rendered
+        assert again["io.write"].delay == 0.25
+        assert again["io.write"].fail == 2
+        assert again["sched.dispatch"].exit == 4
+        assert faults.render_spec({}) == ""
+
+    def test_trips_accessor(self):
+        with faults.inject("io.write", fail=1):
+            with pytest.raises(faults.TransientFault):
+                faults.fire("io.write")
+        faults.fire("io.read")  # disarmed: no trip recorded
+        assert faults.trips() == {"io.write": 1}
+
+
+class TestDeterministicJitter:
+    def test_jitter_unit_deterministic_across_loads(self):
+        """ISSUE 20 satellite: the backoff jitter is a pure function of
+        ``(site, attempt)`` — two independently loaded ranks (or a replayed
+        chaos schedule) derive identical sleep sequences."""
+        a = _fresh_faults("_faults_twin_a")
+        b = _fresh_faults("_faults_twin_b")
+        try:
+            for site in ("io.write", "comm.collective", "sched.dispatch"):
+                for attempt in range(6):
+                    u = faults.jitter_unit(site, attempt)
+                    assert 0.0 <= u < 1.0
+                    assert a.jitter_unit(site, attempt) == u
+                    assert b.jitter_unit(site, attempt) == u
+        finally:
+            del sys.modules["_faults_twin_a"], sys.modules["_faults_twin_b"]
+
+    def test_jitter_decorrelates_sites_and_attempts(self):
+        # the reason jitter exists: concurrent retriers must spread out
+        assert faults.jitter_unit("io.write", 0) != faults.jitter_unit(
+            "io.read", 0
+        )
+        draws = {faults.jitter_unit("io.write", i) for i in range(8)}
+        assert len(draws) == 8
+
+    def test_backoff_default_uses_seeded_jitter(self):
+        want = [
+            min(2.0, 0.1 * 2.0**i) * (1.0 + 0.5 * faults.jitter_unit("io.write", i))
+            for i in range(4)
+        ]
+        got = list(
+            faults.backoff_schedule(4, base_delay=0.1, jitter=0.5,
+                                    site="io.write")
+        )
+        np.testing.assert_allclose(got, want)
+        # and the schedule is reproducible call-to-call (no process entropy)
+        assert got == list(
+            faults.backoff_schedule(4, base_delay=0.1, jitter=0.5,
+                                    site="io.write")
+        )
 
 
 class TestBackoff:

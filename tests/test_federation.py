@@ -476,6 +476,82 @@ class TestReplayAndAttestation:
         assert replay["torn"] == 1
         assert replay["jobs"]["a"]["state"] == F.SUBMITTED  # torn DONE never lands
 
+    def test_torn_final_record_requeued_on_recovery(self, tmp_path):
+        """A crash mid-append of a job's TERMINAL record is the canonical
+        torn-tail: the half-written DONE must not land, and recovery must
+        requeue the job — a torn terminal treated as landed would be a
+        silently lost answer (the exact failure class the chaos
+        ``no_lost_jobs`` oracle exists to catch)."""
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.submit(_job("keep"))
+        fed.submit(_job("torn"))
+        fed.assign()
+        # 'keep' finishes cleanly; 'torn' crashes mid-terminal-append
+        fed.journal.append({"type": F.DONE, "id": "keep", "world": "w0",
+                            "exec_s": 0.1, "result": {"digest": 1.0}})
+        with open(fed.journal.path, "a") as fh:
+            fh.write('{"type": "done", "id": "torn", "wor')
+        replay = F.replay_federation(fed.journal.path)
+        assert replay["torn"] == 1
+        assert replay["jobs"]["torn"]["state"] == F.ASSIGNED
+        fed2 = F.Federation(str(tmp_path / "r2.jsonl"))
+        n = fed2.recover(fed.journal.path, epoch=1)
+        assert n == 1
+        assert [j.job_id for j in fed2._queue] == ["torn"]
+        # the cleanly journaled DONE is served, never re-executed
+        assert fed2.ingress_result("keep")["result"] == {"digest": 1.0}
+
+    def test_torn_header_refused_loudly(self, tmp_path):
+        """A journal whose meta header line itself is torn must REFUSE to
+        replay (JournalSchemaError), not silently recover zero jobs: the
+        header is written via tmp+rename, so a torn header means file
+        corruption outside the append protocol — guessing would risk
+        resurrecting a journal whose schema can no longer be verified."""
+        path = tmp_path / "j.jsonl"
+        path.write_text(
+            '{"type": "meta", "sch\n'
+            + json.dumps({"type": F.SUBMITTED, "id": "a", "kind": "matmul"})
+            + "\n"
+        )
+        with pytest.raises(S.JournalSchemaError, match="before any"):
+            F.replay_federation(str(path))
+        fed = _fed(tmp_path)
+        with pytest.raises(S.JournalSchemaError):
+            fed.recover(str(path), epoch=1)
+
+    def test_torn_world_journal_terminal_not_folded(self, tmp_path):
+        """``reconcile_world_journal`` over a world journal whose final
+        DONE record is torn must fold NOTHING for that job (the terminal
+        never durably landed) — and folding again after the world's
+        journal heals must remain exactly-once."""
+        fed = _fed(tmp_path)
+        fed.add_world("w0")
+        fed.submit(_job("j1"))
+        fed.assign()
+        wj = tmp_path / "w0.jsonl"
+        sched_j = S.JobJournal(str(wj))
+        sched_j.append({"type": S.SUBMITTED, "id": "j1", "kind": "matmul"})
+        with open(wj, "a") as fh:
+            fh.write('{"type": "done", "id": "j1"')  # torn terminal
+        assert fed.reconcile_world_journal("w0", path=str(wj)) == {
+            "done": 0, "failed": 0,
+        }
+        assert F.replay_federation(fed.journal.path)["jobs"]["j1"]["state"] == (
+            F.ASSIGNED
+        )
+        # the world heals: a restarted generation re-opens the journal
+        # (its fresh header line absorbs the torn tail) and lands a
+        # complete terminal, which folds exactly once
+        sched_j2 = S.JobJournal(str(wj), epoch=1)
+        sched_j2.append({"type": S.DONE, "id": "j1", "result": {"d": 2.0}})
+        assert fed.reconcile_world_journal("w0", path=str(wj)) == {
+            "done": 1, "failed": 0,
+        }
+        assert fed.reconcile_world_journal("w0", path=str(wj)) == {
+            "done": 0, "failed": 0,
+        }
+
     def test_attestation_line_shape(self, tmp_path):
         fed = _fed(tmp_path)
         fed.add_world("w0")

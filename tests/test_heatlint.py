@@ -32,6 +32,7 @@ from heat_tpu.analysis.rules import (
     RawEntropyRule,
     SeqStampBypassRule,
     TraceIdentityRule,
+    UnknownFaultSiteRule,
     UnledgeredDeviceBufferRule,
     UseAfterDonateRule,
 )
@@ -908,6 +909,80 @@ class TestHT112:
 
 
 # ---------------------------------------------------------------------- #
+# HT113 — fault-site literals must be catalog members
+# ---------------------------------------------------------------------- #
+class TestHT113:
+    def test_misspelled_fire_site_flagged(self):
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils import faults
+            def save(path):
+                faults.fire("io.wrte", path=path)
+        """)
+        assert [f.detail for f in fs] == ["fire('io.wrte')"]
+        assert fs[0].rule == "HT113"
+
+    def test_unregistered_inject_site_flagged(self):
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils.faults import inject
+            def test_x():
+                with inject("bogus.site", fail=1):
+                    pass
+        """)
+        assert [f.detail for f in fs] == ["inject('bogus.site')"]
+
+    def test_trip_count_and_faultspec_literals_checked(self):
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils import faults
+            def audit():
+                spec = faults.FaultSpec("io.wrte", fail=1)
+                return faults.trip_count("bogus.site"), spec
+        """)
+        assert sorted(f.detail for f in fs) == [
+            "FaultSpec('io.wrte')", "trip_count('bogus.site')",
+        ]
+
+    def test_catalog_members_not_flagged(self):
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils import faults
+            def save(path):
+                faults.fire("io.write", path=path)
+                with faults.inject("sched.dispatch", fail=1):
+                    pass
+                return faults.trip_count("mem.alloc")
+        """)
+        assert fs == []
+
+    def test_variable_site_out_of_scope(self):
+        # a variable site is someone's abstraction — only literals are
+        # lexically checkable
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils import faults
+            def fire_all(sites):
+                for site in sites:
+                    faults.fire(site)
+        """)
+        assert fs == []
+
+    def test_call_with_retries_pseudo_site_exempt(self):
+        # call_with_retries' site parameter names retry COUNTERS, not
+        # armed fault sites — the chaos harness uses pseudo-sites there
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils import faults
+            def submit(fn):
+                return faults.call_with_retries(fn, "chaos.submit", retries=2)
+        """)
+        assert fs == []
+
+    def test_suppression_works(self):
+        fs = run_rule(UnknownFaultSiteRule(), """
+            from heat_tpu.utils import faults
+            def probe():
+                faults.fire("io.wrte")  # heatlint: disable=HT113 negative fixture
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------- #
 # HT109 — trace identity owned by the tracing choke points
 # ---------------------------------------------------------------------- #
 class TestHT109:
@@ -1031,9 +1106,8 @@ class TestFramework:
         codes = [r.code for r in all_rules()]
         assert codes == [
             "HT101", "HT102", "HT103", "HT104", "HT105", "HT106", "HT107",
-            "HT108", "HT109", "HT110", "HT111", "HT112", "HT201", "HT202",
-            "HT203",
-            "HT204", "HT301", "HT302", "HT303", "HT304",
+            "HT108", "HT109", "HT110", "HT111", "HT112", "HT113", "HT201",
+            "HT202", "HT203", "HT204", "HT301", "HT302", "HT303", "HT304",
         ]
 
     def test_select_unknown_rule_raises(self):

@@ -110,44 +110,18 @@ def test_postmortem_names_hung_rank_and_seq():
     iteration) → the supervisor's heartbeat monitor tears the world down →
     the harvested flight-recorder rings name the hung rank AND the exact
     collective sequence it hung on (the stamp is written before the fault
-    site fires, so the ring's last record IS the wedged collective)."""
-    proc = mpd.launch(
-        timeout=700,
-        n_proc=2,
-        devs_per_proc=4,
-        mode="postmortem",
-        extra_env={
-            "MPDRYRUN_HANG_RANK": 1,
-            "MPDRYRUN_CHAOS_AT": 3,
-            # short staleness budget: the postmortem worker pre-touches its
-            # beacon before the heavy bring-up imports, so 25 s covers
-            # bring-up while keeping post-hang detection fast
-            "MPDRYRUN_HB_TIMEOUT": 25,
-        },
+    site fires, so the ring's last record IS the wedged collective).
+
+    ISSUE 20: the whole contract — FAILED rc, semantic staleness line,
+    the derived straggler verdict and critical-path attribution at the
+    EXACT seq the victim announced — is the declarative
+    ``hang-straggler-verdict`` spec replayed through the chaos engine."""
+    from heat_tpu.chaos import scenarios
+
+    proc = scenarios.run_scenario("hang-straggler-verdict")
+    assert scenarios.check_scenario("hang-straggler-verdict", proc) == [], (
+        (proc.stderr or proc.stdout)[-3000:]
     )
-    out = proc.stdout
-    # a wedged world is a FAILED run: restart budget 0 -> supervisor gives
-    # up after the teardown, with the post-mortem in its report
-    assert proc.returncode != 0
-    assert "SUPERVISOR GAVE UP" in out, out[-3000:]
-    # the victim announced the seq of the collective it was armed to hang
-    # on; the analyzer must name that rank and that exact seq/op
-    m = re.search(r"\[1\] PM-HANG expect_seq=(\d+)", out)
-    assert m, out[-3000:]
-    expect_seq = int(m.group(1))
-    verdict = f"POSTMORTEM epoch=0 verdict=straggler rank=1 seq={expect_seq} op=resplit"
-    assert verdict in out, out[-3000:]
-    # the heartbeat beacons carried the flight recorder's seq, so the
-    # supervisor's staleness line shows SEMANTIC progress, not just mtime
-    assert re.search(r"heartbeat stale .*stuck at seq \d+ resplit", out), out[-3000:]
-    # critical-path attribution (ISSUE 18) agrees with the post-mortem:
-    # the injected hang rank is the NAMED gating rank, blamed at its last
-    # stamped (seq, op) — the very collective it wedged on
-    assert (
-        f"CRITICAL-PATH kind=collective rank=1 op=resplit seq={expect_seq}"
-        in out
-    ), out[-3000:]
-    assert re.search(r"TRACE-EXPORT events=\d+ ranks=\d+ out=", out), out[-3000:]
 
 
 @pytest.mark.heavy
@@ -157,28 +131,16 @@ def test_postmortem_names_first_divergent_seq():
     """ISSUE 7 acceptance (b): one rank of a 3-process world stages a
     rank-conditional EXTRA collective (the classic SPMD desync) → the
     analyzer reports the first divergent sequence and names the deviating
-    rank by majority vote across the 3 fingerprint streams."""
-    proc = mpd.launch(
-        timeout=700,
-        n_proc=3,
-        devs_per_proc=2,
-        mode="postmortem",
-        extra_env={
-            "MPDRYRUN_DESYNC_RANK": 1,
-            "MPDRYRUN_CHAOS_AT": 3,
-            "MPDRYRUN_HB_TIMEOUT": 25,
-        },
-    )
-    out = proc.stdout
-    assert proc.returncode != 0
-    assert "SUPERVISOR GAVE UP" in out, out[-3000:]
-    m = re.search(r"\[1\] PM-DESYNC expect_seq=(\d+)", out)
-    assert m, out[-3000:]
-    expect_seq = int(m.group(1))
-    # first divergent seq = the extra collective's stamp; rank 1 is the
-    # minority fingerprint group among 3 ranks
-    assert f"POSTMORTEM epoch=0 verdict=desync seq={expect_seq} ranks=1" in out, (
-        out[-3000:]
+    rank by majority vote across the 3 fingerprint streams.
+
+    ISSUE 20: declared as the ``desync-minority-verdict`` spec — the
+    derived clause asserts the verdict names the EXACT seq the victim
+    announced (``PM-DESYNC expect_seq=N`` → ``verdict=desync seq=N``)."""
+    from heat_tpu.chaos import scenarios
+
+    proc = scenarios.run_scenario("desync-minority-verdict")
+    assert scenarios.check_scenario("desync-minority-verdict", proc) == [], (
+        (proc.stderr or proc.stdout)[-3000:]
     )
 
 
@@ -243,56 +205,17 @@ def test_serve_sigkill_mid_queue_loses_zero_jobs():
     relaunches → every rank replays rank 0's journal and requeues the
     accepted-but-unfinished jobs EXACTLY once → every accepted job ends
     DONE (zero lost, no duplicate execution), the shed jobs stay shed,
-    and the launcher's journal-derived attestation proves it."""
-    proc = mpd.launch(
-        timeout=700,
-        n_proc=2,
-        devs_per_proc=4,
-        mode="serve",
-        extra_env={
-            "MPDRYRUN_FAULT_RANK": 1,
-            "MPDRYRUN_FAULT_SPEC": "sched.dispatch:exit=4",
-            "MPDRYRUN_RESTARTS": 2,
-        },
+    and the launcher's journal-derived attestation proves it.
+
+    ISSUE 20: the contract — zero-loss attestation, per-rank lockstep
+    requeue equality (derived clauses), trace continuity across the
+    restart — is the declarative ``serve-sigkill-mid-queue`` spec."""
+    from heat_tpu.chaos import scenarios
+
+    proc = scenarios.run_scenario("serve-sigkill-mid-queue")
+    assert scenarios.check_scenario("serve-sigkill-mid-queue", proc) == [], (
+        (proc.stderr or proc.stdout)[-3000:]
     )
-    out = proc.stdout
-    assert proc.returncode == 0, (proc.stderr or out)[-3000:]
-    assert mpd.PASS_MARKER in out
-    # the victim really died by SIGKILL mid-queue and exactly one restart
-    # followed (the fault is disarmed on the restarted world)
-    assert "rank 1 died with exit code -9" in out, out[-3000:]
-    assert "SUPERVISOR restarts=1 generations=2" in out, out[-3000:]
-    # zero-loss attestation from the journal: all 18 accepted jobs DONE
-    # across the two generations, both shed jobs stayed shed, none failed,
-    # none lost (requeued varies with where teardown caught rank 0 —
-    # in-flight plus still-queued jobs — but is at least the wedged batch)
-    m = re.search(
-        r"SCHED jobs=20 done=18 requeued=(\d+) shed=2 failed=0 lost=0", out
-    )
-    assert m, out[-3000:]
-    requeued = int(m.group(1))
-    assert requeued >= 1
-    # every rank replayed the SAME journal and requeued the SAME set —
-    # SPMD lockstep recovery (a divergent requeue would desync the world)
-    for pid in range(2):
-        rm = re.search(
-            rf"\[{pid}\] SCHED-RECOVERED epoch=1 requeued=(\d+)", out
-        )
-        assert rm, out[-3000:]
-        assert int(rm.group(1)) == requeued
-        assert f"[{pid}] {mpd.SERVE_MARKER}" in out, out[-3000:]
-    # the supervisor report's jobs section carries the same accounting per
-    # generation (printed in the SUPERVISOR summary path)
-    assert "TELEMETRY-MERGED ranks=2" in out, out[-3000:]
-    # trace-id continuity across the SIGKILL restart (ISSUE 11 satellite):
-    # every requeued job's post-restart journal records carry the SAME
-    # trace id its pre-crash submit minted (replay preserves it) — the
-    # launcher audits the whole journal and attests it; a severed chain
-    # fails the run
-    assert "SCHED-TRACE-CONTINUITY jobs=20 ok=True" in out, out[-3000:]
-    # ...and the launcher rendered a requeued job's assembled causal
-    # timeline: one trace id spanning BOTH generations' records
-    assert "causal timeline for trace" in out, out[-3000:]
 
 
 @pytest.mark.heavy
